@@ -1,0 +1,13 @@
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.algorithms.fedopt import FedOptEngine
+from fedml_tpu.algorithms.fedprox import FedProxEngine
+from fedml_tpu.algorithms.fednova import FedNovaEngine
+from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustEngine
+from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgEngine
+from fedml_tpu.algorithms.decentralized import DecentralizedGossipEngine
+
+__all__ = [
+    "FedAvgEngine", "FedOptEngine", "FedProxEngine", "FedNovaEngine",
+    "FedAvgRobustEngine", "HierarchicalFedAvgEngine",
+    "DecentralizedGossipEngine",
+]

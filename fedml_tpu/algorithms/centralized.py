@@ -1,0 +1,65 @@
+"""Centralized (non-FL) baseline trainer.
+
+Reference: fedml_api/centralized/centralized_trainer.py +
+fedml_experiments/centralized/main.py (the only classic data-parallel path
+in the reference — PyTorch DDP).  TPU-native, data parallelism is a sharded
+batch axis under jit; see parallel/engine.py for the mesh version.  This is
+also one side of the correctness oracle: FedAvg with full participation,
+full batch, E=1 must match this trainer's accuracy to 3 decimals
+(CI-script-fedavg.sh:41-47).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.utils.config import FedConfig
+
+
+class CentralizedTrainer:
+    def __init__(self, trainer: ClientTrainer, data: FederatedData,
+                 cfg: FedConfig):
+        self.trainer = trainer
+        self.data = data
+        self.cfg = cfg
+        self.epoch_fn = jax.jit(
+            lambda v, shard, rng: trainer.local_train(v, shard, rng, 1))
+        self.eval_fn = jax.jit(trainer.evaluate)
+        self.metrics_history: list[dict] = []
+        self._shard_cache: dict = {}
+
+    def run(self, epochs: Optional[int] = None, variables=None):
+        cfg = self.cfg
+        rng = jax.random.PRNGKey(cfg.seed)
+        if "train" not in self._shard_cache:   # upload once, reuse
+            self._shard_cache["train"] = jax.tree.map(
+                jnp.asarray, self.data.train_global)
+        shard = self._shard_cache["train"]
+        if variables is None:
+            variables = self.trainer.init(rng, shard["x"][0])
+        epochs = epochs if epochs is not None else cfg.comm_round
+        for ep in range(epochs):
+            rng, r = jax.random.split(rng)
+            variables, loss, _ = self.epoch_fn(variables, shard, r)
+            if ep % cfg.frequency_of_the_test == 0 or ep == epochs - 1:
+                stats = self.evaluate(variables)
+                stats.update(epoch=ep, train_loss=float(loss))
+                self.metrics_history.append(stats)
+        return variables
+
+    def evaluate(self, variables) -> dict:
+        out = {}
+        for split in ("train", "test"):
+            if split not in self._shard_cache:   # upload once, reuse
+                src = (self.data.train_global if split == "train"
+                       else self.data.test_global)
+                self._shard_cache[split] = jax.tree.map(jnp.asarray, src)
+            sums = self.eval_fn(variables, self._shard_cache[split])
+            cnt = max(float(sums["count"]), 1.0)
+            out[f"{split}_acc"] = float(sums["correct"]) / cnt
+            out[f"{split}_loss"] = float(sums["loss_sum"]) / cnt
+        return out

@@ -1,0 +1,133 @@
+"""FedAvg — the canonical algorithm, TPU-native.
+
+Reference call stack (SURVEY.md §3.1/§3.2): one OS process per client, MPI
+message per model exchange, server aggregates state dicts in a Python loop.
+Here the whole round is ONE jit-compiled XLA program:
+
+    round_fn(variables, cohort_shards, rng)
+      = vmap(local_train) over the cohort axis       (clients in parallel)
+      → sample-weighted tree mean                    (aggregation)
+
+The cohort axis can further be sharded over a `Mesh` (parallel/engine.py) so
+aggregation lowers to a `psum` over ICI.  The Python layer is only: sample
+client ids (reference-identical numpy semantics), gather the cohort with
+`jnp.take`, log metrics.
+
+Parity targets: fedml_api/standalone/fedavg/fedavg_api.py:40-115 (loop,
+_aggregate), fedml_api/distributed/fedavg/FedAVGAggregator.py:59-98
+(weighted average + sampling), FedAVGTrainer/MyModelTrainer (local SGD).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.sampling import ClientSampler
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.utils.config import FedConfig
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+
+class FedAvgEngine:
+    """Standalone-simulation FedAvg (single device or vmap cohort)."""
+
+    def __init__(self, trainer: ClientTrainer, data: FederatedData,
+                 cfg: FedConfig, donate: bool = True):
+        self.trainer = trainer
+        self.data = data
+        self.cfg = cfg
+        self.sampler = ClientSampler(cfg.client_num_in_total,
+                                     cfg.client_num_per_round)
+        self.round_fn = jax.jit(
+            self._round, donate_argnums=(0,) if donate else ())
+        self.eval_fn = jax.jit(self.trainer.evaluate)
+        # upload eval shards once; evaluate() then runs fully device-side
+        self._eval_shards = {
+            "train": jax.tree.map(jnp.asarray, data.train_global),
+            "test": jax.tree.map(jnp.asarray, data.test_global),
+        }
+        self.metrics_history: list[dict] = []
+
+    # ---- server state (FedOpt's persistent optimizer etc.) ----------------
+    def server_init(self, variables: Pytree) -> Pytree:
+        return ()
+
+    # ---- aggregation customization point (FedOpt/robust override) --------
+    def aggregate(self, stacked_variables: Pytree, weights: jax.Array,
+                  global_variables: Pytree, server_state: Pytree,
+                  rng: jax.Array) -> tuple[Pytree, Pytree]:
+        """Sample-weighted mean over ALL variable collections (params and
+        batch_stats alike), matching the reference's iteration over every
+        state_dict key (FedAVGAggregator.py:74-81)."""
+        return tree_weighted_mean(stacked_variables, weights), server_state
+
+    # ---- one federated round, fully jitted -------------------------------
+    def _round(self, variables: Pytree, server_state: Pytree, cohort: dict,
+               rng: jax.Array):
+        K = cohort["mask"].shape[0]
+        rng, agg_rng = jax.random.split(rng)
+        client_rngs = jax.random.split(rng, K)
+        global_params = variables["params"] if self.trainer.prox_mu > 0 else None
+
+        def one_client(shard, crng):
+            return self.trainer.local_train(
+                variables, shard, crng, self.cfg.epochs,
+                global_params=global_params)
+
+        stacked_vars, losses, ns = jax.vmap(one_client)(cohort, client_rngs)
+        new_variables, server_state = self.aggregate(
+            stacked_vars, ns, variables, server_state, agg_rng)
+        train_loss = jnp.sum(losses * ns) / jnp.sum(ns)
+        return new_variables, server_state, {"train_loss": train_loss}
+
+    # ---- driver loop ------------------------------------------------------
+    def init_variables(self, rng: Optional[jax.Array] = None) -> Pytree:
+        rng = rng if rng is not None else jax.random.PRNGKey(self.cfg.seed)
+        sample = jnp.asarray(self.data.client_shards["x"][0, 0])
+        return self.trainer.init(rng, sample)
+
+    def run(self, variables: Optional[Pytree] = None,
+            rounds: Optional[int] = None) -> Pytree:
+        """The reference's train() loop (fedavg_api.py:40-81)."""
+        cfg = self.cfg
+        variables = variables if variables is not None else self.init_variables()
+        server_state = self.server_init(variables)
+        rng = jax.random.PRNGKey(cfg.seed + 1)
+        rounds = rounds if rounds is not None else cfg.comm_round
+        for round_idx in range(rounds):
+            t0 = time.time()
+            client_ids = self.sampler.sample(round_idx)
+            cohort, _ = self.data.cohort(client_ids)
+            rng, round_rng = jax.random.split(rng)
+            variables, server_state, m = self.round_fn(
+                variables, server_state, cohort, round_rng)
+            if (round_idx % cfg.frequency_of_the_test == 0
+                    or round_idx == rounds - 1):
+                stats = self.evaluate(variables)
+                stats.update(round=round_idx,
+                             train_loss=float(m["train_loss"]),
+                             round_time=time.time() - t0)
+                self.metrics_history.append(stats)
+                log.info("round %d: %s", round_idx, stats)
+        return variables
+
+    def evaluate(self, variables: Pytree) -> dict:
+        """Server-side eval on global train/test shards
+        (FedAVGAggregator.test_on_server_for_all_clients, :110-164)."""
+        out = {}
+        for split, shard in self._eval_shards.items():
+            sums = self.eval_fn(variables, shard)
+            cnt = float(sums["count"])
+            out[f"{split}_acc"] = float(sums["correct"]) / max(cnt, 1.0)
+            out[f"{split}_loss"] = float(sums["loss_sum"]) / max(cnt, 1.0)
+        return out

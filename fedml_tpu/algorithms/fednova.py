@@ -1,0 +1,57 @@
+"""FedNova — normalized averaging.
+
+Reference (fedml_api/standalone/fednova/fednova.py:50-200,
+fednova_trainer.py:97-125): each client i runs tau_i local steps; the server
+averages *normalized* update directions d_i = (w_global - w_i)/tau_i with
+data weights p_i, then applies w_new = w_global - tau_eff * d where
+tau_eff = sum_i p_i tau_i.  This removes the objective inconsistency of
+FedAvg under heterogeneous local work.
+
+TPU-native: tau_i is computed from the shard mask (number of non-empty
+batches x epochs) inside the jitted round; no custom Optimizer subclass is
+needed because the normalization happens at aggregation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.core.pytree import tree_sub
+
+
+class FedNovaEngine(FedAvgEngine):
+    def _round(self, variables, server_state, cohort, rng):
+        K = cohort["mask"].shape[0]
+        rng, _ = jax.random.split(rng)
+        client_rngs = jax.random.split(rng, K)
+
+        def one_client(shard, crng):
+            new_vars, loss, n = self.trainer.local_train(
+                variables, shard, crng, self.cfg.epochs)
+            # tau_i = local optimization steps that saw real data
+            nonempty = jnp.sum((jnp.sum(shard["mask"], axis=1) > 0)
+                               .astype(jnp.float32))
+            tau = nonempty * self.cfg.epochs
+            return new_vars, loss, n, tau
+
+        stacked_vars, losses, ns, taus = jax.vmap(one_client)(cohort, client_rngs)
+        p = ns / jnp.sum(ns)
+        tau_eff = jnp.sum(p * taus)
+
+        def nova_avg(g_leaf, stacked_leaf):
+            # d = sum_i p_i (g - w_i)/tau_i ; w_new = g - tau_eff * d
+            shape = (-1,) + (1,) * (stacked_leaf.ndim - 1)
+            pi = p.reshape(shape).astype(stacked_leaf.dtype)
+            ti = taus.reshape(shape).astype(stacked_leaf.dtype)
+            d = jnp.sum(pi * (g_leaf[None] - stacked_leaf) / jnp.maximum(ti, 1.0),
+                        axis=0)
+            return g_leaf - tau_eff.astype(stacked_leaf.dtype) * d
+
+        new_params = jax.tree.map(nova_avg, variables["params"],
+                                  stacked_vars["params"])
+        new_vars = {k: jax.tree.map(lambda s: jnp.mean(s, axis=0), v)
+                    for k, v in stacked_vars.items() if k != "params"}
+        new_vars["params"] = new_params
+        train_loss = jnp.sum(losses * ns) / jnp.sum(ns)
+        return new_vars, server_state, {"train_loss": train_loss}

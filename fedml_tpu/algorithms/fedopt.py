@@ -1,0 +1,61 @@
+"""FedOpt — server optimizer applied to the pseudo-gradient.
+
+Reference (fedml_api/distributed/fedopt/FedOptAggregator.py:70-123 and
+standalone fedopt_api.py:122-152): weighted-average the client models, form
+pseudo-gradient g = w_global - w_avg, install it as .grad, and step a torch
+server optimizer whose state persists across rounds.
+
+TPU-native: the server optimizer is an optax transformation and its state is
+part of the jitted round's carried server_state — no reflection over
+optimizer subclasses (OptRepo, optrepo.py:11-39) needed: optax names map
+directly.  FedAvgM = sgd(momentum), FedAdam/FedYogi/FedAdagrad = the matching
+optax transforms.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.core.pytree import tree_weighted_mean, tree_sub
+
+Pytree = Any
+
+
+def make_server_optimizer(name: str, lr: float, momentum: float = 0.9):
+    name = name.lower()
+    if name in ("sgd", "fedavgm"):
+        return optax.sgd(lr, momentum=momentum if momentum else None)
+    if name in ("adam", "fedadam"):
+        return optax.adam(lr, b1=0.9, b2=0.99, eps=1e-3)
+    if name in ("yogi", "fedyogi"):
+        return optax.yogi(lr)
+    if name in ("adagrad", "fedadagrad"):
+        return optax.adagrad(lr)
+    raise ValueError(f"unknown server optimizer {name!r}")
+
+
+class FedOptEngine(FedAvgEngine):
+    def __init__(self, trainer, data, cfg, **kw):
+        self.server_tx = make_server_optimizer(
+            cfg.server_optimizer, cfg.server_lr, cfg.server_momentum)
+        super().__init__(trainer, data, cfg, **kw)
+
+    def server_init(self, variables: Pytree) -> Pytree:
+        return self.server_tx.init(variables["params"])
+
+    def aggregate(self, stacked_variables, weights, global_variables,
+                  server_state, rng):
+        avg = tree_weighted_mean(stacked_variables, weights)
+        # pseudo-gradient: optax minimizes, so g = w_global - w_avg moves
+        # params toward the client average at server_lr=1 (reference
+        # set_model_global_grads, FedOptAggregator.py:109-123).
+        pseudo_grad = tree_sub(global_variables["params"], avg["params"])
+        updates, server_state = self.server_tx.update(
+            pseudo_grad, server_state, global_variables["params"])
+        new_params = optax.apply_updates(global_variables["params"], updates)
+        new_vars = dict(avg)      # non-param collections (BN stats): averaged
+        new_vars["params"] = new_params
+        return new_vars, server_state

@@ -1,0 +1,24 @@
+"""FedProx — FedAvg + proximal term (mu/2)||w - w_global||^2 in the client
+loss.
+
+Reference: fedml_api/distributed/fedprox/ mirrors FedAvg file-for-file; the
+prox term lives in the client trainer config.  Here it is literally the
+FedAvg engine with the trainer's prox_mu enabled — the ClientTrainer adds the
+term inside the jitted loss (core/trainer.py), so the whole-round program is
+unchanged in structure.
+"""
+from __future__ import annotations
+
+import copy
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+
+
+class FedProxEngine(FedAvgEngine):
+    def __init__(self, trainer, data, cfg, **kw):
+        if trainer.prox_mu <= 0.0:
+            # never mutate the caller's trainer (it may be shared with a
+            # plain-FedAvg engine whose jit traces would pick up the mu)
+            trainer = copy.copy(trainer)
+            trainer.prox_mu = cfg.prox_mu if cfg.prox_mu > 0 else 0.01
+        super().__init__(trainer, data, cfg, **kw)

@@ -1,0 +1,63 @@
+"""Hierarchical FL — two-tier FedAvg (clients -> groups -> global).
+
+Reference (fedml_api/standalone/hierarchical_fl/trainer.py:44-69, group.py:
+24-46): groups run `group_comm_round` inner FedAvg rounds starting from the
+global model, then the global model is the sample-weighted average of group
+models.  Oracle: with full participation/full batch/E=1 the result is
+invariant to the grouping (CI-script-fedavg.sh:51-59).
+
+TPU-native: cohort reshaped to [G, M, ...]; inner group rounds are a
+`lax.scan`, clients within a group a `vmap`, groups a second `vmap` — the
+whole two-tier schedule is one XLA program.  On a pod this maps to psum
+within an ICI slice (group tier) and a cross-slice reduction over DCN
+(global tier) — see parallel/engine.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.core.pytree import tree_weighted_mean
+
+
+class HierarchicalFedAvgEngine(FedAvgEngine):
+    def __init__(self, trainer, data, cfg, group_num: int = 2,
+                 group_comm_round: int = 1, **kw):
+        self.group_num = group_num
+        self.group_comm_round = group_comm_round
+        super().__init__(trainer, data, cfg, **kw)
+
+    def _round(self, variables, server_state, cohort, rng):
+        """One *global* round = `group_comm_round` inner rounds per group."""
+        K = cohort["mask"].shape[0]
+        G = self.group_num
+        assert K % G == 0, "cohort must split evenly into groups"
+        M = K // G
+        grouped = jax.tree.map(
+            lambda a: a.reshape((G, M) + a.shape[1:]), cohort)
+        rng, _ = jax.random.split(rng)
+
+        def group_inner(group_vars, shards, grng):
+            """`group_comm_round` FedAvg rounds inside one group."""
+            def inner_round(carry, r):
+                gv, k = carry
+                k, sub = jax.random.split(k)
+                crngs = jax.random.split(sub, M)
+                sv, losses, ns = jax.vmap(
+                    lambda sh, cr: self.trainer.local_train(
+                        gv, sh, cr, self.cfg.epochs))(shards, crngs)
+                gv = tree_weighted_mean(sv, ns)
+                return (gv, k), (jnp.sum(losses * ns) / jnp.sum(ns), jnp.sum(ns))
+
+            (gv, _), (losses, ns) = jax.lax.scan(
+                inner_round, (group_vars, grng), jnp.arange(self.group_comm_round))
+            return gv, jnp.mean(losses), ns[-1]
+
+        grngs = jax.random.split(rng, G)
+        group_vars, group_losses, group_ns = jax.vmap(
+            group_inner, in_axes=(None, 0, 0))(variables, grouped, grngs)
+        new_variables = tree_weighted_mean(group_vars, group_ns)
+        train_loss = jnp.sum(group_losses * group_ns) / jnp.sum(group_ns)
+        return new_variables, server_state, {"train_loss": train_loss}

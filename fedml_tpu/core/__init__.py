@@ -1,0 +1,37 @@
+from fedml_tpu.core.pytree import (
+    tree_weighted_mean,
+    tree_stack,
+    tree_unstack,
+    tree_zeros_like,
+    tree_add,
+    tree_sub,
+    tree_scale,
+    tree_dot,
+    tree_l2_norm,
+    tree_clip_by_norm,
+    tree_cast,
+    vectorize_weights,
+)
+from fedml_tpu.core.partition import (
+    partition_homo,
+    partition_dirichlet,
+    partition_power_law,
+    record_data_stats,
+)
+from fedml_tpu.core.sampling import ClientSampler
+from fedml_tpu.core.trainer import ClientTrainer, TrainState
+from fedml_tpu.core.topology import (
+    SymmetricTopologyManager,
+    AsymmetricTopologyManager,
+)
+from fedml_tpu.core.robust import norm_diff_clip, add_weak_dp_noise
+
+__all__ = [
+    "tree_weighted_mean", "tree_stack", "tree_unstack", "tree_zeros_like",
+    "tree_add", "tree_sub", "tree_scale", "tree_dot", "tree_l2_norm",
+    "tree_clip_by_norm", "tree_cast", "vectorize_weights",
+    "partition_homo", "partition_dirichlet", "partition_power_law",
+    "record_data_stats", "ClientSampler", "ClientTrainer", "TrainState",
+    "SymmetricTopologyManager", "AsymmetricTopologyManager",
+    "norm_diff_clip", "add_weak_dp_noise",
+]

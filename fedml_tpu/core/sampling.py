@@ -1,0 +1,42 @@
+"""Deterministic per-round client sampling.
+
+Reproduces the reference's sampling semantics exactly
+(FedAVGAggregator.client_sampling, reference
+fedml_api/distributed/fedavg/FedAVGAggregator.py:90-98):
+``np.random.seed(round_idx); np.random.choice(range(N), k, replace=False)``
+— so runs are comparable round-for-round with the reference, and the
+equivalence oracle (BASELINE.md) stays valid.  A JAX-native sampler is also
+provided for fully-jitted round loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ClientSampler:
+    """Seeded-by-round sampler with the reference's numpy semantics."""
+
+    def __init__(self, client_num_in_total: int, client_num_per_round: int):
+        self.client_num_in_total = client_num_in_total
+        self.client_num_per_round = client_num_per_round
+
+    def sample(self, round_idx: int) -> np.ndarray:
+        if self.client_num_in_total == self.client_num_per_round:
+            return np.arange(self.client_num_in_total, dtype=np.int64)
+        num = min(self.client_num_per_round, self.client_num_in_total)
+        np.random.seed(round_idx)  # deterministic, matches reference
+        return np.asarray(
+            np.random.choice(range(self.client_num_in_total), num, replace=False),
+            dtype=np.int64,
+        )
+
+    def sample_jax(self, round_idx: jax.Array) -> jax.Array:
+        """Traceable variant for fully-jitted round loops: derives a fold-in
+        key from the round index and takes the first k of a permutation.
+        (Not bit-identical to numpy — use `sample` when oracle comparability
+        with the reference matters.)"""
+        key = jax.random.fold_in(jax.random.PRNGKey(0), round_idx)
+        perm = jax.random.permutation(key, self.client_num_in_total)
+        return perm[: self.client_num_per_round]

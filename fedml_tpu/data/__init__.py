@@ -1,0 +1,10 @@
+from fedml_tpu.data.federated import (
+    FederatedData,
+    build_client_shards,
+    build_eval_shard,
+    pad_to_batches,
+)
+from fedml_tpu.data.loaders import load_data
+
+__all__ = ["FederatedData", "build_client_shards", "build_eval_shard",
+           "pad_to_batches", "load_data"]

@@ -1,0 +1,256 @@
+"""Dataset registry + `load_data` dispatch.
+
+Mirrors the reference's per-entry-point dataset dispatch
+(fedml_experiments/distributed/fedavg/main_fedavg.py:138-356) as one
+function.  Every loader returns a `FederatedData` whose client shards are
+stacked padded arrays (see data/federated.py).  When the real files are
+absent (zero-egress image), a deterministic synthetic stand-in with the same
+shapes/vocab/client counts is generated and `synthetic=True` is recorded.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.core.partition import (partition_dirichlet, partition_homo,
+                                      partition_power_law)
+from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                      build_eval_shard)
+from fedml_tpu.data import readers, synthetic
+
+CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR10_STD = (0.2470, 0.2435, 0.2616)
+CIFAR100_MEAN = (0.5071, 0.4866, 0.4409)
+CIFAR100_STD = (0.2673, 0.2564, 0.2762)
+
+
+@dataclass
+class DatasetSpec:
+    n_clients_default: int
+    class_num: int
+    batch_size_default: int
+
+
+SPECS = {
+    "mnist": DatasetSpec(1000, 10, 10),
+    "femnist": DatasetSpec(3400, 62, 20),
+    "fed_cifar100": DatasetSpec(500, 100, 20),
+    "shakespeare": DatasetSpec(715, 90, 4),
+    "fed_shakespeare": DatasetSpec(715, 90, 4),
+    "stackoverflow_nwp": DatasetSpec(1000, 10004, 16),
+    "stackoverflow_lr": DatasetSpec(1000, 500, 16),
+    "cifar10": DatasetSpec(10, 10, 64),
+    "cifar100": DatasetSpec(10, 100, 64),
+    "cinic10": DatasetSpec(10, 10, 64),
+    "synthetic_0_0": DatasetSpec(30, 10, 10),
+    "synthetic_0.5_0.5": DatasetSpec(30, 10, 10),
+    "synthetic_1_1": DatasetSpec(30, 10, 10),
+}
+
+
+def _partition(labels, n_clients, method, alpha, seed):
+    if method == "homo":
+        return partition_homo(len(labels), n_clients, seed)
+    if method == "hetero":
+        return partition_dirichlet(labels, n_clients, alpha, seed=seed)
+    if method == "power_law":
+        return partition_power_law(labels, n_clients, seed)
+    raise ValueError(f"unknown partition {method!r}")
+
+
+def _make(x_tr, y_tr, x_te, y_te, idx_map, batch_size, class_num,
+          max_batches=None, test_idx_map=None, seed=0, synthetic=False):
+    shards = build_client_shards(x_tr, y_tr, idx_map, batch_size,
+                                 max_batches=max_batches, shuffle_seed=seed)
+    sizes = np.array([min(len(idx_map[i]),
+                          shards["mask"].shape[1] * shards["mask"].shape[2])
+                      for i in range(len(idx_map))], np.float32)
+    test_shards = None
+    if test_idx_map is not None:
+        test_shards = build_client_shards(x_te, y_te, test_idx_map, batch_size,
+                                          max_batches=max_batches)
+    return FederatedData(
+        train_data_num=int(len(y_tr)),
+        test_data_num=int(len(y_te)),
+        train_global=build_eval_shard(x_tr, y_tr, max(batch_size, 64)),
+        test_global=build_eval_shard(x_te, y_te, max(batch_size, 64)),
+        client_shards=shards,
+        client_num_samples=sizes,
+        test_client_shards=test_shards,
+        class_num=class_num,
+        synthetic=synthetic,
+    )
+
+
+def load_data(dataset: str,
+              data_dir: Optional[str] = None,
+              client_num_in_total: Optional[int] = None,
+              batch_size: Optional[int] = None,
+              partition_method: str = "hetero",
+              partition_alpha: float = 0.5,
+              max_batches_per_client: Optional[int] = None,
+              seed: int = 0,
+              synthetic_scale: float = 1.0) -> FederatedData:
+    """Load (or synthesize) a federated dataset.
+
+    `synthetic_scale` < 1 shrinks synthetic stand-ins for fast tests.
+    """
+    if dataset not in SPECS:
+        raise ValueError(f"unknown dataset {dataset!r}; known: {sorted(SPECS)}")
+    spec = SPECS[dataset]
+    data_dir = data_dir or ""
+    C = client_num_in_total or spec.n_clients_default
+    bs = batch_size or spec.batch_size_default
+    sc = lambda n: max(C * 2, int(n * synthetic_scale))
+
+    if dataset == "mnist":
+        try:
+            users, user_data = readers.read_leaf_dir(os.path.join(data_dir or "", "train"))
+            users_te, user_data_te = readers.read_leaf_dir(os.path.join(data_dir, "test"))
+            x_tr, y_tr, idx_map = readers.leaf_to_arrays(users[:C], user_data)
+            x_te, y_te, te_map = readers.leaf_to_arrays(users_te[:C], user_data_te)
+            x_tr = x_tr.reshape(-1, 28 * 28); x_te = x_te.reshape(-1, 28 * 28)
+            synth = False
+        except FileNotFoundError:
+            synth = True
+            x, y = synthetic.synthetic_classification_images(
+                sc(60000), (28, 28), 1, 10, seed=seed, flat=True)
+            n_te = max(C, sc(60000) // 6)
+            x_tr, y_tr, x_te, y_te = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = _partition(y_tr, C, "power_law", partition_alpha, seed)
+            te_map = None
+        return _make(x_tr, y_tr, x_te, y_te, idx_map, bs, 10,
+                     max_batches_per_client, te_map, seed, synthetic=synth)
+
+    if dataset == "femnist":
+        try:
+            h5 = readers.read_tff_h5(os.path.join(data_dir or "", "fed_emnist_train.h5"),
+                                     ("pixels", "label"))
+            h5t = readers.read_tff_h5(os.path.join(data_dir, "fed_emnist_test.h5"),
+                                      ("pixels", "label"))
+            cids = sorted(h5.keys())[:C]
+            xs, ys, idx_map, off = [], [], {}, 0
+            for i, cid in enumerate(cids):
+                px = h5[cid]["pixels"].astype(np.float32)[..., None]
+                lb = h5[cid]["label"].astype(np.int64)
+                xs.append(px); ys.append(lb)
+                idx_map[i] = np.arange(off, off + len(lb)); off += len(lb)
+            x_tr, y_tr = np.concatenate(xs), np.concatenate(ys)
+            xt = np.concatenate([h5t[c]["pixels"].astype(np.float32)[..., None]
+                                 for c in sorted(h5t.keys())[:C]])
+            yt = np.concatenate([h5t[c]["label"].astype(np.int64)
+                                 for c in sorted(h5t.keys())[:C]])
+            te_map = None
+            synth = False
+        except FileNotFoundError:
+            synth = True
+            x, y = synthetic.synthetic_classification_images(
+                sc(80000), (28, 28), 1, 62, seed=seed)
+            n_te = sc(80000) // 8
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = _partition(y_tr, C, "power_law", partition_alpha, seed)
+            te_map = None
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, 62,
+                     max_batches_per_client, te_map, seed, synthetic=synth)
+
+    if dataset == "fed_cifar100":
+        try:
+            h5 = readers.read_tff_h5(os.path.join(data_dir or "", "fed_cifar100_train.h5"),
+                                     ("image", "label"))
+            cids = sorted(h5.keys())[:C]
+            xs, ys, idx_map, off = [], [], {}, 0
+            for i, cid in enumerate(cids):
+                im = h5[cid]["image"].astype(np.float32) / 255.0
+                lb = h5[cid]["label"].astype(np.int64)
+                xs.append(im); ys.append(lb)
+                idx_map[i] = np.arange(off, off + len(lb)); off += len(lb)
+            x_tr, y_tr = np.concatenate(xs), np.concatenate(ys)
+            h5t = readers.read_tff_h5(os.path.join(data_dir, "fed_cifar100_test.h5"),
+                                      ("image", "label"))
+            xt = np.concatenate([h5t[c]["image"].astype(np.float32) / 255.0
+                                 for c in sorted(h5t.keys())])
+            yt = np.concatenate([h5t[c]["label"].astype(np.int64)
+                                 for c in sorted(h5t.keys())])
+            synth = False
+        except FileNotFoundError:
+            synth = True
+            x, y = synthetic.synthetic_classification_images(
+                sc(50000), (32, 32), 3, 100, seed=seed)
+            n_te = sc(50000) // 5
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = _partition(y_tr, C, "hetero", partition_alpha, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, 100,
+                     max_batches_per_client, None, seed, synthetic=synth)
+
+    if dataset in ("shakespeare", "fed_shakespeare"):
+        seq_len, vocab = 80, 90
+        x, y = synthetic.synthetic_sequences(sc(16000), seq_len, vocab, seed=seed)
+        n_te = sc(16000) // 8
+        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+        idx_map = partition_homo(len(y_tr), C, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, vocab,
+                     max_batches_per_client, None, seed, synthetic=True)
+
+    if dataset == "stackoverflow_nwp":
+        seq_len, vocab = 20, 10004
+        x, y = synthetic.synthetic_sequences(sc(20000), seq_len, vocab, seed=seed)
+        n_te = sc(20000) // 8
+        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+        idx_map = partition_homo(len(y_tr), C, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, vocab,
+                     max_batches_per_client, None, seed, synthetic=True)
+
+    if dataset == "stackoverflow_lr":
+        dim, n_tags = 10000, 500
+        x, y = synthetic.synthetic_multilabel(sc(20000), dim, n_tags, seed=seed)
+        n_te = sc(20000) // 8
+        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+        idx_map = partition_homo(len(y_tr), C, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, n_tags,
+                     max_batches_per_client, None, seed, synthetic=True)
+
+    if dataset in ("cifar10", "cifar100", "cinic10"):
+        n_classes = 100 if dataset == "cifar100" else 10
+        mean, std = ((CIFAR100_MEAN, CIFAR100_STD) if dataset == "cifar100"
+                     else (CIFAR10_MEAN, CIFAR10_STD))
+        try:
+            if dataset == "cinic10":
+                x_tr, y_tr, xt, yt = readers.read_image_folder(data_dir)
+            else:
+                sub = {"cifar10": "cifar-10-batches-py",
+                       "cifar100": "cifar-100-python"}[dataset]
+                x_tr, y_tr, xt, yt = readers.read_cifar_pickles(
+                    os.path.join(data_dir, sub),
+                    cifar100=(dataset == "cifar100"))
+            x_tr = readers.normalize_image(x_tr, mean, std)
+            xt = readers.normalize_image(xt, mean, std)
+            synth = False
+        except FileNotFoundError:
+            synth = True
+            n = sc(50000 if dataset != "cinic10" else 90000)
+            x, y = synthetic.synthetic_classification_images(
+                n, (32, 32), 3, n_classes, seed=seed)
+            n_te = n // 5
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+        idx_map = _partition(y_tr, C, partition_method, partition_alpha, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, n_classes,
+                     max_batches_per_client, None, seed, synthetic=synth)
+
+    if dataset.startswith("synthetic_"):
+        ab = dataset.split("_")[1:]
+        alpha, beta = float(ab[0]), float(ab[1])
+        x, y, idx_map = synthetic.synthetic_fedprox(alpha, beta, C, seed=seed)
+        n = len(y)
+        # 90/10 train/test split inside each client, reference-style
+        tr_map, te_idx = {}, []
+        for k, idx in idx_map.items():
+            cut = max(1, int(0.9 * len(idx)))
+            tr_map[k] = idx[:cut]; te_idx.append(idx[cut:])
+        te_idx = np.concatenate(te_idx)
+        return _make(x, y, x[te_idx], y[te_idx], tr_map, bs, 10,
+                     max_batches_per_client, None, seed)
+
+    raise ValueError(f"unknown dataset {dataset!r}")

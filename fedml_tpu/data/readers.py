@@ -1,0 +1,120 @@
+"""On-disk format readers for the real federated datasets.
+
+Parity with reference fedml_api/data_preprocessing/*:
+  - LEAF JSON  (MNIST/data_loader.py:9-49, shakespeare): dirs of
+    ``{"users": [...], "user_data": {uid: {"x": ..., "y": ...}}}``
+  - TFF HDF5   (FederatedEMNIST, fed_cifar100, fed_shakespeare,
+    stackoverflow_*): ``examples/<client_id>/<feature>`` groups
+  - CIFAR python pickles (cifar10/100); CINIC-10 image folders
+    (read_image_folder, requires PIL only when files are present).
+
+All readers return host numpy; partitioning metadata comes from the file's
+natural per-user split. Missing files raise FileNotFoundError — the loader
+layer catches it and substitutes the synthetic stand-in.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def read_leaf_dir(data_dir: str) -> tuple[list[str], dict]:
+    """Read every *.json in a LEAF split dir; returns (users, user_data)."""
+    if not os.path.isdir(data_dir):
+        raise FileNotFoundError(data_dir)
+    users, user_data = [], {}
+    files = sorted(f for f in os.listdir(data_dir) if f.endswith(".json"))
+    if not files:
+        raise FileNotFoundError(f"no LEAF json in {data_dir}")
+    for f in files:
+        with open(os.path.join(data_dir, f)) as fh:
+            blob = json.load(fh)
+        users.extend(blob["users"])
+        user_data.update(blob["user_data"])
+    return users, user_data
+
+
+def leaf_to_arrays(users: list[str], user_data: dict,
+                   xform: Optional[Callable] = None):
+    """Flatten LEAF per-user data to (x, y, idx_map)."""
+    xs, ys, idx_map, off = [], [], {}, 0
+    for i, u in enumerate(users):
+        ux = np.asarray(user_data[u]["x"], np.float32)
+        uy = np.asarray(user_data[u]["y"], np.int64)
+        if xform is not None:
+            ux, uy = xform(ux, uy)
+        xs.append(ux); ys.append(uy)
+        idx_map[i] = np.arange(off, off + len(uy))
+        off += len(uy)
+    return np.concatenate(xs), np.concatenate(ys), idx_map
+
+
+def read_tff_h5(path: str, feature_keys: tuple[str, ...]):
+    """Read a TFF-style h5: returns {client_id: {key: np.ndarray}}."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    import h5py  # after the existence check: absent file must fall back
+                 # to synthetic even when h5py isn't installed
+    out = {}
+    with h5py.File(path, "r") as f:
+        ex = f["examples"]
+        for cid in ex.keys():
+            out[cid] = {k: np.asarray(ex[cid][k]) for k in feature_keys}
+    return out
+
+
+def read_cifar_pickles(data_dir: str, cifar100: bool = False):
+    """CIFAR-10/100 python-version pickles -> (x_train, y_train, x_test,
+    y_test) in NHWC float32 [0,1]."""
+    if cifar100:
+        tf, sf, lk = ["train"], "test", b"fine_labels"
+    else:
+        tf = [f"data_batch_{i}" for i in range(1, 6)]
+        sf, lk = "test_batch", b"labels"
+    def _load(name):
+        p = os.path.join(data_dir, name)
+        if not os.path.isfile(p):
+            raise FileNotFoundError(p)
+        with open(p, "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x.astype(np.float32) / 255.0, np.asarray(d[lk], np.int64)
+    parts = [_load(n) for n in tf]
+    x_tr = np.concatenate([p[0] for p in parts])
+    y_tr = np.concatenate([p[1] for p in parts])
+    x_te, y_te = _load(sf)
+    return x_tr, y_tr, x_te, y_te
+
+
+def normalize_image(x: np.ndarray, mean, std) -> np.ndarray:
+    return (x - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+
+
+def read_image_folder(data_dir: str, splits=("train", "test"),
+                      max_per_class: Optional[int] = None):
+    """CINIC-10-style image folders: <split>/<class_name>/*.png.
+    Returns (x_train, y_train, x_test, y_test) NHWC float32 in [0,1]."""
+    if not os.path.isdir(os.path.join(data_dir, splits[0])):
+        raise FileNotFoundError(os.path.join(data_dir, splits[0]))
+    from PIL import Image  # after existence check (same fallback contract
+                           # as read_tff_h5)
+    out = []
+    for split in splits:
+        root = os.path.join(data_dir, split)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        xs, ys = [], []
+        for ci, cname in enumerate(classes):
+            files = sorted(os.listdir(os.path.join(root, cname)))
+            if max_per_class:
+                files = files[:max_per_class]
+            for f in files:
+                with Image.open(os.path.join(root, cname, f)) as im:
+                    xs.append(np.asarray(im.convert("RGB"), np.float32) / 255.0)
+                ys.append(ci)
+        out += [np.stack(xs), np.asarray(ys, np.int64)]
+    return tuple(out)

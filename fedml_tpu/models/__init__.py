@@ -1,0 +1,43 @@
+"""Flax model zoo — TPU-native rebuild of reference fedml_api/model/ (§2.6).
+
+`create_model(model_name, output_dim, **kw)` mirrors the reference's factory
+(fedml_experiments/distributed/fedavg/main_fedavg.py:359-394).
+"""
+from __future__ import annotations
+
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.models.cnn import CNNOriginalFedAvg, CNNDropOut
+from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
+from fedml_tpu.models.resnet_gn import ResNet18GN
+from fedml_tpu.models.resnet_cifar import resnet20, resnet32, resnet44, resnet56
+from fedml_tpu.models.mobilenet import MobileNetV1
+from fedml_tpu.models.vgg import VGG11, VGG16
+
+
+def create_model(model_name: str, output_dim: int, input_dim: int | None = None,
+                 **kw):
+    """Model factory keyed by the reference's --model names."""
+    name = model_name.lower()
+    if name == "lr":
+        return LogisticRegression(num_classes=output_dim, flatten=True)
+    if name == "cnn":
+        return CNNOriginalFedAvg(num_classes=output_dim, **kw)
+    if name == "cnn_dropout":
+        return CNNDropOut(num_classes=output_dim, **kw)
+    if name == "rnn":
+        return RNNOriginalFedAvg(vocab_size=kw.pop("vocab_size", 90), **kw)
+    if name == "rnn_stackoverflow":
+        return RNNStackOverflow(**kw)
+    if name in ("resnet18_gn", "resnet18"):
+        return ResNet18GN(num_classes=output_dim, **kw)
+    if name == "resnet56":
+        return resnet56(num_classes=output_dim, **kw)
+    if name == "resnet20":
+        return resnet20(num_classes=output_dim, **kw)
+    if name == "mobilenet":
+        return MobileNetV1(num_classes=output_dim, **kw)
+    if name in ("vgg11",):
+        return VGG11(num_classes=output_dim, **kw)
+    if name in ("vgg16",):
+        return VGG16(num_classes=output_dim, **kw)
+    raise ValueError(f"unknown model {model_name!r}")

@@ -1,0 +1,53 @@
+"""FedAvg-paper CNNs (reference fedml_api/model/cv/cnn.py).
+
+CNNOriginalFedAvg: 2x(conv5x5 + maxpool) + fc512 + softmax head — the 1.66M
+parameter model of McMahan et al. used for FEMNIST (cnn.py:4-70).
+CNNDropOut: the dropout variant (cnn.py:73-142).
+
+Inputs are NHWC (TPU-native layout; the reference is NCHW torch).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNOriginalFedAvg(nn.Module):
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512)(x)
+        x = nn.relu(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+
+
+class CNNDropOut(nn.Module):
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)

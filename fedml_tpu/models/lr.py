@@ -1,0 +1,21 @@
+"""Logistic regression (reference fedml_api/model/linear/lr.py:1-11).
+
+The reference applies sigmoid(linear) and trains with CrossEntropyLoss; the
+TPU-native version emits raw logits and lets the loss own the nonlinearity
+(numerically better, and XLA fuses it into the matmul's epilogue on the MXU).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    num_classes: int
+    flatten: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.flatten:
+            x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,52 @@
+"""MobileNetV1 (reference fedml_api/model/cv/mobilenet.py, 209 LoC torch).
+
+Depthwise-separable conv stacks; CIFAR-sized stem (3x3 s1) rather than the
+ImageNet 224 stem, matching the reference's cross-silo CIFAR usage
+(benchmark/README.md:108-110).  Depthwise = Conv with
+feature_group_count=channels, which XLA lowers to efficient TPU convolutions.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DepthwiseSeparable(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5)
+        c_in = x.shape[-1]
+        x = nn.Conv(c_in, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", feature_group_count=c_in, use_bias=False)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        x = norm()(x)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    num_classes: int = 10
+    alpha: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def c(f):
+            return max(8, int(f * self.alpha))
+        x = nn.Conv(c(32), (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5)(x)
+        x = nn.relu(x)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        for filters, strides in cfg:
+            x = DepthwiseSeparable(c(filters), strides)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,71 @@
+"""CIFAR ResNets (reference fedml_api/model/cv/resnet.py — resnet20..56).
+
+3 stages x n BasicBlocks at 16/32/64 channels with BatchNorm, the classic
+CIFAR family (resnet56 = n=9 used by the cross-silo benchmarks,
+benchmark/README.md:105-107).  BatchNorm running statistics live in the
+`batch_stats` collection; FedAvg averages them along with params, exactly as
+the reference averages every state_dict key (FedAVGAggregator.py:74-81).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False)(x)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetCIFAR(nn.Module):
+    n_per_stage: int = 9
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5)(x)
+        x = nn.relu(x)
+        for i, filters in enumerate((16, 32, 64)):
+            for j in range(self.n_per_stage):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BasicBlock(filters, strides)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet20(num_classes: int = 10, **kw):
+    return ResNetCIFAR(n_per_stage=3, num_classes=num_classes, **kw)
+
+
+def resnet32(num_classes: int = 10, **kw):
+    return ResNetCIFAR(n_per_stage=5, num_classes=num_classes, **kw)
+
+
+def resnet44(num_classes: int = 10, **kw):
+    return ResNetCIFAR(n_per_stage=7, num_classes=num_classes, **kw)
+
+
+def resnet56(num_classes: int = 10, **kw):
+    return ResNetCIFAR(n_per_stage=9, num_classes=num_classes, **kw)

@@ -1,0 +1,40 @@
+"""Character/word LSTMs (reference fedml_api/model/nlp/rnn.py).
+
+RNNOriginalFedAvg (rnn.py:4-36): embed(vocab 90 -> 8) + 2xLSTM(256) + dense,
+used for shakespeare / fed_shakespeare next-char prediction.
+RNNStackOverflow (rnn.py:39-70): embed(10004 -> 96) + LSTM(670) + dense(96)
++ dense(vocab), used for stackoverflow next-word prediction.
+
+Both return per-position logits [B, T, vocab]; the loss masks padding.
+`lax.scan`-based nn.RNN keeps the step function static for XLA.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    vocab_size: int = 10004        # 10000 words + pad/bos/eos/oov
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

@@ -1,0 +1,38 @@
+"""VGG (reference fedml_api/model/cv/vgg.py, 158 LoC torch)."""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFGS = {
+    "vgg11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    cfg_name: str = "vgg11"
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for v in _CFGS[self.cfg_name]:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME")(x)
+                x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(512)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def VGG11(num_classes: int = 10, **kw):
+    return VGG(cfg_name="vgg11", num_classes=num_classes, **kw)
+
+
+def VGG16(num_classes: int = 10, **kw):
+    return VGG(cfg_name="vgg16", num_classes=num_classes, **kw)

@@ -1,0 +1,1 @@
+from fedml_tpu.utils.config import FedConfig

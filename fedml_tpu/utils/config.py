@@ -1,0 +1,54 @@
+"""Unified run configuration.
+
+The reference scatters ~20 argparse flags per entry point plus three sidecar
+files (gpu_mapping.yaml, grpc_ipconfig.csv, trpc_master_config.csv —
+SURVEY.md §5).  Here one dataclass covers the canonical flag set
+(main_fedavg.py:46-135) and is consumed by every algorithm and entry point;
+`from_args` adapts an argparse namespace.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class FedConfig:
+    # task
+    model: str = "lr"
+    dataset: str = "mnist"
+    data_dir: Optional[str] = None
+    partition_method: str = "hetero"
+    partition_alpha: float = 0.5
+    # federation
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+    comm_round: int = 10
+    epochs: int = 1                      # local epochs E
+    batch_size: int = 10
+    # client optimizer
+    client_optimizer: str = "sgd"
+    lr: float = 0.03
+    momentum: float = 0.0
+    wd: float = 0.0
+    # server optimizer (FedOpt)
+    server_optimizer: str = "sgd"
+    server_lr: float = 1.0
+    server_momentum: float = 0.0
+    # fedprox
+    prox_mu: float = 0.0
+    # robust aggregation
+    norm_bound: float = 5.0
+    stddev: float = 0.0
+    # eval cadence
+    frequency_of_the_test: int = 5
+    # misc
+    seed: int = 0
+    max_batches_per_client: Optional[int] = None
+    synthetic_scale: float = 1.0
+    ci: bool = False
+
+    @classmethod
+    def from_args(cls, args) -> "FedConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in vars(args).items() if k in known})

@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU mesh so multi-device sharding
+is testable without TPU hardware (SURVEY.md §4 implication).
+
+The environment may register an out-of-tree TPU-tunnel PJRT plugin via
+sitecustomize that (a) overrides jax_platforms and (b) blocks at backend
+init when the tunnel is unavailable.  Tests must never depend on that
+hardware path, so we force the CPU platform and drop any non-CPU backend
+factories before the first backend initialization.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env vars)
+
+# The sitecustomize hook force-sets jax_platforms="axon,cpu"; pin it back so
+# backends() never initializes the (possibly unreachable) tunnel backend.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,161 @@
+"""Unit tests for fedml_tpu.core (pytree ops, partitioners, sampling,
+topology, robust primitives)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core import (
+    ClientSampler, SymmetricTopologyManager, AsymmetricTopologyManager,
+    partition_dirichlet, partition_homo, partition_power_law,
+    record_data_stats, tree_l2_norm, tree_stack, tree_unstack,
+    tree_weighted_mean, norm_diff_clip, add_weak_dp_noise,
+)
+from fedml_tpu.core.pytree import vectorize_weights, unvectorize_weights
+from fedml_tpu.core.robust import coordinate_median, krum_select, trimmed_mean
+
+
+def _tree(seed=0, scale=1.0):
+    r = np.random.RandomState(seed)
+    return {"w": jnp.asarray(r.randn(4, 3) * scale, jnp.float32),
+            "b": jnp.asarray(r.randn(3) * scale, jnp.float32)}
+
+
+class TestPytree:
+    def test_weighted_mean_matches_manual(self):
+        trees = [_tree(i) for i in range(3)]
+        w = jnp.asarray([1.0, 2.0, 3.0])
+        got = tree_weighted_mean(tree_stack(trees), w)
+        wn = np.array([1, 2, 3]) / 6.0
+        want_w = sum(wn[i] * np.asarray(trees[i]["w"]) for i in range(3))
+        np.testing.assert_allclose(got["w"], want_w, rtol=1e-6)
+
+    def test_equal_weights_is_plain_mean(self):
+        trees = [_tree(i) for i in range(4)]
+        got = tree_weighted_mean(tree_stack(trees), jnp.ones(4))
+        want = np.mean([np.asarray(t["b"]) for t in trees], axis=0)
+        np.testing.assert_allclose(got["b"], want, rtol=1e-6)
+
+    def test_stack_unstack_roundtrip(self):
+        trees = [_tree(i) for i in range(3)]
+        back = tree_unstack(tree_stack(trees))
+        for a, b in zip(trees, back):
+            np.testing.assert_array_equal(a["w"], b["w"])
+
+    def test_vectorize_roundtrip(self):
+        t = _tree(5)
+        v = vectorize_weights(t)
+        assert v.shape == (4 * 3 + 3,)
+        back = unvectorize_weights(v, t)
+        np.testing.assert_array_equal(back["w"], t["w"])
+
+    def test_l2_norm(self):
+        t = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+        assert float(tree_l2_norm(t)) == pytest.approx(5.0)
+
+
+class TestPartition:
+    def test_homo_covers_all(self):
+        m = partition_homo(103, 7, seed=1)
+        allidx = np.sort(np.concatenate(list(m.values())))
+        np.testing.assert_array_equal(allidx, np.arange(103))
+
+    def test_dirichlet_min_size_and_coverage(self):
+        y = np.random.RandomState(0).randint(0, 10, 2000)
+        m = partition_dirichlet(y, 8, alpha=0.5, seed=0)
+        assert len(m) == 8
+        sizes = [len(v) for v in m.values()]
+        assert min(sizes) >= 10
+        allidx = np.sort(np.concatenate(list(m.values())))
+        np.testing.assert_array_equal(allidx, np.arange(2000))
+
+    def test_dirichlet_skews_more_with_small_alpha(self):
+        y = np.random.RandomState(0).randint(0, 10, 5000)
+        stats_lo = record_data_stats(y, partition_dirichlet(y, 10, 0.1, seed=0))
+        stats_hi = record_data_stats(y, partition_dirichlet(y, 10, 100.0, seed=0))
+        def mean_nclasses(stats):
+            return np.mean([len(v) for v in stats.values()])
+        assert mean_nclasses(stats_lo) < mean_nclasses(stats_hi)
+
+    def test_power_law_sizes_spread(self):
+        y = np.random.RandomState(0).randint(0, 10, 5000)
+        m = partition_power_law(y, 20, seed=0)
+        sizes = np.array([len(v) for v in m.values()])
+        assert sizes.min() >= 10 and sizes.max() > 2 * sizes.min()
+
+
+class TestSampler:
+    def test_matches_reference_numpy_semantics(self):
+        s = ClientSampler(100, 10)
+        got = s.sample(7)
+        np.random.seed(7)
+        want = np.random.choice(range(100), 10, replace=False)
+        np.testing.assert_array_equal(got, want)
+
+    def test_full_participation_identity(self):
+        s = ClientSampler(10, 10)
+        np.testing.assert_array_equal(s.sample(3), np.arange(10))
+
+    def test_deterministic_per_round(self):
+        s = ClientSampler(50, 5)
+        np.testing.assert_array_equal(s.sample(3), s.sample(3))
+        assert not np.array_equal(s.sample(3), s.sample(4))
+
+
+class TestTopology:
+    def test_symmetric_rows_normalized(self):
+        tm = SymmetricTopologyManager(8, neighbor_num=4, seed=0)
+        np.testing.assert_allclose(tm.topology.sum(axis=1), np.ones(8), rtol=1e-6)
+        np.testing.assert_allclose((tm.topology > 0), (tm.topology > 0).T)
+
+    def test_neighbors(self):
+        tm = SymmetricTopologyManager(6, neighbor_num=2, seed=0)
+        assert 1 in tm.get_out_neighbor_idx_list(0)
+        assert 5 in tm.get_out_neighbor_idx_list(0)
+
+    def test_asymmetric_keeps_ring(self):
+        tm = AsymmetricTopologyManager(8, neighbor_num=4, deleted_ratio=0.5, seed=0)
+        np.testing.assert_allclose(tm.topology.sum(axis=1), np.ones(8), rtol=1e-6)
+        for i in range(8):
+            assert tm.topology[i, (i + 1) % 8] > 0
+
+
+class TestRobust:
+    def test_norm_clip_noop_within_bound(self):
+        g, l = _tree(0), _tree(0)
+        out = norm_diff_clip(l, g, 1.0)
+        np.testing.assert_allclose(out["w"], l["w"], rtol=1e-6)
+
+    def test_norm_clip_clips(self):
+        g = _tree(0)
+        l = jax.tree.map(lambda x: x + 100.0, g)
+        out = norm_diff_clip(l, g, 1.0)
+        diff = jax.tree.map(lambda a, b: a - b, out, g)
+        assert float(tree_l2_norm(diff)) == pytest.approx(1.0, rel=1e-4)
+
+    def test_weak_dp_noise_scale(self):
+        t = {"w": jnp.zeros((1000,))}
+        out = add_weak_dp_noise(t, jax.random.PRNGKey(0), 0.1)
+        assert 0.05 < float(jnp.std(out["w"])) < 0.2
+
+    def test_krum_rejects_outlier(self):
+        good = [_tree(i, scale=0.01) for i in range(4)]
+        bad = jax.tree.map(lambda x: x + 50.0, _tree(9, scale=0.01))
+        stacked = tree_stack(good + [bad])
+        assert int(krum_select(stacked, n_byzantine=1)) != 4
+
+    def test_krum_rejects_outlier_at_slot_zero(self):
+        # regression: NaN-poisoned distances made argmin always return 0
+        bad = jax.tree.map(lambda x: x + 50.0, _tree(9, scale=0.01))
+        good = [_tree(i, scale=0.01) for i in range(4)]
+        stacked = tree_stack([bad] + good)
+        assert int(krum_select(stacked, n_byzantine=1)) != 0
+
+    def test_median_and_trimmed_mean_reject_outlier(self):
+        good = [_tree(0, scale=0.0) for _ in range(4)]
+        bad = jax.tree.map(lambda x: x + 1000.0, _tree(0, scale=0.0))
+        stacked = tree_stack(good + [bad])
+        med = coordinate_median(stacked)
+        assert float(jnp.max(jnp.abs(med["w"]))) < 1.0
+        tm = trimmed_mean(stacked, 1)
+        assert float(jnp.max(jnp.abs(tm["w"]))) < 1.0

@@ -1,0 +1,148 @@
+"""End-to-end FedAvg tests, including the reference's mathematical
+equivalence oracle (CI-script-fedavg.sh:41-59 / BASELINE.md):
+
+  FedAvg with full participation, full batch, E=1  ==  centralized GD
+  (same accuracy to 3 decimals; here we assert parameter-level closeness,
+  which is stronger).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.centralized import CentralizedTrainer
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.federated import FederatedData, build_client_shards, build_eval_shard
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.models.lr import LogisticRegression
+from fedml_tpu.utils.config import FedConfig
+
+
+def make_uniform_data(n_clients=4, per_client=32, dim=16, classes=4, seed=0):
+    """Equal-sized clients, full-batch shards (one batch per client)."""
+    rng = np.random.RandomState(seed)
+    n = n_clients * per_client
+    w = rng.randn(dim, classes)
+    x = rng.randn(n, dim).astype(np.float32)
+    y = np.argmax(x @ w + 0.3 * rng.randn(n, classes), axis=1).astype(np.int64)
+    idx_map = {i: np.arange(i * per_client, (i + 1) * per_client)
+               for i in range(n_clients)}
+    shards = build_client_shards(x, y, idx_map, per_client)
+    return FederatedData(
+        train_data_num=n, test_data_num=n,
+        train_global=build_eval_shard(x, y, n),
+        test_global=build_eval_shard(x, y, n),
+        client_shards=shards,
+        client_num_samples=np.full(n_clients, per_client, np.float32),
+        test_client_shards=None, class_num=classes), x, y
+
+
+class TestEquivalenceOracle:
+    """FedAvg(full participation, full batch, E=1) == centralized full-batch
+    GD, round for round. With equal client sizes and one full batch each,
+    mean-of-client-gradient-steps == one global gradient step exactly."""
+
+    def test_fedavg_equals_centralized(self):
+        data, x, y = make_uniform_data()
+        cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                        comm_round=5, epochs=1, lr=0.1, batch_size=128)
+        model = LogisticRegression(num_classes=4, flatten=False)
+        t_fed = ClientTrainer(model, lr=0.1)
+        t_cen = ClientTrainer(model, lr=0.1)
+
+        engine = FedAvgEngine(t_fed, data, cfg, donate=False)
+        v0 = engine.init_variables()
+        v_fed = engine.run(variables=jax.tree.map(jnp.copy, v0))
+
+        # centralized: full-batch GD on the union of client data, same #steps
+        cen = CentralizedTrainer(t_cen, data, cfg)
+        v_cen = cen.run(epochs=5, variables=jax.tree.map(jnp.copy, v0))
+
+        fed_acc = engine.evaluate(v_fed)["train_acc"]
+        cen_acc = cen.evaluate(v_cen)["train_acc"]
+        assert round(fed_acc, 3) == round(cen_acc, 3)
+        # parameter-level equivalence (stronger than the reference's oracle)
+        for a, b in zip(jax.tree.leaves(v_fed), jax.tree.leaves(v_cen)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=1e-3)
+
+    def test_weighted_aggregation_unequal_clients(self):
+        """Unequal client sizes: the weighted mean must use true sample
+        counts (padding must not leak into weights or gradients)."""
+        rng = np.random.RandomState(1)
+        x = rng.randn(48, 8).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)
+        idx_map = {0: np.arange(0, 8), 1: np.arange(8, 48)}  # sizes 8 vs 40
+        shards = build_client_shards(x, y, idx_map, 8)
+        data = FederatedData(48, 48, build_eval_shard(x, y, 48),
+                             build_eval_shard(x, y, 48), shards,
+                             np.array([8., 40.], np.float32), None, 2)
+        cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                        comm_round=3, epochs=1, lr=0.5, batch_size=8)
+        model = LogisticRegression(num_classes=2, flatten=False)
+        engine = FedAvgEngine(ClientTrainer(model, lr=0.5), data, cfg)
+        v = engine.run()
+        acc = engine.evaluate(v)["train_acc"]
+        assert acc > 0.85  # learns the separable task
+
+    @pytest.mark.parametrize("opt_kw", [
+        dict(),                                   # plain SGD
+        dict(momentum=0.9, weight_decay=0.01),    # momentum+decay: update
+                                                  # nonzero even at zero grad
+        dict(prox_mu=0.1),                        # prox pulls toward global
+    ])
+    def test_padding_mask_is_noop(self, opt_kw):
+        """A fully-padded batch must be a complete no-op: training with
+        8 real + 8 padded samples == training with just the 8 real ones,
+        even with momentum / weight decay / prox terms."""
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 4).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)
+        model = LogisticRegression(num_classes=2, flatten=False)
+        trainer = ClientTrainer(model, lr=0.3, **opt_kw)
+        v0 = trainer.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+        sh_real = build_client_shards(x, y, {0: np.arange(8)}, 8)
+        sh_pad = build_client_shards(x, y, {0: np.arange(8)}, 8, max_batches=2)
+        # force an extra all-padding batch
+        pad = {k: np.concatenate([v, np.zeros_like(v)], axis=1)
+               for k, v in sh_real.items()}
+        r = jax.random.PRNGKey(1)
+        one = lambda sh: trainer.local_train(
+            jax.tree.map(jnp.copy, v0),
+            jax.tree.map(lambda a: jnp.asarray(a[0]), sh), r, 1,
+            global_params=v0["params"])
+        v_real, _, n_real = one(sh_real)
+        v_pad, _, n_pad = one(pad)
+        assert float(n_real) == float(n_pad) == 8.0
+        for a, b in zip(jax.tree.leaves(v_real), jax.tree.leaves(v_pad)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+class TestFedAvgLearning:
+    def test_mnist_synthetic_reaches_target(self):
+        """BASELINE.md row 1 analogue: standalone FedAvg, LR model, 10
+        sampled clients/round. On the synthetic stand-in task accuracy must
+        clear 75% (the real-MNIST bar)."""
+        data = load_data("mnist", client_num_in_total=50, batch_size=10,
+                         synthetic_scale=0.02, seed=0)
+        cfg = FedConfig(client_num_in_total=50, client_num_per_round=10,
+                        comm_round=20, epochs=1, lr=0.1, batch_size=10,
+                        frequency_of_the_test=100)
+        model = LogisticRegression(num_classes=10, flatten=False)
+        engine = FedAvgEngine(ClientTrainer(model, lr=0.1), data, cfg)
+        v = engine.run()
+        assert engine.evaluate(v)["test_acc"] > 0.75
+
+    def test_deterministic_given_seed(self):
+        data, *_ = make_uniform_data()
+        cfg = FedConfig(client_num_in_total=4, client_num_per_round=2,
+                        comm_round=3, epochs=2, lr=0.1, batch_size=32, seed=3)
+        model = LogisticRegression(num_classes=4, flatten=False)
+        runs = []
+        for _ in range(2):
+            e = FedAvgEngine(ClientTrainer(model, lr=0.1), data, cfg)
+            runs.append(e.run())
+        for a, b in zip(jax.tree.leaves(runs[0]), jax.tree.leaves(runs[1])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
