@@ -1,0 +1,210 @@
+"""FedGKT — group knowledge transfer (split training via distillation).
+
+Parity: fedml_api/distributed/fedgkt/ — the client runs a small CNN and
+uploads per-sample feature maps + logits + labels
+(GKTClientTrainer.py:49-129); the server trains a large CNN on those
+features with CE + KL distillation toward the client logits
+(GKTServerTrainer.py:42-48, 193-291, `KL_Loss(temperature)` in utils.py),
+then returns its own logits per client for the client's next local phase.
+
+TPU-native: client-side local training is a jitted scan (CE + KL to the
+server's last logits); the server-side distillation epoch is a jitted scan
+over every client's uploaded feature batches.  The exchange is arrays, not
+pickled tensors; when clients are remote the same arrays ride the comm
+layer.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.trainer import make_optimizer, masked_accuracy_sums
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.utils.config import FedConfig
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+
+def kl_divergence_loss(student_logits, teacher_logits, mask,
+                       temperature: float = 3.0):
+    """KL(teacher ‖ student) with temperature scaling (fedgkt/utils.py
+    KL_Loss): T² · KL(softmax(t/T) ‖ log_softmax(s/T))."""
+    t = jax.nn.softmax(teacher_logits / temperature, axis=-1)
+    s = jax.nn.log_softmax(student_logits / temperature, axis=-1)
+    per = jnp.sum(t * (jnp.log(jnp.clip(t, 1e-8)) - s), axis=-1)
+    m = mask.astype(per.dtype)
+    return (temperature ** 2) * jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.)
+
+
+class FedGKTEngine:
+    """client_model: x → (features, logits); server_model: features → logits."""
+
+    def __init__(self, client_model, server_model, data: FederatedData,
+                 cfg: FedConfig, temperature: float = 3.0,
+                 server_epochs: int = 1):
+        self.client_model = client_model
+        self.server_model = server_model
+        self.data = data
+        self.cfg = cfg
+        self.temperature = temperature
+        self.server_epochs = server_epochs
+        self.client_tx = make_optimizer(cfg.client_optimizer, cfg.lr,
+                                        cfg.momentum, cfg.wd)
+        self.server_tx = make_optimizer(cfg.server_optimizer, cfg.server_lr,
+                                        cfg.server_momentum)
+        self._client_phase_j = jax.jit(self._client_phase)
+        self._server_phase_j = jax.jit(self._server_phase)
+        self.metrics_history: list[dict] = []
+
+    # -- init ----------------------------------------------------------------
+    def init_params(self, rng: Optional[jax.Array] = None):
+        rng = rng if rng is not None else jax.random.PRNGKey(self.cfg.seed)
+        r1, r2 = jax.random.split(rng)
+        x = jnp.asarray(self.data.client_shards["x"][0, 0])
+        cp = self.client_model.init(r1, x)["params"]
+        feats, _ = self.client_model.apply({"params": cp}, x)
+        sp = self.server_model.init(r2, feats)["params"]
+        return cp, sp
+
+    # -- client phase: local CE + KL(server logits) --------------------------
+    def _client_phase(self, client_params, shard, server_logits):
+        """shard: {x,y,mask}[B,bs,...]; server_logits [B,bs,C] (zeros in
+        round 0 ⇒ pure CE, matching the reference's whether_distill_on_the_
+        client bootstrap)."""
+        opt = self.client_tx.init(client_params)
+
+        def loss_fn(p, batch, slog):
+            feats, logits = self.client_model.apply({"params": p}, batch["x"])
+            m = batch["mask"]
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"])
+            ce = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+            kl = kl_divergence_loss(logits, slog, m, self.temperature)
+            use_kl = jnp.any(jnp.abs(slog) > 0)
+            return ce + jnp.where(use_kl, kl, 0.0)
+
+        def step(carry, inp):
+            p, opt = carry
+            batch, slog = inp
+            loss, g = jax.value_and_grad(loss_fn)(p, batch, slog)
+            has = jnp.sum(batch["mask"]) > 0
+            u, opt2 = self.client_tx.update(g, opt, p)
+            keep = lambda n, o: jax.tree.map(
+                lambda a, b: jnp.where(has, a, b), n, o)
+            return (keep(optax.apply_updates(p, u), p), keep(opt2, opt)), loss
+
+        def epoch(carry, _):
+            carry, losses = jax.lax.scan(step, carry, (shard, server_logits))
+            return carry, losses.mean()
+
+        (p, _), losses = jax.lax.scan(epoch, (client_params, opt), None,
+                                      length=self.cfg.epochs)
+        # upload: features + logits for every sample (extracted_feature_dict /
+        # logits_dict upload, GKTClientTrainer.py:49-129)
+        feats, logits = jax.vmap(
+            lambda b: self.client_model.apply({"params": p}, b))(shard["x"])
+        return p, feats, logits, losses.mean()
+
+    # -- server phase: distill on uploaded features --------------------------
+    def _server_phase(self, server_params, opt_state, feats, logits, ys,
+                      masks):
+        """feats/logits/ys/masks have a leading client axis [K,B,...]; the
+        server's epoch is a scan over the flattened client×batch stream
+        (GKTServerTrainer.train_and_distill_on_server, :193-291)."""
+        K, B = masks.shape[0], masks.shape[1]
+        fl = lambda a: a.reshape((K * B,) + a.shape[2:])
+        stream = (fl(feats), fl(logits), fl(ys), fl(masks))
+
+        def loss_fn(p, f, clog, y, m):
+            slog = self.server_model.apply({"params": p}, f)
+            ce = optax.softmax_cross_entropy_with_integer_labels(slog, y)
+            ce = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+            return ce + kl_divergence_loss(slog, clog, m, self.temperature)
+
+        def step(carry, inp):
+            p, opt = carry
+            f, clog, y, m = inp
+            loss, g = jax.value_and_grad(loss_fn)(p, f, clog, y, m)
+            has = jnp.sum(m) > 0
+            u, opt2 = self.server_tx.update(g, opt, p)
+            keep = lambda n, o: jax.tree.map(
+                lambda a, b: jnp.where(has, a, b), n, o)
+            return (keep(optax.apply_updates(p, u), p), keep(opt2, opt)), loss
+
+        def epoch(carry, _):
+            carry, losses = jax.lax.scan(step, carry, stream)
+            return carry, losses.mean()
+
+        (p, opt_state), losses = jax.lax.scan(
+            epoch, (server_params, opt_state), None,
+            length=self.server_epochs)
+        # per-client server logits for the next client phase
+        slog = jax.vmap(jax.vmap(
+            lambda f: self.server_model.apply({"params": p}, f)))(feats)
+        return p, opt_state, slog, losses.mean()
+
+    # -- driver ---------------------------------------------------------------
+    def run(self, rounds: Optional[int] = None):
+        cfg = self.cfg
+        cp0, sp = self.init_params()
+        C = self.data.client_num
+        client_params = [cp0] * C
+        server_opt = self.server_tx.init(sp)
+        shards, _ = self.data.device_shards()
+        sample_logits = None
+        rounds = rounds if rounds is not None else cfg.comm_round
+        for round_idx in range(rounds):
+            t0 = time.time()
+            feats_l, logits_l, losses = [], [], []
+            for cid in range(C):
+                shard = jax.tree.map(lambda a, c=cid: a[c], shards)
+                if sample_logits is None:
+                    B, bs = shard["mask"].shape
+                    n_cls = self.data.class_num
+                    slog = jnp.zeros((B, bs, n_cls))
+                else:
+                    slog = sample_logits[cid]
+                cp, feats, logits, loss = self._client_phase_j(
+                    client_params[cid], shard, slog)
+                client_params[cid] = cp
+                feats_l.append(feats)
+                logits_l.append(logits)
+                losses.append(float(loss))
+            feats = jnp.stack(feats_l)
+            logits = jnp.stack(logits_l)
+            ys = shards["y"]
+            masks = shards["mask"]
+            sp, server_opt, sample_logits, s_loss = self._server_phase_j(
+                sp, server_opt, feats, logits, ys, masks)
+            if (round_idx % cfg.frequency_of_the_test == 0
+                    or round_idx == rounds - 1):
+                stats = self.evaluate(client_params[0], sp)
+                stats.update(round=round_idx,
+                             client_loss=float(np.mean(losses)),
+                             server_loss=float(s_loss),
+                             round_time=time.time() - t0)
+                self.metrics_history.append(stats)
+                log.info("gkt round %d: %s", round_idx, stats)
+        return client_params, sp
+
+    def evaluate(self, client_params, server_params) -> dict:
+        shard = jax.tree.map(jnp.asarray, self.data.test_global)
+
+        @jax.jit
+        def _eval(cp, sp, shard):
+            def one(batch):
+                f, _ = self.client_model.apply({"params": cp}, batch["x"])
+                logits = self.server_model.apply({"params": sp}, f)
+                return masked_accuracy_sums(logits, batch["y"], batch["mask"])
+            c, n = jax.vmap(one)(shard)
+            return c.sum(), n.sum()
+
+        c, n = _eval(client_params, server_params, shard)
+        return {"test_acc": float(c) / max(float(n), 1.0)}

@@ -1,0 +1,62 @@
+"""FedSeg — federated semantic segmentation.
+
+Parity: fedml_api/distributed/fedseg/ (FedSegAggregator.py:1-240,
+MyModelTrainer.py, utils.py Evaluator) — the FedAvg skeleton with
+pixel-wise CE and IoU/accuracy evaluation via a confusion matrix.
+
+The engine reuses FedAvgEngine wholesale (aggregation is unchanged);
+only evaluation differs: per-class IoU from a jitted confusion matrix
+(core/seg_metrics.py) tracked by an EvaluationMetricsKeeper.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.core.trainer import broadcast_mask
+from fedml_tpu.core.seg_metrics import (EvaluationMetricsKeeper,
+                                        confusion_matrix,
+                                        frequency_weighted_iou, mean_iou,
+                                        pixel_accuracy, pixel_accuracy_class)
+
+log = logging.getLogger(__name__)
+
+
+class FedSegEngine(FedAvgEngine):
+    """FedAvg with segmentation eval. The trainer must be built with
+    has_time_axis=True so the per-sample mask broadcasts over H,W."""
+
+    def __init__(self, trainer, data, cfg, **kw):
+        super().__init__(trainer, data, cfg, **kw)
+        self.metrics_keeper = EvaluationMetricsKeeper()
+        self._cm_fn = jax.jit(self._shard_confusion)
+
+    def _shard_confusion(self, variables, shard):
+        params = variables["params"]
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        C = self.data.class_num
+
+        def one(batch):
+            logits = self.trainer.model.apply(
+                {"params": params, **rest}, batch["x"], train=False)
+            pred = jnp.argmax(logits, axis=-1)
+            m = broadcast_mask(batch["mask"], batch["y"])
+            return confusion_matrix(pred, batch["y"], m, C)
+
+        return jax.vmap(one)(shard).sum(axis=0)
+
+    def evaluate(self, variables) -> dict:
+        out = {}
+        for split, shard in self._eval_shards.items():
+            cm = np.asarray(self._cm_fn(variables, shard))
+            out[f"{split}_acc"] = pixel_accuracy(cm)
+            out[f"{split}_acc_class"] = pixel_accuracy_class(cm)
+            out[f"{split}_mIoU"] = mean_iou(cm)
+            out[f"{split}_FWIoU"] = frequency_weighted_iou(cm)
+        self.metrics_keeper.update(len(self.metrics_history), out)
+        return out

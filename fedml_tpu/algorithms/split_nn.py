@@ -1,0 +1,145 @@
+"""SplitNN — split learning across a client/server model cut.
+
+Parity: fedml_api/distributed/split_nn/ (client.py:24-35, server.py:40-72,
+SplitNNAPI.py) — the client net computes activations, the server net
+computes logits+loss and returns activation gradients; clients take turns
+round-robin (`active_node` rotation, server.py:69-72). The reference
+crosses an MPI process boundary TWICE PER MINIBATCH (SURVEY.md §3.4) — its
+comm stress test.
+
+TPU-native: when both halves live in the mesh, the "split" is structural
+(two flax modules) and the per-batch boundary is function composition under
+one jit — XLA fuses straight through; the activation/gradient round-trip
+costs nothing.  For genuinely remote clients, `SplitNNServerManager` /
+`SplitNNClientManager` (comm/split_messaging.py) carry the same per-batch
+protocol over the message layer.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.trainer import (make_optimizer, masked_accuracy_sums,
+                                    masked_cross_entropy)
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.utils.config import FedConfig
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+
+class SplitNNEngine:
+    """Round-robin split training: client k trains for `epochs` with its
+    lower-net params; the server upper-net params persist and are trained on
+    every client's traffic (the reference's SplitNN semantics)."""
+
+    def __init__(self, client_model, server_model, data: FederatedData,
+                 cfg: FedConfig):
+        self.client_model = client_model
+        self.server_model = server_model
+        self.data = data
+        self.cfg = cfg
+        self.client_tx = make_optimizer(cfg.client_optimizer, cfg.lr,
+                                        cfg.momentum, cfg.wd)
+        self.server_tx = make_optimizer(cfg.client_optimizer, cfg.lr,
+                                        cfg.momentum, cfg.wd)
+        self._fit_client = jax.jit(self._client_phase)
+        self.metrics_history: list[dict] = []
+
+    # -- init ---------------------------------------------------------------
+    def init_params(self, rng: Optional[jax.Array] = None):
+        rng = rng if rng is not None else jax.random.PRNGKey(self.cfg.seed)
+        r1, r2 = jax.random.split(rng)
+        x = jnp.asarray(self.data.client_shards["x"][0, 0])
+        cp = self.client_model.init(r1, x)["params"]
+        acts = self.client_model.apply({"params": cp}, x)
+        sp = self.server_model.init(r2, acts)["params"]
+        return cp, sp
+
+    # -- the split step ------------------------------------------------------
+    def _loss(self, client_params, server_params, batch):
+        # forward crosses the cut: acts = f_client(x); logits = f_server(acts)
+        # (client.py:24-31 'forward_pass' + server.py:40-55). Under jit the
+        # cut is invisible to XLA; grads to BOTH halves come from one
+        # backward pass (the reference ships acts.grad back by hand,
+        # server.py:57-60).
+        acts = self.client_model.apply({"params": client_params}, batch["x"])
+        logits = self.server_model.apply({"params": server_params}, acts)
+        return masked_cross_entropy(logits, batch["y"], batch["mask"])
+
+    def _client_phase(self, client_params, server_params, shard):
+        """One client's `epochs` over its shard; both halves update per
+        batch (scan over batches x epochs)."""
+        c_opt = self.client_tx.init(client_params)
+        s_opt = self.server_tx.init(server_params)
+
+        def batch_step(carry, batch):
+            cp, sp, co, so = carry
+            loss, (cg, sg) = jax.value_and_grad(
+                lambda p: self._loss(p[0], p[1], batch))((cp, sp))
+            has = jnp.sum(batch["mask"]) > 0
+            cu, co2 = self.client_tx.update(cg, co, cp)
+            su, so2 = self.server_tx.update(sg, so, sp)
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(has, n, o), new, old)
+            cp2 = keep(optax.apply_updates(cp, cu), cp)
+            sp2 = keep(optax.apply_updates(sp, su), sp)
+            return (cp2, sp2, keep(co2, co), keep(so2, so)), loss
+
+        def epoch(carry, _):
+            carry, losses = jax.lax.scan(batch_step, carry, shard)
+            return carry, losses.mean()
+
+        (cp, sp, _, _), losses = jax.lax.scan(
+            epoch, (client_params, server_params, c_opt, s_opt), None,
+            length=self.cfg.epochs)
+        return cp, sp, losses.mean()
+
+    # -- driver --------------------------------------------------------------
+    def run(self, rounds: Optional[int] = None):
+        cfg = self.cfg
+        client_params, server_params = self.init_params()
+        # every client keeps its own lower-net weights (not averaged — split
+        # learning semantics, unlike FedAvg)
+        per_client = [client_params] * self.data.client_num
+        rounds = rounds if rounds is not None else cfg.comm_round
+        shards, _ = self.data.device_shards()
+        for round_idx in range(rounds):
+            t0 = time.time()
+            losses = []
+            for cid in range(self.data.client_num):   # active_node rotation
+                shard = jax.tree.map(lambda a, c=cid: a[c], shards)
+                cp, server_params, loss = self._fit_client(
+                    per_client[cid], server_params, shard)
+                per_client[cid] = cp
+                losses.append(float(loss))
+            if (round_idx % cfg.frequency_of_the_test == 0
+                    or round_idx == rounds - 1):
+                stats = self.evaluate(per_client[0], server_params)
+                stats.update(round=round_idx,
+                             train_loss=float(np.mean(losses)),
+                             round_time=time.time() - t0)
+                self.metrics_history.append(stats)
+                log.info("splitnn round %d: %s", round_idx, stats)
+        return per_client, server_params
+
+    def evaluate(self, client_params, server_params) -> dict:
+        shard = jax.tree.map(jnp.asarray, self.data.test_global)
+
+        @jax.jit
+        def _eval(cp, sp, shard):
+            def one(batch):
+                acts = self.client_model.apply({"params": cp}, batch["x"])
+                logits = self.server_model.apply({"params": sp}, acts)
+                return masked_accuracy_sums(logits, batch["y"], batch["mask"])
+            correct, count = jax.vmap(one)(shard)
+            return correct.sum(), count.sum()
+
+        correct, count = _eval(client_params, server_params, shard)
+        return {"test_acc": float(correct) / max(float(count), 1.0)}

@@ -1,0 +1,24 @@
+"""comm — host-side message layer for genuinely-remote participants.
+
+In-mesh federated traffic never touches this package (it's ICI collectives,
+fedml_tpu/parallel/).  This layer exists for the reference's cross-silo /
+edge deployments where clients are separate processes or machines:
+BaseCommunicationManager + Message + Observer
+(fedml_core/distributed/communication/, SURVEY.md §2.1) with pluggable
+backends — in-process (tests/simulation), gRPC (WAN cross-silo), native TCP
+(the C++ transport in fedml_tpu/native/), and MQTT (edge gateway, optional).
+
+Differences from the reference, by design:
+  * no 0.3 s polling loops or killable daemon threads
+    (mpi/com_manager.py:71-78, mpi_send_thread.py:47-53) — backends push
+    into a blocking queue drained by the manager's run loop;
+  * one consistent port scheme (the reference binds 50000+rank but dials
+    8888+rank — grpc_comm_manager.py:41-61 — a bug SURVEY.md flags);
+  * tensors ride a zero-copy binary codec, with the reference's
+    JSON-list mode kept for mobile parity (--is_mobile,
+    fedavg/utils.py:7-16).
+"""
+from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.comm.base import BaseCommManager, Observer
+from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+from fedml_tpu.comm.managers import ClientManager, ServerManager

@@ -1,0 +1,62 @@
+"""Comm abstraction: BaseCommManager + Observer.
+
+Parity: fedml_core/distributed/communication/base_com_manager.py:7-27 and
+observer.py:4-7.  Backends push received Messages into an internal queue;
+`handle_receive_message()` drains it and fans out to observers — a blocking
+get instead of the reference's 0.3 s polling loop
+(mpi/com_manager.py:71-78).
+"""
+from __future__ import annotations
+
+import abc
+import queue
+import threading
+from typing import Optional
+
+from fedml_tpu.comm.message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type, msg_params: Message) -> None: ...
+
+
+class BaseCommManager(abc.ABC):
+    """Backend interface. Concrete backends implement `send_message` and
+    arrange for inbound messages to reach `_on_message` (thread-safe)."""
+
+    def __init__(self):
+        self._observers: list[Observer] = []
+        self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
+        self._running = False
+
+    # -- reference API -------------------------------------------------------
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None: ...
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        """Blocking dispatch loop; returns after stop_receive_message()."""
+        self._running = True
+        while self._running:
+            msg = self._inbox.get()
+            if msg is None:       # sentinel from stop_receive_message
+                break
+            self._notify(msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._inbox.put(None)
+
+    # -- backend-side delivery ----------------------------------------------
+    def _on_message(self, msg: Message) -> None:
+        self._inbox.put(msg)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)

@@ -1,0 +1,50 @@
+"""In-process comm backend — N logical ranks in one process.
+
+The reference fakes multi-node with localhost MPI processes
+(run_fedavg_distributed_pytorch.sh:19-21, SURVEY.md §4.5); here the same
+manager/FSM code runs over an in-memory router, so the full message-driven
+algorithm stack (init → local train → upload → aggregate → sync) is unit
+-testable with zero sockets.  Frames still go through MessageCodec
+encode/decode so the wire path is exercised.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.message import Message, MessageCodec
+
+
+class InProcRouter:
+    """Shared mailbox fabric; one per simulated deployment."""
+
+    def __init__(self, encode: bool = True):
+        self._backends: dict[int, "InProcBackend"] = {}
+        self._lock = threading.Lock()
+        self.encode = encode
+
+    def register(self, rank: int, backend: "InProcBackend") -> None:
+        with self._lock:
+            self._backends[rank] = backend
+
+    def route(self, msg: Message) -> None:
+        if self.encode:   # exercise the wire codec even in-memory
+            msg = MessageCodec.decode(MessageCodec.encode(msg))
+        rank = msg.get_receiver_id()
+        with self._lock:
+            dst = self._backends.get(rank)
+        if dst is None:
+            raise KeyError(f"no backend registered for rank {rank}")
+        dst._on_message(msg)
+
+
+class InProcBackend(BaseCommManager):
+    def __init__(self, rank: int, router: InProcRouter):
+        super().__init__()
+        self.rank = rank
+        self.router = router
+        router.register(rank, self)
+
+    def send_message(self, msg: Message) -> None:
+        self.router.route(msg)

@@ -1,0 +1,107 @@
+"""ClientManager / ServerManager — the message-FSM runtime.
+
+Parity: fedml_core/distributed/client/client_manager.py:14-79 and
+server/server_manager.py:14-74 — select a backend by string, register as
+observer, dispatch inbound messages through a handler dict keyed by message
+type (register_message_receive_handler, client_manager.py:67-68).
+
+Backend strings: "INPROC" (router passed via kwargs), "GRPC", "TCP"
+(native C++ transport), "MQTT".  The reference's "MPI" process model has no
+TPU equivalent by design — in-mesh participants use fedml_tpu/parallel/.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from fedml_tpu.comm.base import BaseCommManager, Observer
+from fedml_tpu.comm.message import Message
+
+log = logging.getLogger(__name__)
+
+
+def _build_backend(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
+    b = backend.upper()
+    if b == "INPROC":
+        from fedml_tpu.comm.inproc import InProcBackend
+        return InProcBackend(rank, kw["router"])
+    if b == "GRPC":
+        from fedml_tpu.comm.grpc_backend import GrpcBackend
+        return GrpcBackend(rank, kw["ip_config"],
+                           base_port=kw.get("base_port", 50000))
+    if b == "TCP":
+        from fedml_tpu.comm.tcp_backend import TcpBackend
+        return TcpBackend(rank, kw["ip_config"],
+                          base_port=kw.get("base_port", 52000))
+    if b == "MQTT":
+        from fedml_tpu.comm.mqtt_backend import MqttBackend
+        return MqttBackend(rank, size, host=kw.get("host", "127.0.0.1"),
+                           port=kw.get("port", 1883))
+    raise ValueError(f"unknown comm backend {backend!r}")
+
+
+class _Manager(Observer):
+    node_type = "generic"
+
+    def __init__(self, rank: int, size: int, backend: str = "INPROC", **kw):
+        self.rank = rank
+        self.size = size
+        self.backend_name = backend
+        self.com_manager = _build_backend(backend, rank, size, **kw)
+        self.com_manager.add_observer(self)
+        self.message_handler_dict: dict[object, Callable[[Message], None]] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    # -- reference API -------------------------------------------------------
+    def register_message_receive_handler(self, msg_type,
+                                         handler: Callable[[Message], None]):
+        self.message_handler_dict[msg_type] = handler
+
+    def receive_message(self, msg_type, msg: Message) -> None:
+        handler = self.message_handler_dict.get(msg_type)
+        if handler is None:
+            log.warning("%s rank %d: no handler for %r", self.node_type,
+                        self.rank, msg_type)
+            return
+        handler(msg)
+
+    def send_message(self, msg: Message) -> None:
+        self.com_manager.send_message(msg)
+
+    def run(self) -> None:
+        """Register handlers then block on the receive loop (the reference's
+        run(), client_manager.py:42-45)."""
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+
+    def run_async(self) -> threading.Thread:
+        """Run the receive loop on a daemon thread (for in-process
+        multi-rank simulations and tests)."""
+        self.register_message_receive_handlers()
+        self._thread = threading.Thread(
+            target=self.com_manager.handle_receive_message, daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def register_message_receive_handlers(self) -> None:
+        """Subclasses register their FSM here."""
+
+    def finish(self) -> None:
+        """Graceful stop — the reference calls MPI.COMM_WORLD.Abort()
+        (client_manager.py:70-79); we just stop the loop and close."""
+        self.com_manager.stop_receive_message()
+        close = getattr(self.com_manager, "close", None)
+        if close is not None:
+            close()
+        if (self._thread is not None
+                and self._thread is not threading.current_thread()):
+            self._thread.join(timeout=10)
+
+
+class ClientManager(_Manager):
+    node_type = "client"
+
+
+class ServerManager(_Manager):
+    node_type = "server"

@@ -1,0 +1,181 @@
+"""Message envelope + wire codecs.
+
+Parity: fedml_core/distributed/communication/message.py:5-74 — a typed
+key→value bag with sender/receiver ids and JSON serialization.  The
+reference JSON-encodes model weights as nested Python lists on the mobile
+path (fedml_api/distributed/fedavg/utils.py:7-16) and pickles state dicts
+through MPI otherwise; here the default codec is a compact self-describing
+binary frame (JSON header + raw little-endian array buffers) that carries
+jax/numpy pytrees zero-copy, and `to_json` keeps the mobile-parity list
+form.
+"""
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Optional
+
+import numpy as np
+
+
+class Message:
+    """Typed message with params; mirrors the reference's constant names."""
+
+    MSG_ARG_KEY_OPERATION = "operation"
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_OPERATION_SEND = "send"
+    MSG_OPERATION_RECEIVE = "receive"
+    MSG_OPERATION_BROADCAST = "broadcast"
+    MSG_OPERATION_REDUCE = "reduce"
+
+    def __init__(self, type: Any = 0, sender_id: int = 0,
+                 receiver_id: int = 0):
+        self.type = type
+        self.sender_id = sender_id
+        self.receiver_id = receiver_id
+        self.msg_params: dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: type,
+            Message.MSG_ARG_KEY_SENDER: sender_id,
+            Message.MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    # -- reference API (message.py:23-61) -----------------------------------
+    def init(self, msg_params):
+        self.msg_params = dict(msg_params)
+        self.type = self.msg_params.get(Message.MSG_ARG_KEY_TYPE)
+        self.sender_id = self.msg_params.get(Message.MSG_ARG_KEY_SENDER, 0)
+        self.receiver_id = self.msg_params.get(Message.MSG_ARG_KEY_RECEIVER, 0)
+        return self
+
+    def get_sender_id(self) -> int:
+        return int(self.msg_params[Message.MSG_ARG_KEY_SENDER])
+
+    def get_receiver_id(self) -> int:
+        return int(self.msg_params[Message.MSG_ARG_KEY_RECEIVER])
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def add(self, key: str, value: Any) -> None:
+        self.add_params(key, value)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_params(self) -> dict:
+        return self.msg_params
+
+    def get_type(self):
+        return self.msg_params[Message.MSG_ARG_KEY_TYPE]
+
+    def to_string(self) -> str:
+        return (f"Message(type={self.type}, sender={self.sender_id}, "
+                f"receiver={self.receiver_id}, "
+                f"keys={sorted(self.msg_params)})")
+
+    __repr__ = to_string
+
+    # -- mobile-parity JSON (lists) -----------------------------------------
+    def to_json(self) -> str:
+        """JSON with ndarray/pytree leaves as nested lists (the reference's
+        --is_mobile transform, fedavg/utils.py:7-16, applied at the
+        envelope instead of per call site)."""
+        def conv(v):
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            if hasattr(v, "__array__") and not isinstance(v, (int, float,
+                                                              bool, str)):
+                return np.asarray(v).tolist()
+            if isinstance(v, dict):
+                return {k: conv(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [conv(x) for x in v]
+            return v
+        return json.dumps({k: conv(v) for k, v in self.msg_params.items()})
+
+    @classmethod
+    def from_json(cls, payload: str) -> "Message":
+        return cls().init(json.loads(payload))
+
+
+class MessageCodec:
+    """Binary wire format: 4-byte header length ‖ JSON header ‖ buffers.
+
+    Pytree leaves that are numpy/jax arrays are flattened into contiguous
+    little-endian buffers referenced from the header by (path, dtype,
+    shape, offset).  Everything else must be JSON-serializable.
+    """
+
+    MAGIC = b"FML1"
+
+    @staticmethod
+    def _flatten(obj, path, arrays, meta):
+        if isinstance(obj, dict):
+            return {k: MessageCodec._flatten(v, f"{path}/{k}", arrays, meta)
+                    for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            out = [MessageCodec._flatten(v, f"{path}/{i}", arrays, meta)
+                   for i, v in enumerate(obj)]
+            return out if isinstance(obj, list) else {"__tuple__": out}
+        if isinstance(obj, np.ndarray) or (
+                hasattr(obj, "__array__")
+                and not isinstance(obj, (int, float, bool, str, bytes))):
+            a = np.ascontiguousarray(np.asarray(obj))
+            ref = len(arrays)
+            arrays.append(a)
+            meta.append({"dtype": str(a.dtype), "shape": list(a.shape)})
+            return {"__array__": ref}
+        if isinstance(obj, (np.integer,)):
+            return int(obj)
+        if isinstance(obj, (np.floating,)):
+            return float(obj)
+        return obj
+
+    @staticmethod
+    def _unflatten(obj, buffers):
+        if isinstance(obj, dict):
+            if "__array__" in obj and len(obj) == 1:
+                return buffers[obj["__array__"]]
+            if "__tuple__" in obj and len(obj) == 1:
+                return tuple(MessageCodec._unflatten(v, buffers)
+                             for v in obj["__tuple__"])
+            return {k: MessageCodec._unflatten(v, buffers)
+                    for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [MessageCodec._unflatten(v, buffers) for v in obj]
+        return obj
+
+    @classmethod
+    def encode(cls, msg: Message) -> bytes:
+        arrays: list[np.ndarray] = []
+        meta: list[dict] = []
+        tree = cls._flatten(msg.msg_params, "", arrays, meta)
+        header = json.dumps({"tree": tree, "arrays": meta}).encode()
+        out = io.BytesIO()
+        out.write(cls.MAGIC)
+        out.write(len(header).to_bytes(8, "little"))
+        out.write(header)
+        for a in arrays:
+            out.write(a.tobytes())
+        return out.getvalue()
+
+    @classmethod
+    def decode(cls, payload: bytes) -> Message:
+        assert payload[:4] == cls.MAGIC, "bad frame magic"
+        hlen = int.from_bytes(payload[4:12], "little")
+        header = json.loads(payload[12:12 + hlen].decode())
+        off = 12 + hlen
+        buffers = []
+        for m in header["arrays"]:
+            dt = np.dtype(m["dtype"])
+            count = int(np.prod(m["shape"], dtype=np.int64)) if m["shape"] else 1
+            nbytes = count * dt.itemsize
+            a = np.frombuffer(payload, dtype=dt, count=count,
+                              offset=off).reshape(m["shape"])
+            buffers.append(a)
+            off += nbytes
+        params = cls._unflatten(header["tree"], buffers)
+        return Message().init(params)

@@ -1,0 +1,57 @@
+"""MQTT comm backend — broker-mediated edge/device transport.
+
+Parity: fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14-126
+(topic scheme: the server publishes `fedml0_<client>` and subscribes
+`fedml_<client>`; clients the mirror image).  Payloads are the Message
+mobile-parity JSON (brokered devices won't speak the binary frame).
+
+paho-mqtt is optional in this image; the backend raises a clear error at
+construction when it (or a broker) is unavailable.
+"""
+from __future__ import annotations
+
+import logging
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.message import Message
+
+log = logging.getLogger(__name__)
+
+_TOPIC_S2C = "fedml0_"     # server → client <id>
+_TOPIC_C2S = "fedml_"      # client <id> → server
+
+
+class MqttBackend(BaseCommManager):
+    def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
+                 port: int = 1883, keepalive: int = 180):
+        super().__init__()
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as e:          # pragma: no cover - env-dependent
+            raise RuntimeError(
+                "MQTT backend requires paho-mqtt, which is not installed in "
+                "this image; use GRPC or TCP for remote participants") from e
+        self.rank = rank
+        self.size = size
+        self._mqtt = mqtt.Client(client_id=f"fedml_tpu_{rank}")
+        self._mqtt.on_message = self._on_mqtt_message
+        self._mqtt.connect(host, port, keepalive)
+        if rank == 0:   # server listens to every client's uplink
+            for cid in range(1, size):
+                self._mqtt.subscribe(_TOPIC_C2S + str(cid))
+        else:
+            self._mqtt.subscribe(_TOPIC_S2C + str(rank))
+        self._mqtt.loop_start()
+
+    def _on_mqtt_message(self, client, userdata, m) -> None:
+        self._on_message(Message.from_json(m.payload.decode()))
+
+    def send_message(self, msg: Message) -> None:
+        receiver = msg.get_receiver_id()
+        topic = (_TOPIC_S2C + str(receiver) if self.rank == 0
+                 else _TOPIC_C2S + str(self.rank))
+        self._mqtt.publish(topic, msg.to_json())
+
+    def close(self) -> None:
+        self._mqtt.loop_stop()
+        self._mqtt.disconnect()

@@ -1,0 +1,96 @@
+"""TCP comm backend — length-prefixed MessageCodec frames over raw sockets.
+
+The lean transport for trusted intra-cluster control traffic (the reference
+covers this niche with Torch-RPC/TensorPipe, trpc_comm_manager.py:26-144 —
+tensor-native, no JSON).  Frame format: 8-byte little-endian length ‖
+MessageCodec bytes.
+
+When the native C++ transport (fedml_tpu/native/) is built, `TcpBackend`
+transparently uses it for the socket loop; this pure-Python path is the
+fallback and the behavioral spec.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Union
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.message import Message, MessageCodec
+
+log = logging.getLogger(__name__)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class TcpBackend(BaseCommManager):
+    def __init__(self, rank: int, ip_config: Union[str, dict],
+                 base_port: int = 52000):
+        super().__init__()
+        from fedml_tpu.comm.grpc_backend import load_ip_config
+        self.rank = rank
+        self.ip_config = load_ip_config(ip_config)
+        self.base_port = base_port
+        self._conns: dict[int, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", base_port + rank))
+        self._listener.listen(64)
+        self._alive = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while self._alive:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._recv_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        try:
+            while self._alive:
+                (length,) = struct.unpack("<Q", _read_exact(conn, 8))
+                payload = _read_exact(conn, length)
+                self._on_message(MessageCodec.decode(payload))
+        except (ConnectionError, OSError):
+            conn.close()
+
+    def _connect(self, receiver: int) -> socket.socket:
+        with self._conn_lock:
+            s = self._conns.get(receiver)
+            if s is None:
+                s = socket.create_connection(
+                    (self.ip_config[receiver], self.base_port + receiver),
+                    timeout=30)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[receiver] = s
+            return s
+
+    def send_message(self, msg: Message) -> None:
+        payload = MessageCodec.encode(msg)
+        sock = self._connect(msg.get_receiver_id())
+        with self._conn_lock:
+            sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+    def close(self) -> None:
+        self._alive = False
+        self._listener.close()
+        with self._conn_lock:
+            for s in self._conns.values():
+                s.close()
+            self._conns.clear()

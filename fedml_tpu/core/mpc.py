@@ -1,0 +1,161 @@
+"""Finite-field MPC primitives for secure aggregation (TurboAggregate).
+
+Parity: fedml_api/distributed/turboaggregate/mpc_function.py — BGW secret
+sharing (:62-108), Lagrange Coded Computing encode/decode (:111-260),
+additive shares (:214-224), and DH-style key agreement (:263-275).
+
+These are *control-plane* host ops on small integers; they stay numpy
+(int64 + Python-int modular inverses), not XLA — the data-plane model math
+stays on TPU and enters/leaves this layer through fixed-point quantization
+(`quantize`/`dequantize`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# A 31-bit prime (reference uses p = 2^31 - 1 style fields); int64 products
+# of two <p residues overflow, so reduce via Python ints / object math where
+# needed. 2147483647 = 2^31 - 1 (Mersenne).
+DEFAULT_PRIME = 2_147_483_647
+
+
+def _mod(a: np.ndarray, p: int) -> np.ndarray:
+    return np.mod(a, p)
+
+
+def modinv(a: int, p: int) -> int:
+    return pow(int(a), p - 2, p)
+
+
+def modmat(A: np.ndarray, B: np.ndarray, p: int) -> np.ndarray:
+    """Modular matrix product with object-int accumulation (no overflow)."""
+    A = A.astype(object)
+    B = B.astype(object)
+    return np.mod(A @ B, p).astype(np.int64)
+
+
+# -- fixed-point bridge ------------------------------------------------------
+
+def quantize(x: np.ndarray, scale: int = 2 ** 16,
+             p: int = DEFAULT_PRIME) -> np.ndarray:
+    """float → field: round(x·scale) mod p, negatives wrap to [p/2, p)."""
+    q = np.round(np.asarray(x, np.float64) * scale).astype(np.int64)
+    return _mod(q, p)
+
+
+def dequantize(q: np.ndarray, scale: int = 2 ** 16,
+               p: int = DEFAULT_PRIME) -> np.ndarray:
+    """field → float, mapping the upper half back to negatives."""
+    q = np.asarray(q, np.int64)
+    signed = np.where(q > p // 2, q - p, q)
+    return signed.astype(np.float64) / scale
+
+
+# -- polynomial secret sharing (BGW) ----------------------------------------
+
+def BGW_encoding(X: np.ndarray, N: int, T: int, p: int = DEFAULT_PRIME,
+                 seed: int | None = None) -> np.ndarray:
+    """Shamir/BGW: share secret array X (field elements) into N shares with
+    threshold T (any T+1 reconstruct). Returns [N, *X.shape]
+    (mpc_function.py:62-83)."""
+    rs = np.random.RandomState(seed)
+    X = np.mod(np.asarray(X, np.int64), p)
+    coeffs = [X] + [rs.randint(0, p, X.shape).astype(np.int64)
+                    for _ in range(T)]
+    alphas = np.arange(1, N + 1, dtype=np.int64)
+    shares = np.empty((N,) + X.shape, np.int64)
+    for i, a in enumerate(alphas):
+        acc = np.zeros(X.shape, dtype=object)
+        apow = 1
+        for c in coeffs:
+            acc = acc + c.astype(object) * apow
+            apow = (apow * int(a)) % p
+        shares[i] = np.mod(acc, p).astype(np.int64)
+    return shares
+
+
+def _lagrange_coeffs_at(targets: np.ndarray, evals: np.ndarray,
+                        p: int) -> np.ndarray:
+    """W[i][j]: weight of eval point j when interpolating at target i."""
+    W = np.empty((len(targets), len(evals)), np.int64)
+    for ti, t in enumerate(targets):
+        for j, aj in enumerate(evals):
+            num, den = 1, 1
+            for m, am in enumerate(evals):
+                if m == j:
+                    continue
+                num = (num * ((int(t) - int(am)) % p)) % p
+                den = (den * ((int(aj) - int(am)) % p)) % p
+            W[ti, j] = (num * modinv(den, p)) % p
+    return W
+
+
+def BGW_decoding(shares: np.ndarray, worker_idx: np.ndarray,
+                 p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Reconstruct the secret from ≥T+1 shares (rows of `shares` correspond
+    to worker indices `worker_idx`, 0-based) — mpc_function.py:86-108."""
+    alphas = np.asarray(worker_idx, np.int64) + 1
+    W = _lagrange_coeffs_at(np.zeros(1, np.int64), alphas, p)[0]
+    flat = shares.reshape(shares.shape[0], -1)
+    out = modmat(W[None, :], flat, p)[0]
+    return out.reshape(shares.shape[1:])
+
+
+# -- Lagrange Coded Computing ------------------------------------------------
+
+def LCC_encoding(X: np.ndarray, N: int, K: int, T: int = 0,
+                 p: int = DEFAULT_PRIME, seed: int | None = None) -> np.ndarray:
+    """Encode K data blocks (leading axis of X, shape [K, ...]) into N coded
+    blocks via Lagrange interpolation through betas 1..K(+T random pads),
+    evaluated at alphas K+T+1..K+T+N (mpc_function.py:111-170).  With T>0,
+    T uniformly-random pad blocks give T-privacy."""
+    rs = np.random.RandomState(seed)
+    X = np.mod(np.asarray(X, np.int64), p)
+    K_, rest = X.shape[0], X.shape[1:]
+    assert K_ == K
+    if T > 0:
+        pads = rs.randint(0, p, (T,) + rest).astype(np.int64)
+        X = np.concatenate([X, pads], axis=0)
+    betas = np.arange(1, K + T + 1, dtype=np.int64)
+    alphas = np.arange(K + T + 1, K + T + N + 1, dtype=np.int64)
+    W = _lagrange_coeffs_at(alphas, betas, p)         # [N, K+T]
+    flat = X.reshape(K + T, -1)
+    out = modmat(W, flat, p)
+    return out.reshape((N,) + rest)
+
+
+def LCC_decoding(coded: np.ndarray, worker_idx: np.ndarray, N: int, K: int,
+                 T: int = 0, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Recover the K data blocks from any K+T coded blocks
+    (mpc_function.py:173-213)."""
+    alphas_all = np.arange(K + T + 1, K + T + N + 1, dtype=np.int64)
+    evals = alphas_all[np.asarray(worker_idx)]
+    betas = np.arange(1, K + T + 1, dtype=np.int64)
+    W = _lagrange_coeffs_at(betas, evals, p)          # [K+T, len(idx)]
+    flat = coded.reshape(coded.shape[0], -1)
+    out = modmat(W, flat, p)
+    return out.reshape((K + T,) + coded.shape[1:])[:K]
+
+
+# -- additive sharing + key agreement ----------------------------------------
+
+def additive_shares(X: np.ndarray, N: int, p: int = DEFAULT_PRIME,
+                    seed: int | None = None) -> np.ndarray:
+    """Split X into N uniformly-random shares summing to X mod p
+    (mpc_function.py:214-224)."""
+    rs = np.random.RandomState(seed)
+    X = np.mod(np.asarray(X, np.int64), p)
+    shares = rs.randint(0, p, (N - 1,) + X.shape).astype(np.int64)
+    last = np.mod(X.astype(object) - shares.astype(object).sum(axis=0),
+                  p).astype(np.int64)
+    return np.concatenate([shares, last[None]], axis=0)
+
+
+def pk_gen(sk: int, g: int = 5, p: int = DEFAULT_PRIME) -> int:
+    """Diffie-Hellman-style public key g^sk mod p (mpc_function.py:263-269)."""
+    return pow(g, int(sk), p)
+
+
+def shared_key(pk_other: int, sk_self: int, p: int = DEFAULT_PRIME) -> int:
+    """pairwise shared secret pk_other^sk_self mod p (:271-275)."""
+    return pow(int(pk_other), int(sk_self), p)

@@ -1,0 +1,70 @@
+"""Segmentation evaluation metrics — confusion-matrix based.
+
+Parity: fedml_api/distributed/fedseg/utils.py (Evaluator with
+pixel-accuracy / class-accuracy / mIoU / FWIoU) and the per-class metric
+keeper in FedSegAggregator.py:105-186 (`EvaluationMetricsKeeper`).
+
+TPU-native: the confusion matrix is one `jnp.bincount`-style scatter-add
+under jit; metrics derive from it on host.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def confusion_matrix(pred: jnp.ndarray, label: jnp.ndarray,
+                     mask: jnp.ndarray, num_classes: int) -> jnp.ndarray:
+    """[C, C] counts; rows = true class, cols = predicted. Mask-aware."""
+    valid = mask.reshape(-1) > 0
+    idx = label.reshape(-1) * num_classes + pred.reshape(-1)
+    idx = jnp.where(valid, idx, num_classes * num_classes)   # spill bucket
+    counts = jnp.zeros(num_classes * num_classes + 1, jnp.float32)
+    counts = counts.at[idx].add(1.0)
+    return counts[:-1].reshape(num_classes, num_classes)
+
+
+def pixel_accuracy(cm: np.ndarray) -> float:
+    return float(np.diag(cm).sum() / np.maximum(cm.sum(), 1.0))
+
+
+def pixel_accuracy_class(cm: np.ndarray) -> float:
+    per = np.diag(cm) / np.maximum(cm.sum(axis=1), 1.0)
+    return float(np.nanmean(per))
+
+
+def mean_iou(cm: np.ndarray) -> float:
+    inter = np.diag(cm)
+    union = cm.sum(axis=1) + cm.sum(axis=0) - inter
+    iou = inter / np.maximum(union, 1.0)
+    present = cm.sum(axis=1) > 0
+    return float(iou[present].mean()) if present.any() else 0.0
+
+
+def frequency_weighted_iou(cm: np.ndarray) -> float:
+    freq = cm.sum(axis=1) / np.maximum(cm.sum(), 1.0)
+    inter = np.diag(cm)
+    union = cm.sum(axis=1) + cm.sum(axis=0) - inter
+    iou = inter / np.maximum(union, 1.0)
+    return float((freq[freq > 0] * iou[freq > 0]).sum())
+
+
+class EvaluationMetricsKeeper:
+    """Round-indexed best-metric tracker (FedSegAggregator.py:105-186)."""
+
+    def __init__(self):
+        self.history: list[dict] = []
+        self.best: dict[str, float] = {}
+
+    def update(self, round_idx: int, metrics: dict) -> None:
+        entry = dict(metrics, round=round_idx)
+        self.history.append(entry)
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)) and v > self.best.get(k, -np.inf):
+                self.best[k] = float(v)
+
+    def summary(self) -> dict:
+        return {"best": dict(self.best), "rounds": len(self.history)}

@@ -60,6 +60,14 @@ def make_optimizer(name: str, lr: float, momentum: float = 0.0,
     return optax.chain(*txs)
 
 
+def broadcast_mask(mask, target):
+    """Broadcast a per-sample mask over any trailing label axes (sequence
+    time, segmentation H/W): [bs] → target.shape."""
+    if mask.ndim < target.ndim:
+        mask = mask.reshape(mask.shape + (1,) * (target.ndim - mask.ndim))
+    return jnp.broadcast_to(mask, target.shape)
+
+
 def masked_cross_entropy(logits, labels, mask):
     """Mean softmax CE over valid (mask=1) samples. Labels are int class ids;
     if labels has a trailing time axis (NWP models) the mask must match."""
@@ -129,7 +137,7 @@ class ClientTrainer:
                                       rngs=rngs)
             new_rest = rest
         if self.has_time_axis and mask.ndim < y.ndim:
-            mask = jnp.broadcast_to(mask[..., None], y.shape)
+            mask = broadcast_mask(mask, y)
         if self.loss_name == "ce":
             loss = masked_cross_entropy(logits, y, mask)
         elif self.loss_name == "bce":
@@ -196,7 +204,7 @@ class ClientTrainer:
         x, y, mask = batch["x"], batch["y"], batch["mask"]
         logits = self.model.apply({"params": params, **rest}, x, train=False)
         if self.has_time_axis and mask.ndim < y.ndim:
-            mask = jnp.broadcast_to(mask[..., None], y.shape)
+            mask = broadcast_mask(mask, y)
         if self.loss_name == "ce":
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             loss_sum = jnp.sum(ce * mask)

@@ -1,0 +1,27 @@
+"""MNIST GAN (reference fedml_api/model/cv/mnist_gan.py:1-65: a dense
+generator z→784 with tanh and a dense discriminator 784→1) for FedGAN.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    latent_dim: int = 64
+    out_dim: int = 784
+
+    @nn.compact
+    def __call__(self, z):
+        x = nn.relu(nn.Dense(128)(z))
+        x = nn.relu(nn.Dense(256)(x))
+        return jnp.tanh(nn.Dense(self.out_dim)(x))
+
+
+class Discriminator(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.leaky_relu(nn.Dense(256)(x), 0.2)
+        x = nn.leaky_relu(nn.Dense(128)(x), 0.2)
+        return nn.Dense(1)(x)[:, 0]

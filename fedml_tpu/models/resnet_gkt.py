@@ -1,0 +1,76 @@
+"""GKT split ResNet pair (reference fedml_api/model/cv/resnet56_gkt/
+{resnet_client,resnet_server}.py: an 8-layer client net producing 16-channel
+feature maps + local logits, and a 55-layer server net consuming them).
+
+GroupNorm replaces BatchNorm here: the GKT server trains on *uploaded*
+feature batches whose statistics are not the client's data distribution, so
+running-stat BN is both a correctness hazard and a mutable-collection
+complication under jit; GN is the reference's own choice for its federated
+ResNet-18 (resnet_gn.py) and is batch-independent.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class GNBasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    groups: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        norm = partial(nn.GroupNorm, num_groups=self.groups)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3),
+                    strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False)(x)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetClientGKT(nn.Module):
+    """resnet_client.py: conv stem + n_blocks at 16ch; returns
+    (feature_maps [H,W,16], logits) — the client uploads both."""
+    num_classes: int = 10
+    n_blocks: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=2)(x)
+        x = nn.relu(x)
+        for _ in range(self.n_blocks):
+            x = GNBasicBlock(16)(x)
+        feats = x
+        pooled = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(pooled)
+        return feats, logits
+
+
+class ResNetServerGKT(nn.Module):
+    """resnet_server.py: the deep tail (stages at 16/32/64) consuming the
+    client's 16-channel feature maps."""
+    num_classes: int = 10
+    n_per_stage: int = 6
+
+    @nn.compact
+    def __call__(self, feats):
+        x = feats
+        for i, filters in enumerate((16, 32, 64)):
+            for j in range(self.n_per_stage):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = GNBasicBlock(filters, strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

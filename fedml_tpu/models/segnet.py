@@ -1,0 +1,36 @@
+"""Compact encoder-decoder segmentation net (stand-in for the reference's
+DeepLabV3+/MobileNet fedseg backbones — fedml_api/model/cv/ via fedseg).
+
+GroupNorm (batch-independent) keeps the whole model in the params
+collection, so FedAvg/vmap treat it like every other model.  Output:
+per-pixel class logits [B, H, W, C].
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SegEncoderDecoder(nn.Module):
+    num_classes: int = 21
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.width
+        # encoder: /4 spatial
+        e1 = nn.relu(nn.GroupNorm(4)(nn.Conv(w, (3, 3), padding="SAME")(x)))
+        d1 = nn.max_pool(e1, (2, 2), strides=(2, 2))
+        e2 = nn.relu(nn.GroupNorm(4)(nn.Conv(2 * w, (3, 3),
+                                             padding="SAME")(d1)))
+        d2 = nn.max_pool(e2, (2, 2), strides=(2, 2))
+        b = nn.relu(nn.GroupNorm(4)(nn.Conv(4 * w, (3, 3),
+                                            padding="SAME")(d2)))
+        # decoder with skip connections
+        u1 = nn.ConvTranspose(2 * w, (2, 2), strides=(2, 2))(b)
+        u1 = nn.relu(nn.GroupNorm(4)(nn.Conv(2 * w, (3, 3),
+                                             padding="SAME")(u1 + e2)))
+        u2 = nn.ConvTranspose(w, (2, 2), strides=(2, 2))(u1)
+        u2 = nn.relu(nn.GroupNorm(4)(nn.Conv(w, (3, 3),
+                                             padding="SAME")(u2 + e1)))
+        return nn.Conv(self.num_classes, (1, 1))(u2)

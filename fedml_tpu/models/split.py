@@ -1,0 +1,58 @@
+"""Split-network pairs for SplitNN (reference fedml_api/distributed/split_nn
+uses an arbitrary user-provided cut; fedml_experiments feeds it CIFAR CNNs).
+
+`split_mlp` / `split_cnn` return (client_net, server_net): the client half
+maps x → activations at the cut, the server half activations → logits
+(client.py:24-31 / server.py:40-55).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLPLower(nn.Module):
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.relu(nn.Dense(self.hidden)(x))
+
+
+class MLPUpper(nn.Module):
+    num_classes: int = 10
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, acts):
+        x = nn.relu(nn.Dense(self.hidden)(acts))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNLower(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Conv(32, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="SAME")(x))
+        return nn.max_pool(x, (2, 2), strides=(2, 2))
+
+
+class CNNUpper(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, acts):
+        x = acts.reshape((acts.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def split_mlp(num_classes: int = 10, hidden: int = 128):
+    return MLPLower(hidden=hidden), MLPUpper(num_classes=num_classes)
+
+
+def split_cnn(num_classes: int = 10):
+    return CNNLower(), CNNUpper(num_classes=num_classes)

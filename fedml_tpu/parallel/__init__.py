@@ -1,0 +1,24 @@
+"""parallel — the mesh/collective federated engine (L1 of the rebuild).
+
+The reference's "distributed" layer is one OS process per logical client
+exchanging pickled state dicts over MPI point-to-point sends
+(fedml_core/distributed/communication/mpi/com_manager.py:13-98); even its
+server-side aggregation is a Python dict-of-tensors loop on CPU
+(fedml_api/distributed/fedavg/FedAVGAggregator.py:59-88).
+
+TPU-native, clients are a *mesh axis*: per-client datasets live HBM-sharded
+across devices, local SGD runs as vmap-of-scan inside `shard_map`, and the
+sample-weighted FedAvg aggregation is literally
+
+    psum(w_i * n_i) / psum(n_i)
+
+over ICI.  Hierarchical FL maps onto a 2-D mesh — inner `psum` over the
+intra-silo axis (ICI), outer `psum` over the cross-silo axis (DCN) — and
+decentralized gossip is `lax.ppermute` neighbor exchange over a mesh ring.
+"""
+from fedml_tpu.parallel.mesh import (make_mesh, client_sharding,
+                                     replicated_sharding, shard_cohort)
+from fedml_tpu.parallel.engine import (MeshFedAvgEngine, MeshFedOptEngine,
+                                       MeshFedProxEngine, MeshRobustEngine)
+from fedml_tpu.parallel.hierarchical import MeshHierarchicalEngine
+from fedml_tpu.parallel.gossip import MeshGossipEngine

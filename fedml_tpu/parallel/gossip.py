@@ -1,0 +1,153 @@
+"""Decentralized gossip (DSGD) over a mesh ring — serverless FL.
+
+Reference: fedml_api/distributed/decentralized_framework/ (neighbor
+wait-and-advance over a TopologyManager ring) and
+fedml_api/standalone/decentralized/client_dsgd.py (DSGD mixing).  The
+reference moves models between worker processes with MPI point-to-point
+sends; here every mesh device owns one worker's model and the neighbor
+exchange is `lax.ppermute` over the ring — the gossip step
+
+    v_i ← w_self·v_i + w_nbr·(v_{i-1} + v_{i+1})
+
+is two ICI shifts, no host involvement (SURVEY.md §2.5: 'neighbor exchange
+= lax.ppermute over mesh ring').
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.core.topology import SymmetricTopologyManager
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.parallel.mesh import CLIENT_AXIS, make_mesh
+from fedml_tpu.utils.config import FedConfig
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+
+class MeshGossipEngine(FedAvgEngine):
+    """One worker model per mesh shard; ring-gossip mixing each round.
+
+    `neighbor_weight` follows the reference's row-normalized symmetric ring
+    (SymmetricTopologyManager.generate_topology,
+    symmetric_topology_manager.py:21-52): with 2 neighbors each row is
+    [w_nbr, w_self, w_nbr]."""
+
+    def __init__(self, trainer: ClientTrainer, data: FederatedData,
+                 cfg: FedConfig, mesh: Optional[Mesh] = None,
+                 self_weight: float = 1.0 / 3.0, donate: bool = True):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError("gossip requires a 1-D (ring) mesh; got axes "
+                             f"{self.mesh.axis_names}")
+        self.n_shards = int(np.prod(list(self.mesh.shape.values())))
+        self.self_weight = self_weight
+        super().__init__(trainer, data, cfg, donate=donate)
+        # every client is a gossip worker (client_dsgd.py); workers are laid
+        # out contiguously over the mesh ring in blocks of C/n_shards
+        self.n_workers = data.client_num
+        assert self.n_workers % self.n_shards == 0, (
+            f"{self.n_workers} workers over {self.n_shards} shards")
+        self._stack = None
+        self._stack_w = None
+        self.round_fn = jax.jit(self._gossip_round,
+                                donate_argnums=(0,) if donate else ())
+
+    def _device_stack(self):
+        if self._stack is None:
+            sh = NamedSharding(self.mesh, P(self.mesh.axis_names))
+            self._stack = {k: jax.device_put(np.asarray(v), sh)
+                           for k, v in self.data.client_shards.items()}
+            self._stack_w = jax.device_put(
+                np.asarray(self.data.client_num_samples, np.float32), sh)
+        return self._stack, self._stack_w
+
+    def init_worker_variables(self, rng: Optional[jax.Array] = None):
+        """[W, ...] stacked worker models, one per shard (all equal at init)."""
+        v = self.init_variables(rng)
+        stacked = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (self.n_workers,) + a.shape),
+            v)
+        sh = NamedSharding(self.mesh, P(self.mesh.axis_names))
+        return jax.tree.map(lambda a: jax.device_put(a, sh), stacked)
+
+    def _gossip_round(self, worker_vars, stack, stack_w, rng):
+        mesh, axes = self.mesh, self.mesh.axis_names
+        trainer, epochs = self.trainer, self.cfg.epochs
+        W = self.n_workers
+        w_self = self.self_weight
+        w_nbr = (1.0 - w_self) / 2.0
+        sc = P(axes)
+
+        def shard_body(worker_vars, cohort, weights, rngs):
+            # this shard's workers: [w_loc, ...]; each trains on its clients
+            def one(vars_i, shard, crng):
+                v, loss, _ = trainer.local_train(vars_i, shard, crng, epochs)
+                return v, loss
+
+            vs, losses = jax.vmap(one)(worker_vars, cohort, rngs)
+            # ring gossip: shift the whole local block both ways. Within the
+            # block the neighbor is a jnp.roll; across block edges the
+            # wrap-around element comes from the adjacent device (ppermute).
+            n_sh = jax.lax.axis_size(axes[0])
+            perm_fwd = [(i, (i + 1) % n_sh) for i in range(n_sh)]
+            perm_bwd = [(i, (i - 1) % n_sh) for i in range(n_sh)]
+
+            def mix(x):
+                left = jnp.roll(x, 1, axis=0)
+                right = jnp.roll(x, -1, axis=0)
+                if n_sh > 1:
+                    # fix the wrapped entries with cross-device edges
+                    from_prev = jax.lax.ppermute(x[-1], axes[0], perm_fwd)
+                    from_next = jax.lax.ppermute(x[0], axes[0], perm_bwd)
+                    left = left.at[0].set(from_prev)
+                    right = right.at[-1].set(from_next)
+                return w_self * x + w_nbr * (left + right)
+
+            mixed = jax.tree.map(
+                lambda x: mix(x.astype(jnp.float32)).astype(x.dtype), vs)
+            den = jax.lax.psum(jnp.sum(weights), axes)
+            loss = jax.lax.psum(jnp.sum(losses * weights), axes) / den
+            return mixed, loss
+
+        stack_rngs = jax.random.split(rng, W)
+        new_vars, train_loss = jax.shard_map(
+            shard_body, mesh=mesh, in_specs=(sc, sc, sc, sc),
+            out_specs=(sc, P()))(worker_vars, stack, stack_w, stack_rngs)
+        return new_vars, {"train_loss": train_loss}
+
+    def consensus_variables(self, worker_vars):
+        """Uniform average of all worker models (for evaluation)."""
+        return jax.tree.map(lambda a: jnp.mean(a.astype(jnp.float32),
+                                               axis=0).astype(a.dtype),
+                            worker_vars)
+
+    def run(self, rounds: Optional[int] = None) -> Pytree:
+        cfg = self.cfg
+        worker_vars = self.init_worker_variables()
+        rng = jax.random.PRNGKey(cfg.seed + 1)
+        rounds = rounds if rounds is not None else cfg.comm_round
+        stack, stack_w = self._device_stack()
+        for round_idx in range(rounds):
+            t0 = time.time()
+            rng, round_rng = jax.random.split(rng)
+            worker_vars, m = self.round_fn(worker_vars, stack, stack_w,
+                                           round_rng)
+            if (round_idx % cfg.frequency_of_the_test == 0
+                    or round_idx == rounds - 1):
+                stats = self.evaluate(self.consensus_variables(worker_vars))
+                stats.update(round=round_idx,
+                             train_loss=float(m["train_loss"]),
+                             round_time=time.time() - t0)
+                self.metrics_history.append(stats)
+                log.info("gossip round %d: %s", round_idx, stats)
+        return worker_vars

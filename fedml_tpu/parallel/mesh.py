@@ -1,0 +1,92 @@
+"""Mesh construction + sharding helpers.
+
+Replaces the reference's process/placement machinery: `mpirun -np W+1` +
+gpu_mapping.yaml rank→GPU tables (fedml_api/distributed/utils/
+gpu_mapping.py:8-39).  Here "placement" is a `jax.sharding.Mesh` and a
+`PartitionSpec`; the runtime below (XLA) moves the bytes.
+
+Axis conventions used throughout the framework:
+
+  "clients"  — the federated data-parallel axis (cohort dimension K).
+  "silo"     — the cross-silo / DCN tier for hierarchical FL (2-D meshes).
+
+Multi-host note: on a real pod these helpers take `jax.devices()` spanning
+hosts; ICI carries the "clients" psum within a slice and DCN the "silo"
+reductions, exactly the two-tier layout of SURVEY.md §2.5.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+CLIENT_AXIS = "clients"
+SILO_AXIS = "silo"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_name: str = CLIENT_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over `n_devices` (default: all local devices)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def make_mesh_2d(n_silos: int, per_silo: Optional[int] = None,
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """2-D (silo × clients) mesh for hierarchical FL (SURVEY.md §2.5:
+    'psum within ICI slice, DCN cross-slice')."""
+    devs = list(devices) if devices is not None else jax.devices()
+    per_silo = per_silo if per_silo is not None else len(devs) // n_silos
+    devs = devs[: n_silos * per_silo]
+    grid = np.array(devs).reshape(n_silos, per_silo)
+    return Mesh(grid, (SILO_AXIS, CLIENT_AXIS))
+
+
+def pvary_tree(tree: Pytree, axis_names) -> Pytree:
+    """Mark a replicated pytree as varying over `axis_names` inside
+    shard_map (needed before per-shard scans/vmaps mutate it, else the
+    vma type-check rejects the scan carry)."""
+    return jax.tree.map(
+        lambda a: jax.lax.pcast(a, axis_names, to="varying"), tree)
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard a [K, ...] cohort/stack along its leading (client) axis over
+    every mesh axis — on a 2-D mesh clients are split over silo×clients."""
+    return NamedSharding(mesh, P(mesh.axis_names))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_cohort(mesh: Mesh, cohort: Pytree) -> Pytree:
+    """Place a host-side cohort {x,y,mask}[K,...] (+weights [K]) onto the
+    mesh, leading axis split across devices. K must divide evenly — callers
+    pad the cohort with zero-weight clients otherwise (pad_cohort)."""
+    sh = client_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), cohort)
+
+
+def pad_cohort(cohort: dict, weights: np.ndarray, multiple: int):
+    """Pad cohort to a multiple of the mesh size with zero-weight dummy
+    clients (mask=0 ⇒ their local_train is a no-op and weight 0 drops them
+    from the psum numerator and denominator)."""
+    K = int(weights.shape[0])
+    pad = (-K) % multiple
+    if pad == 0:
+        return cohort, weights
+    def pad_leaf(a):
+        z = np.zeros((pad,) + tuple(a.shape[1:]), a.dtype)
+        return np.concatenate([np.asarray(a), z], axis=0)
+    cohort = {k: pad_leaf(v) for k, v in cohort.items()}
+    weights = np.concatenate([np.asarray(weights),
+                              np.zeros(pad, np.asarray(weights).dtype)])
+    return cohort, weights

@@ -1,0 +1,131 @@
+"""Mesh-engine tests on the 8-device virtual CPU mesh (conftest.py).
+
+The key invariants:
+  * MeshFedAvgEngine == single-device FedAvgEngine bit-for-bit-ish (the psum
+    aggregation must reproduce the tree weighted mean to float tolerance).
+  * The equivalence oracle survives sharding: full-batch E=1 full
+    participation == centralized (CI-script-fedavg.sh:41-47).
+  * Hierarchical grouping does not change the one-inner-round result
+    (CI-script-fedavg.sh:51-59).
+  * Gossip reaches consensus-ish accuracy on an easy task.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel import (MeshFedAvgEngine, MeshFedOptEngine,
+                                MeshGossipEngine, MeshHierarchicalEngine,
+                                MeshRobustEngine)
+from fedml_tpu.parallel.mesh import make_mesh, make_mesh_2d
+from fedml_tpu.utils.config import FedConfig
+
+
+def _mnist_like_cfg(**kw):
+    base = dict(model="lr", dataset="mnist",
+                client_num_in_total=16, client_num_per_round=16,
+                comm_round=4, epochs=1, batch_size=16, lr=0.1,
+                partition_method="homo", frequency_of_the_test=100)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _setup(cfg, prox_mu=0.0):
+    data = load_data(cfg.dataset, client_num_in_total=cfg.client_num_in_total,
+                     batch_size=cfg.batch_size, synthetic_scale=0.02,
+                     seed=cfg.seed)
+    model = create_model(cfg.model, output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=cfg.lr, optimizer=cfg.client_optimizer,
+                            prox_mu=prox_mu)
+    return trainer, data
+
+
+def test_mesh_matches_single_device():
+    cfg = _mnist_like_cfg()
+    trainer, data = _setup(cfg)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+
+    mesh = make_mesh(8)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh, donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_partial_participation_padding():
+    # 10 of 16 clients -> cohort padded to 16 with zero-weight repeats
+    cfg = _mnist_like_cfg(client_num_per_round=10)
+    trainer, data = _setup(cfg)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_fedopt_runs_and_learns():
+    cfg = _mnist_like_cfg(server_optimizer="adam", server_lr=0.05,
+                          comm_round=6)
+    trainer, data = _setup(cfg)
+    eng = MeshFedOptEngine(trainer, data, cfg, mesh=make_mesh(8))
+    v = eng.run(rounds=6)
+    acc = eng.evaluate(v)["train_acc"]
+    assert acc > 0.5, acc
+
+
+def test_mesh_robust_clipping_runs():
+    cfg = _mnist_like_cfg(norm_bound=0.5, stddev=1e-3, comm_round=2)
+    trainer, data = _setup(cfg)
+    eng = MeshRobustEngine(trainer, data, cfg, mesh=make_mesh(8))
+    v = eng.run(rounds=2)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
+
+
+def test_hierarchical_equals_flat_for_one_inner_round():
+    # oracle: one inner round, full participation => grouping-invariant
+    # == plain FedAvg (CI-script-fedavg.sh:51-59 generalization). The
+    # hierarchical engine caps the per-silo cohort at clients_per_silo (8),
+    # which with client_num_per_round=16 means full participation both ways.
+    cfg = _mnist_like_cfg(client_num_per_round=16)
+    trainer, data = _setup(cfg)
+    flat = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = flat.init_variables()
+    v_flat = flat.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+
+    mesh = make_mesh_2d(n_silos=2, per_silo=4)
+    eng = MeshHierarchicalEngine(trainer, data, cfg, mesh=mesh,
+                                 group_comm_round=1, donate=False)
+    v_h = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_flat), jax.tree.leaves(v_h)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_hierarchical_multi_inner_rounds_learn():
+    cfg = _mnist_like_cfg(client_num_per_round=8, comm_round=3)
+    trainer, data = _setup(cfg)
+    eng = MeshHierarchicalEngine(trainer, data, cfg,
+                                 mesh=make_mesh_2d(n_silos=4, per_silo=2),
+                                 group_comm_round=3)
+    v = eng.run(rounds=3)
+    assert eng.evaluate(v)["train_acc"] > 0.5
+
+
+def test_gossip_learns():
+    cfg = _mnist_like_cfg(comm_round=6, lr=0.2)
+    trainer, data = _setup(cfg)
+    eng = MeshGossipEngine(trainer, data, cfg, mesh=make_mesh(8))
+    wv = eng.run(rounds=6)
+    acc = eng.evaluate(eng.consensus_variables(wv))["train_acc"]
+    assert acc > 0.5, acc
