@@ -5,9 +5,10 @@ from fedml_tpu.algorithms.fednova import FedNovaEngine
 from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustEngine
 from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgEngine
 from fedml_tpu.algorithms.decentralized import DecentralizedGossipEngine
+from fedml_tpu.algorithms.fednas import FedNASSearchEngine
 
 __all__ = [
     "FedAvgEngine", "FedOptEngine", "FedProxEngine", "FedNovaEngine",
     "FedAvgRobustEngine", "HierarchicalFedAvgEngine",
-    "DecentralizedGossipEngine",
+    "DecentralizedGossipEngine", "FedNASSearchEngine",
 ]

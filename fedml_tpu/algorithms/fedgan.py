@@ -11,6 +11,7 @@ weighted tree-mean (a psum on a mesh).
 """
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import Any, Optional
@@ -20,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.pytree import tree_select, tree_weighted_mean
 from fedml_tpu.core.sampling import ClientSampler
 from fedml_tpu.core.trainer import make_optimizer
 from fedml_tpu.data.federated import FederatedData
@@ -95,9 +96,7 @@ class FedGANEngine:
 
             gl, gg = jax.value_and_grad(g_loss)(p["gen"])
             gu, go2 = self.g_tx.update(gg, go, p["gen"])
-            has = jnp.sum(m) > 0
-            keep = lambda n, o: jax.tree.map(
-                lambda a, b: jnp.where(has, a, b), n, o)
+            keep = functools.partial(tree_select, jnp.sum(m) > 0)
             new_p = {"gen": keep(optax.apply_updates(p["gen"], gu), p["gen"]),
                      "disc": keep(new_disc, p["disc"])}
             return (new_p, keep(go2, go), keep(do2, do), rng), (dl, gl)

@@ -15,6 +15,7 @@ layer.
 """
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import Any, Optional
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fedml_tpu.core.pytree import tree_select
 from fedml_tpu.core.trainer import make_optimizer, masked_accuracy_sums
 from fedml_tpu.data.federated import FederatedData
 from fedml_tpu.utils.config import FedConfig
@@ -61,6 +63,7 @@ class FedGKTEngine:
                                         cfg.server_momentum)
         self._client_phase_j = jax.jit(self._client_phase)
         self._server_phase_j = jax.jit(self._server_phase)
+        self._eval = jax.jit(self._eval_sums)
         self.metrics_history: list[dict] = []
 
     # -- init ----------------------------------------------------------------
@@ -96,8 +99,7 @@ class FedGKTEngine:
             loss, g = jax.value_and_grad(loss_fn)(p, batch, slog)
             has = jnp.sum(batch["mask"]) > 0
             u, opt2 = self.client_tx.update(g, opt, p)
-            keep = lambda n, o: jax.tree.map(
-                lambda a, b: jnp.where(has, a, b), n, o)
+            keep = functools.partial(tree_select, has)
             return (keep(optax.apply_updates(p, u), p), keep(opt2, opt)), loss
 
         def epoch(carry, _):
@@ -134,8 +136,7 @@ class FedGKTEngine:
             loss, g = jax.value_and_grad(loss_fn)(p, f, clog, y, m)
             has = jnp.sum(m) > 0
             u, opt2 = self.server_tx.update(g, opt, p)
-            keep = lambda n, o: jax.tree.map(
-                lambda a, b: jnp.where(has, a, b), n, o)
+            keep = functools.partial(tree_select, has)
             return (keep(optax.apply_updates(p, u), p), keep(opt2, opt)), loss
 
         def epoch(carry, _):
@@ -194,17 +195,15 @@ class FedGKTEngine:
                 log.info("gkt round %d: %s", round_idx, stats)
         return client_params, sp
 
+    def _eval_sums(self, cp, sp, shard):
+        def one(batch):
+            f, _ = self.client_model.apply({"params": cp}, batch["x"])
+            logits = self.server_model.apply({"params": sp}, f)
+            return masked_accuracy_sums(logits, batch["y"], batch["mask"])
+        c, n = jax.vmap(one)(shard)
+        return c.sum(), n.sum()
+
     def evaluate(self, client_params, server_params) -> dict:
         shard = jax.tree.map(jnp.asarray, self.data.test_global)
-
-        @jax.jit
-        def _eval(cp, sp, shard):
-            def one(batch):
-                f, _ = self.client_model.apply({"params": cp}, batch["x"])
-                logits = self.server_model.apply({"params": sp}, f)
-                return masked_accuracy_sums(logits, batch["y"], batch["mask"])
-            c, n = jax.vmap(one)(shard)
-            return c.sum(), n.sum()
-
-        c, n = _eval(client_params, server_params, shard)
+        c, n = self._eval(client_params, server_params, shard)
         return {"test_acc": float(c) / max(float(n), 1.0)}

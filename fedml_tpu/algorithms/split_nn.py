@@ -16,6 +16,7 @@ protocol over the message layer.
 """
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import Any, Optional
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fedml_tpu.core.pytree import tree_select
 from fedml_tpu.core.trainer import (make_optimizer, masked_accuracy_sums,
                                     masked_cross_entropy)
 from fedml_tpu.data.federated import FederatedData
@@ -50,6 +52,7 @@ class SplitNNEngine:
         self.server_tx = make_optimizer(cfg.client_optimizer, cfg.lr,
                                         cfg.momentum, cfg.wd)
         self._fit_client = jax.jit(self._client_phase)
+        self._eval = jax.jit(self._eval_sums)
         self.metrics_history: list[dict] = []
 
     # -- init ---------------------------------------------------------------
@@ -86,8 +89,7 @@ class SplitNNEngine:
             has = jnp.sum(batch["mask"]) > 0
             cu, co2 = self.client_tx.update(cg, co, cp)
             su, so2 = self.server_tx.update(sg, so, sp)
-            keep = lambda new, old: jax.tree.map(
-                lambda n, o: jnp.where(has, n, o), new, old)
+            keep = functools.partial(tree_select, has)
             cp2 = keep(optax.apply_updates(cp, cu), cp)
             sp2 = keep(optax.apply_updates(sp, su), sp)
             return (cp2, sp2, keep(co2, co), keep(so2, so)), loss
@@ -129,17 +131,15 @@ class SplitNNEngine:
                 log.info("splitnn round %d: %s", round_idx, stats)
         return per_client, server_params
 
+    def _eval_sums(self, cp, sp, shard):
+        def one(batch):
+            acts = self.client_model.apply({"params": cp}, batch["x"])
+            logits = self.server_model.apply({"params": sp}, acts)
+            return masked_accuracy_sums(logits, batch["y"], batch["mask"])
+        correct, count = jax.vmap(one)(shard)
+        return correct.sum(), count.sum()
+
     def evaluate(self, client_params, server_params) -> dict:
         shard = jax.tree.map(jnp.asarray, self.data.test_global)
-
-        @jax.jit
-        def _eval(cp, sp, shard):
-            def one(batch):
-                acts = self.client_model.apply({"params": cp}, batch["x"])
-                logits = self.server_model.apply({"params": sp}, acts)
-                return masked_accuracy_sums(logits, batch["y"], batch["mask"])
-            correct, count = jax.vmap(one)(shard)
-            return correct.sum(), count.sum()
-
-        correct, count = _eval(client_params, server_params, shard)
+        correct, count = self._eval(client_params, server_params, shard)
         return {"test_acc": float(correct) / max(float(count), 1.0)}

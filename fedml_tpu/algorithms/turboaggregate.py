@@ -58,7 +58,9 @@ class TurboAggregateEngine(FedAvgEngine):
     into any single w_i."""
 
     def __init__(self, trainer, data, cfg, scale: int = 2 ** 16,
-                 prime: int = mpc.DEFAULT_PRIME, donate: bool = False):
+                 prime: int = mpc.DEFAULT_PRIME):
+        # donation is never safe here: secure_round reuses `variables`
+        # after round_fn would have consumed its buffer
         super().__init__(trainer, data, cfg, donate=False)
         self.scale = scale
         self.prime = prime

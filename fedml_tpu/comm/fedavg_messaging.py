@@ -210,6 +210,7 @@ def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",
     if not server.done.wait(timeout=600):
         for c in clients:
             c.finish()
+        server.finish()   # close the server backend too (frees its port)
         raise TimeoutError(
             f"messaging FedAvg did not finish {cfg.comm_round} rounds in "
             f"600s (stalled at round {server.round_idx}; a client likely "

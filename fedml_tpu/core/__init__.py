@@ -1,5 +1,6 @@
 from fedml_tpu.core.pytree import (
     tree_weighted_mean,
+    tree_select,
     tree_stack,
     tree_unstack,
     tree_zeros_like,
@@ -27,7 +28,8 @@ from fedml_tpu.core.topology import (
 from fedml_tpu.core.robust import norm_diff_clip, add_weak_dp_noise
 
 __all__ = [
-    "tree_weighted_mean", "tree_stack", "tree_unstack", "tree_zeros_like",
+    "tree_weighted_mean", "tree_select", "tree_stack", "tree_unstack",
+    "tree_zeros_like",
     "tree_add", "tree_sub", "tree_scale", "tree_dot", "tree_l2_norm",
     "tree_clip_by_norm", "tree_cast", "vectorize_weights",
     "partition_homo", "partition_dirichlet", "partition_power_law",

@@ -106,3 +106,13 @@ def unvectorize_weights(vec: jax.Array, like: Pytree) -> Pytree:
         out.append(vec[off:off + n].reshape(leaf.shape).astype(leaf.dtype))
         off += n
     return jax.tree.unflatten(treedef, out)
+
+
+def tree_select(pred, new: Pytree, old: Pytree) -> Pytree:
+    """Elementwise `jnp.where(pred, new, old)` over two matching pytrees.
+
+    The standard empty-batch guard: an all-padding batch must be a no-op,
+    but momentum / weight-decay / prox updates are nonzero even at zero
+    data gradient — so freeze params and optimizer state when the batch
+    holds no real samples (the reference iterates only real batches)."""
+    return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new, old)

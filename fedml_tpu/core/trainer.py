@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from fedml_tpu.core.pytree import tree_select
+
 Pytree = Any
 
 
@@ -159,13 +161,9 @@ class ClientTrainer:
             params, rest, batch, step_rng, global_params)
         updates, opt_state = self.tx.update(grads, state.opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        # An all-padding batch must be a no-op: with momentum / weight decay /
-        # prox the update is nonzero even at zero data gradient, so freeze
-        # params, optimizer state, and stats collections when the batch holds
-        # no real samples (the reference iterates only real batches).
+        # empty-batch guard — see core/pytree.py:tree_select
         has_data = jnp.sum(batch["mask"]) > 0
-        keep = lambda new, old: jax.tree.map(
-            lambda n, o: jnp.where(has_data, n, o), new, old)
+        keep = functools.partial(tree_select, has_data)
         return TrainState(
             variables={"params": keep(new_params, params), **keep(new_rest, rest)},
             opt_state=keep(opt_state, state.opt_state),

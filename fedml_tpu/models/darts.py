@@ -1,0 +1,360 @@
+"""DARTS search space for FedNAS — TPU-native redesign.
+
+Reference behavior: fedml_api/model/cv/darts/{operations.py:4-20,
+genotypes.py:1-14, model_search.py:172-296, model.py} — a cell-based
+search space with 8 primitives, continuous architecture weights (alphas)
+relaxed by softmax over ops per edge, and a discrete-genotype derivation
+that keeps the 2 strongest incoming edges per node.
+
+TPU-first deviations (deliberate, documented):
+  * GroupNorm replaces BatchNorm.  The search-phase bilevel gradients
+    (architect) must differentiate through the network twice; BatchNorm's
+    mutable running stats would thread a `batch_stats` collection through
+    every `jax.grad` and break functional purity under `vmap` over clients.
+    GroupNorm is stateless, per-sample, and the standard TPU substitution
+    (the reference itself ships ResNet18-GN for the same reason,
+    cv/resnet_gn.py).
+  * Architecture weights (alphas) are NOT flax params: `__call__` takes
+    them as explicit inputs.  This makes the weight/arch bilevel split a
+    plain function-argument split — `jax.grad(..., argnums=...)` — instead
+    of pytree surgery on a mixed parameter dict.
+  * All 8 primitive branches of a MixedOp are computed and combined with a
+    weighted sum (one stacked elementwise op) — on TPU the branches are
+    XLA-fused and the MXU-heavy separable convs dominate; no Python-level
+    op dispatch survives tracing.
+  * NHWC layout throughout (TPU conv layout), vs the reference's NCHW.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Genotype = namedtuple("Genotype", "normal normal_concat reduce reduce_concat")
+
+# Same 8-primitive vocabulary as the reference (genotypes.py:5-14).
+PRIMITIVES = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
+
+# The published DARTS-V2 CIFAR genotype (public constant; genotypes.py).
+DARTS_V2 = Genotype(
+    normal=[("sep_conv_3x3", 0), ("sep_conv_3x3", 1), ("sep_conv_3x3", 0),
+            ("sep_conv_3x3", 1), ("sep_conv_3x3", 1), ("skip_connect", 0),
+            ("skip_connect", 0), ("dil_conv_3x3", 2)],
+    normal_concat=[2, 3, 4, 5],
+    reduce=[("max_pool_3x3", 0), ("max_pool_3x3", 1), ("skip_connect", 2),
+            ("max_pool_3x3", 1), ("max_pool_3x3", 0), ("skip_connect", 2),
+            ("skip_connect", 2), ("max_pool_3x3", 1)],
+    reduce_concat=[2, 3, 4, 5],
+)
+
+
+def _gn(C: int) -> nn.Module:
+    for g in (8, 4, 2, 1):
+        if C % g == 0:
+            return nn.GroupNorm(num_groups=g)
+    return nn.GroupNorm(num_groups=1)
+
+
+class ReLUConvGN(nn.Module):
+    """relu → conv → norm (reference ReLUConvBN, operations.py:23-35)."""
+    C_out: int
+    kernel: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(self.C_out, (self.kernel, self.kernel),
+                    strides=self.stride, padding="SAME", use_bias=False)(x)
+        return _gn(self.C_out)(x)
+
+
+class SepConv(nn.Module):
+    """Depthwise-separable conv applied twice (operations.py:53-70)."""
+    C_out: int
+    kernel: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        C_in = x.shape[-1]
+        for i, s in enumerate((self.stride, 1)):
+            x = nn.relu(x)
+            x = nn.Conv(C_in, (self.kernel, self.kernel), strides=s,
+                        padding="SAME", feature_group_count=C_in,
+                        use_bias=False)(x)
+            C_next = C_in if i == 0 else self.C_out
+            x = nn.Conv(C_next, (1, 1), use_bias=False)(x)
+            x = _gn(C_next)(x)
+        return x
+
+
+class DilConv(nn.Module):
+    """Dilated depthwise-separable conv (operations.py:38-50)."""
+    C_out: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        C_in = x.shape[-1]
+        x = nn.relu(x)
+        x = nn.Conv(C_in, (self.kernel, self.kernel), strides=self.stride,
+                    padding="SAME", kernel_dilation=self.dilation,
+                    feature_group_count=C_in, use_bias=False)(x)
+        x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
+        return _gn(self.C_out)(x)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 reduction via two offset 1x1 convs (operations.py:81-97)."""
+    C_out: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        a = nn.Conv(self.C_out // 2, (1, 1), strides=2, use_bias=False)(x)
+        b = nn.Conv(self.C_out - self.C_out // 2, (1, 1), strides=2,
+                    use_bias=False)(x[:, 1:, 1:, :])
+        # offset path loses a row/col at odd sizes; pad back to match
+        if b.shape[1] != a.shape[1] or b.shape[2] != a.shape[2]:
+            b = jnp.pad(b, ((0, 0), (0, a.shape[1] - b.shape[1]),
+                            (0, a.shape[2] - b.shape[2]), (0, 0)))
+        return _gn(self.C_out)(jnp.concatenate([a, b], axis=-1))
+
+
+def _pool(x, kind: str, stride: int):
+    w = (1, 3, 3, 1)
+    s = (1, stride, stride, 1)
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, w, s, "SAME")
+    ones = jnp.ones_like(x)
+    num = jax.lax.reduce_window(x, 0.0, jax.lax.add, w, s, "SAME")
+    den = jax.lax.reduce_window(ones, 0.0, jax.lax.add, w, s, "SAME")
+    return num / den   # count_include_pad=False semantics
+
+
+class MixedOp(nn.Module):
+    """All |PRIMITIVES| branches, combined by softmaxed alphas
+    (model_search.py:10-24)."""
+    C: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, w):
+        s = self.stride
+        outs = [
+            jnp.zeros_like(x[:, ::s, ::s, :]),                   # none
+            _pool(x, "max", s),                                  # max_pool_3x3
+            _pool(x, "avg", s),                                  # avg_pool_3x3
+            (x if s == 1 else FactorizedReduce(self.C)(x)),      # skip
+            SepConv(self.C, 3, s)(x),
+            SepConv(self.C, 5, s)(x),
+            DilConv(self.C, 3, s)(x),
+            DilConv(self.C, 5, s)(x),
+        ]
+        stacked = jnp.stack(outs, axis=0)                        # [O, N,H,W,C]
+        return jnp.tensordot(w, stacked, axes=[[0], [0]])
+
+
+class SearchCell(nn.Module):
+    """One DARTS cell: `steps` intermediate nodes, every node summing a
+    MixedOp over all previous states (model_search.py:26-60)."""
+    steps: int
+    multiplier: int
+    C: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, weights):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C)(s0)
+        else:
+            s0 = ReLUConvGN(self.C)(s0)
+        s1 = ReLUConvGN(self.C)(s1)
+        states = [s0, s1]
+        offset = 0
+        for _ in range(self.steps):
+            acc = 0.0
+            for j, h in enumerate(states):
+                stride = 2 if self.reduction and j < 2 else 1
+                acc = acc + MixedOp(self.C, stride)(h, weights[offset + j])
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+class DartsSearchNetwork(nn.Module):
+    """Search-phase supernet (model_search.py:172-231).  Reduction cells at
+    layers//3 and 2*layers//3.  `__call__(x, alphas)` with
+    alphas = {"normal": [k, O], "reduce": [k, O]} raw logits."""
+    num_classes: int
+    C: int = 16
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+
+    @nn.compact
+    def __call__(self, x, alphas, train: bool = True):
+        del train
+        w_normal = jax.nn.softmax(alphas["normal"], axis=-1)
+        w_reduce = jax.nn.softmax(alphas["reduce"], axis=-1)
+        C_curr = self.stem_multiplier * self.C
+        s = nn.Conv(C_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        s0 = s1 = _gn(C_curr)(s)
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = SearchCell(self.steps, self.multiplier, C_curr,
+                              reduction, reduction_prev)
+            s0, s1 = s1, cell(s0, s1, w_reduce if reduction else w_normal)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+def num_edges(steps: int = 4) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+def init_alphas(rng: jax.Array, steps: int = 4) -> dict[str, jax.Array]:
+    """1e-3 * randn init, as the reference (model_search.py:232-241)."""
+    k = num_edges(steps)
+    rn, rr = jax.random.split(rng)
+    return {"normal": 1e-3 * jax.random.normal(rn, (k, len(PRIMITIVES))),
+            "reduce": 1e-3 * jax.random.normal(rr, (k, len(PRIMITIVES)))}
+
+
+def derive_genotype(alphas: dict[str, Any], steps: int = 4,
+                    multiplier: int = 4) -> Genotype:
+    """Discretize: per node keep the 2 incoming edges with the strongest
+    best-non-'none' op, then that op per edge (model_search.py:258-296)."""
+    none_idx = PRIMITIVES.index("none")
+
+    def _parse(w):
+        w = np.asarray(jax.nn.softmax(jnp.asarray(w), axis=-1))
+        gene, start, n = [], 0, 2
+        for _ in range(steps):
+            W = w[start:start + n]
+            edges = sorted(
+                range(n),
+                key=lambda j: -max(W[j][k] for k in range(len(PRIMITIVES))
+                                   if k != none_idx))[:2]
+            for j in sorted(edges):
+                k_best = max((k for k in range(len(PRIMITIVES))
+                              if k != none_idx), key=lambda k: W[j][k])
+                gene.append((PRIMITIVES[k_best], j))
+            start += n
+            n += 1
+        return gene
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return Genotype(normal=_parse(alphas["normal"]), normal_concat=concat,
+                    reduce=_parse(alphas["reduce"]), reduce_concat=concat)
+
+
+# ---------------------------------------------------------------------------
+# Fixed (derived) network for the FedNAS train phase (cv/darts/model.py)
+# ---------------------------------------------------------------------------
+
+_FIXED_OPS = {
+    "max_pool_3x3": lambda C, s: (lambda x: _pool(x, "max", s)),
+    "avg_pool_3x3": lambda C, s: (lambda x: _pool(x, "avg", s)),
+}
+
+
+class _FixedOp(nn.Module):
+    op: str        # `name` is reserved by flax Module
+    C: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        n, C, s = self.op, self.C, self.stride
+        if n == "skip_connect":
+            return x if s == 1 else FactorizedReduce(C)(x)
+        if n in _FIXED_OPS:
+            return _FIXED_OPS[n](C, s)(x)
+        if n == "sep_conv_3x3":
+            return SepConv(C, 3, s)(x)
+        if n == "sep_conv_5x5":
+            return SepConv(C, 5, s)(x)
+        if n == "dil_conv_3x3":
+            return DilConv(C, 3, s)(x)
+        if n == "dil_conv_5x5":
+            return DilConv(C, 5, s)(x)
+        raise ValueError(f"op {n!r} not valid in a derived genotype")
+
+
+class FixedCell(nn.Module):
+    genotype: Any
+    C: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1):
+        g = self.genotype
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C)(s0)
+        else:
+            s0 = ReLUConvGN(self.C)(s0)
+        s1 = ReLUConvGN(self.C)(s1)
+        ops = g.reduce if self.reduction else g.normal
+        concat = g.reduce_concat if self.reduction else g.normal_concat
+        states = [s0, s1]
+        # ops come in pairs: 2 incoming edges per intermediate node
+        for i in range(len(ops) // 2):
+            acc = 0.0
+            for name, j in ops[2 * i:2 * i + 2]:
+                stride = 2 if self.reduction and j < 2 else 1
+                acc = acc + _FixedOp(name, self.C, stride)(states[j])
+            states.append(acc)
+        return jnp.concatenate([states[i] for i in concat], axis=-1)
+
+
+class DartsNetwork(nn.Module):
+    """Train-phase network built from a derived genotype
+    (cv/darts/model.py NetworkCIFAR; drop-path omitted — GroupNorm +
+    weight decay regularize instead, a documented deviation)."""
+    num_classes: int
+    genotype: Any
+    C: int = 36
+    layers: int = 20
+    stem_multiplier: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train
+        C_curr = self.stem_multiplier * self.C
+        s = nn.Conv(C_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        s0 = s1 = _gn(C_curr)(s)
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = FixedCell(self.genotype, C_curr, reduction, reduction_prev)
+            s0, s1 = s1, cell(s0, s1)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
