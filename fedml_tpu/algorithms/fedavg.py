@@ -42,10 +42,15 @@ class FedAvgEngine:
     """Standalone-simulation FedAvg (single device or vmap cohort)."""
 
     def __init__(self, trainer: ClientTrainer, data: FederatedData,
-                 cfg: FedConfig, donate: bool = True):
+                 cfg: FedConfig, donate: bool = True,
+                 pallas_agg: bool = False):
         self.trainer = trainer
         self.data = data
         self.cfg = cfg
+        # opt-in fused aggregation kernel (fedml_tpu/ops); the default XLA
+        # tree-mean is already fused well — the kernel wins when the whole
+        # stack is flattened anyway (robust pipeline) or on very many leaves
+        self.pallas_agg = pallas_agg
         self.sampler = ClientSampler(cfg.client_num_in_total,
                                      cfg.client_num_per_round)
         self.round_fn = jax.jit(
@@ -69,6 +74,9 @@ class FedAvgEngine:
         """Sample-weighted mean over ALL variable collections (params and
         batch_stats alike), matching the reference's iteration over every
         state_dict key (FedAVGAggregator.py:74-81)."""
+        if self.pallas_agg:
+            from fedml_tpu.ops import weighted_mean_pallas
+            return weighted_mean_pallas(stacked_variables, weights), server_state
         return tree_weighted_mean(stacked_variables, weights), server_state
 
     # ---- one federated round, fully jitted -------------------------------
@@ -96,21 +104,47 @@ class FedAvgEngine:
         sample = jnp.asarray(self.data.client_shards["x"][0, 0])
         return self.trainer.init(rng, sample)
 
+    # ---- driver-loop hooks (mesh engines override) ------------------------
+    def _prepare_variables(self, variables: Pytree) -> Pytree:
+        """Post-init/post-restore placement hook (mesh: replicate)."""
+        return variables
+
+    def _round_args(self, round_idx: int) -> tuple:
+        """Per-round positional args for round_fn between server_state and
+        the rng (mesh: the resident device stack + padded cohort ids)."""
+        client_ids = self.sampler.sample(round_idx)
+        cohort, _ = self.data.cohort(client_ids)
+        return (cohort,)
+
     def run(self, variables: Optional[Pytree] = None,
-            rounds: Optional[int] = None) -> Pytree:
-        """The reference's train() loop (fedavg_api.py:40-81)."""
+            rounds: Optional[int] = None, logger=None, ckpt=None,
+            ckpt_every: int = 0, resume: bool = False) -> Pytree:
+        """The reference's train() loop (fedavg_api.py:40-81), plus the
+        round-level checkpoint/resume the reference lacks (SURVEY.md §5):
+        `ckpt` is a utils.checkpoint.FedCheckpointManager; with `resume`
+        the run continues bitwise-identically (per-round rngs are
+        fold_in(round_idx), the sampler reseeds per round).  This one loop
+        drives the vmap-simulation and all mesh engines via the
+        _prepare_variables/_round_args hooks."""
         cfg = self.cfg
         variables = variables if variables is not None else self.init_variables()
+        variables = self._prepare_variables(variables)
         server_state = self.server_init(variables)
-        rng = jax.random.PRNGKey(cfg.seed + 1)
+        rng_base = jax.random.PRNGKey(cfg.seed + 1)
         rounds = rounds if rounds is not None else cfg.comm_round
-        for round_idx in range(rounds):
+        start = 0
+        if ckpt is not None and resume and ckpt.latest_round() is not None:
+            start, variables, server_state = ckpt.restore(
+                variables, server_state)
+            start += 1
+            variables = self._prepare_variables(variables)
+            log.info("resumed from round %d", start - 1)
+        for round_idx in range(start, rounds):
             t0 = time.time()
-            client_ids = self.sampler.sample(round_idx)
-            cohort, _ = self.data.cohort(client_ids)
-            rng, round_rng = jax.random.split(rng)
+            round_rng = jax.random.fold_in(rng_base, round_idx)
             variables, server_state, m = self.round_fn(
-                variables, server_state, cohort, round_rng)
+                variables, server_state, *self._round_args(round_idx),
+                round_rng)
             if (round_idx % cfg.frequency_of_the_test == 0
                     or round_idx == rounds - 1):
                 stats = self.evaluate(variables)
@@ -118,7 +152,12 @@ class FedAvgEngine:
                              train_loss=float(m["train_loss"]),
                              round_time=time.time() - t0)
                 self.metrics_history.append(stats)
+                if logger is not None:
+                    logger.log(stats, step=round_idx)
                 log.info("round %d: %s", round_idx, stats)
+            if ckpt is not None and ckpt_every and \
+                    (round_idx + 1) % ckpt_every == 0:
+                ckpt.save(round_idx, variables, server_state)
         return variables
 
     def evaluate(self, variables: Pytree) -> dict:

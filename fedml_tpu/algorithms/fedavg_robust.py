@@ -43,8 +43,14 @@ class FedAvgRobustEngine(FedAvgEngine):
         params = stacked_variables["params"]
         g = global_variables["params"]
         if self.defense == "norm_clip":
-            clipped = jax.vmap(lambda p: norm_diff_clip(p, g, self.cfg.norm_bound))(params)
-            new_params = tree_weighted_mean(clipped, weights)
+            if self.pallas_agg:
+                from fedml_tpu.ops import robust_weighted_mean_pallas
+                new_params = robust_weighted_mean_pallas(
+                    params, weights, g, self.cfg.norm_bound)
+            else:
+                clipped = jax.vmap(
+                    lambda p: norm_diff_clip(p, g, self.cfg.norm_bound))(params)
+                new_params = tree_weighted_mean(clipped, weights)
             if self.cfg.stddev > 0:
                 new_params = add_weak_dp_noise(new_params, rng, self.cfg.stddev)
         elif self.defense == "krum":
@@ -60,3 +66,12 @@ class FedAvgRobustEngine(FedAvgEngine):
                     for k, v in stacked_variables.items() if k != "params"}
         new_vars["params"] = new_params
         return new_vars, server_state
+
+    def evaluate_backdoor(self, variables, poison_shard) -> dict:
+        """Backdoor success rate on a triggered test set (the reference's
+        poisoned-testset eval, FedAvgRobustAggregator.test :14-111)."""
+        shard = jax.tree.map(jnp.asarray, poison_shard)
+        sums = self.eval_fn(variables, shard)
+        n = max(float(sums["count"]), 1.0)
+        return {"backdoor_acc": float(sums["correct"]) / n,
+                "backdoor_loss": float(sums["loss_sum"]) / n}

@@ -1,0 +1,269 @@
+"""Unified experiment launcher — the fedml_experiments parity surface.
+
+One CLI replaces the reference's per-(algorithm × paradigm) main_*.py files
+and the fed_launch unified launcher (fedml_experiments/distributed/
+fed_launch/main.py): the canonical flag set of main_fedavg.py:46-135 plus
+`--algorithm` dispatch.  `mpirun -np N` + hostfiles + gpu_mapping.yaml are
+replaced by the device mesh: `--mesh` runs the cohort mesh-sharded over all
+visible TPU chips (pjit/shard_map); without it the vmap simulation engine
+runs on one chip (the reference's "standalone" paradigm).
+
+Usage:
+  python -m fedml_tpu.cli --algorithm fedavg --dataset mnist --model lr \
+      --client_num_in_total 1000 --client_num_per_round 10 --comm_round 100
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+import numpy as np
+from typing import Optional
+
+from fedml_tpu.utils.config import FedConfig
+
+ALGORITHMS = ("fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
+              "hierarchical", "decentralized", "fednas", "fedgan",
+              "fedgkt", "splitnn", "vfl", "turboaggregate", "centralized")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("fedml_tpu",
+                                description="TPU-native federated learning")
+    # canonical reference flags (main_fedavg.py:46-135)
+    p.add_argument("--algorithm", choices=ALGORITHMS, default="fedavg")
+    p.add_argument("--model", type=str, default="lr")
+    p.add_argument("--dataset", type=str, default="mnist")
+    p.add_argument("--data_dir", type=str, default=None)
+    p.add_argument("--partition_method", type=str, default="hetero")
+    p.add_argument("--partition_alpha", type=float, default=0.5)
+    p.add_argument("--client_num_in_total", type=int, default=10)
+    p.add_argument("--client_num_per_round", type=int, default=10)
+    p.add_argument("--comm_round", type=int, default=10)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch_size", type=int, default=10)
+    p.add_argument("--client_optimizer", type=str, default="sgd")
+    p.add_argument("--lr", type=float, default=0.03)
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--wd", type=float, default=0.0)
+    p.add_argument("--server_optimizer", type=str, default="sgd")
+    p.add_argument("--server_lr", type=float, default=1.0)
+    p.add_argument("--server_momentum", type=float, default=0.0)
+    p.add_argument("--prox_mu", type=float, default=0.0)
+    p.add_argument("--norm_bound", type=float, default=5.0)
+    p.add_argument("--stddev", type=float, default=0.0)
+    p.add_argument("--frequency_of_the_test", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ci", type=int, default=0)
+    p.add_argument("--synthetic_scale", type=float, default=1.0)
+    p.add_argument("--max_batches_per_client", type=int, default=None)
+    # TPU-native replacements for mpirun/hostfile/gpu_mapping
+    p.add_argument("--mesh", action="store_true",
+                   help="shard the cohort over all visible devices")
+    p.add_argument("--group_num", type=int, default=2,
+                   help="hierarchical: silo count")
+    p.add_argument("--group_comm_round", type=int, default=2)
+    p.add_argument("--defense", type=str, default="norm_clip")
+    p.add_argument("--topology", type=str, default="ring",
+                   help="decentralized: ring|ws (Watts-Strogatz)")
+    p.add_argument("--unrolled", action="store_true",
+                   help="fednas: 2nd-order architect")
+    # observability / checkpointing (SURVEY.md §5 gaps the build fills)
+    p.add_argument("--run_dir", type=str, default="./runs")
+    p.add_argument("--run_name", type=str, default=None)
+    p.add_argument("--ckpt_dir", type=str, default=None)
+    p.add_argument("--ckpt_every", type=int, default=0)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--profile_dir", type=str, default=None)
+    return p
+
+
+def _load(cfg: FedConfig):
+    from fedml_tpu.data import load_data
+    return load_data(cfg.dataset, data_dir=cfg.data_dir,
+                     client_num_in_total=cfg.client_num_in_total,
+                     batch_size=cfg.batch_size,
+                     partition_method=cfg.partition_method,
+                     partition_alpha=cfg.partition_alpha,
+                     max_batches_per_client=cfg.max_batches_per_client,
+                     seed=cfg.seed, synthetic_scale=cfg.synthetic_scale)
+
+
+def _trainer(cfg: FedConfig, data):
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+    loss = "bce" if cfg.dataset == "stackoverflow_lr" else "ce"
+    has_time = cfg.dataset in ("shakespeare", "fed_shakespeare",
+                               "stackoverflow_nwp")
+    model = create_model(cfg.model, data.class_num)
+    return ClientTrainer(model, loss=loss, optimizer=cfg.client_optimizer,
+                         lr=cfg.lr, momentum=cfg.momentum,
+                         weight_decay=cfg.wd, prox_mu=cfg.prox_mu,
+                         has_time_axis=has_time)
+
+
+def build_engine(args, cfg: FedConfig, data):
+    """Algorithm dispatch (the reference's fed_launch algorithm select)."""
+    algo = args.algorithm
+    mesh = None
+    if args.mesh:
+        from fedml_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh()
+
+    if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
+                                         "fedavg_robust", "hierarchical",
+                                         "decentralized"):
+        logging.getLogger(__name__).warning(
+            "--mesh has no %s engine; running the single-device path", algo)
+
+    if algo in ("fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
+                "turboaggregate", "centralized"):
+        trainer = _trainer(cfg, data)
+        if mesh is not None and algo in ("fedavg", "fedopt", "fedprox",
+                                         "fedavg_robust"):
+            from fedml_tpu.parallel import (MeshFedAvgEngine,
+                                            MeshFedOptEngine,
+                                            MeshFedProxEngine,
+                                            MeshRobustEngine)
+            cls = {"fedavg": MeshFedAvgEngine, "fedopt": MeshFedOptEngine,
+                   "fedprox": MeshFedProxEngine,
+                   "fedavg_robust": MeshRobustEngine}[algo]
+            return cls(trainer, data, cfg, mesh=mesh)
+        if algo == "centralized":
+            from fedml_tpu.algorithms.centralized import CentralizedTrainer
+            return CentralizedTrainer(trainer, data, cfg)
+        from fedml_tpu import algorithms as A
+        cls = {"fedavg": A.FedAvgEngine, "fedopt": A.FedOptEngine,
+               "fedprox": A.FedProxEngine, "fednova": A.FedNovaEngine}.get(algo)
+        if cls is not None:
+            return cls(trainer, data, cfg)
+        if algo == "fedavg_robust":
+            return A.FedAvgRobustEngine(trainer, data, cfg,
+                                        defense=args.defense)
+        from fedml_tpu.algorithms.turboaggregate import TurboAggregateEngine
+        return TurboAggregateEngine(trainer, data, cfg)
+
+    if algo == "hierarchical":
+        if mesh is not None:
+            from fedml_tpu.parallel import MeshHierarchicalEngine
+            from fedml_tpu.parallel.mesh import make_mesh_2d
+            mesh2 = make_mesh_2d(args.group_num)
+            return MeshHierarchicalEngine(
+                _trainer(cfg, data), data, cfg, mesh=mesh2,
+                group_comm_round=args.group_comm_round)
+        from fedml_tpu.algorithms import HierarchicalFedAvgEngine
+        return HierarchicalFedAvgEngine(
+            _trainer(cfg, data), data, cfg, group_num=args.group_num,
+            group_comm_round=args.group_comm_round)
+
+    if algo == "decentralized":
+        if mesh is not None:
+            from fedml_tpu.parallel import MeshGossipEngine
+            return MeshGossipEngine(_trainer(cfg, data), data, cfg,
+                                    mesh=mesh)
+        from fedml_tpu.algorithms import DecentralizedGossipEngine
+        from fedml_tpu.core.topology import (AsymmetricTopologyManager,
+                                             SymmetricTopologyManager)
+        C = cfg.client_num_in_total
+        topo = (SymmetricTopologyManager(C, neighbor_num=2)
+                if args.topology == "ring"
+                else AsymmetricTopologyManager(C))
+        topo.generate_topology()
+        return DecentralizedGossipEngine(_trainer(cfg, data), data, cfg,
+                                         topology=topo)
+
+    if algo == "fednas":
+        from fedml_tpu.algorithms import FedNASSearchEngine
+        return FedNASSearchEngine(data, cfg, unrolled=args.unrolled)
+
+    if algo == "fedgan":
+        from fedml_tpu.algorithms.fedgan import FedGANEngine
+        from fedml_tpu.models.gan import Discriminator, Generator
+        out_dim = int(np.prod(data.client_shards["x"].shape[3:]))
+        return FedGANEngine(Generator(latent_dim=64, out_dim=out_dim),
+                            Discriminator(), data, cfg, latent_dim=64)
+
+    if algo == "fedgkt":
+        from fedml_tpu.algorithms.fedgkt import FedGKTEngine
+        from fedml_tpu.models.resnet_gkt import (ResNetClientGKT,
+                                                 ResNetServerGKT)
+        return FedGKTEngine(ResNetClientGKT(num_classes=data.class_num),
+                            ResNetServerGKT(num_classes=data.class_num),
+                            data, cfg)
+
+    if algo == "splitnn":
+        from fedml_tpu.algorithms.split_nn import SplitNNEngine
+        from fedml_tpu.models.split import split_cnn, split_mlp
+        is_img = data.client_shards["x"].ndim >= 5
+        cm, sm = (split_cnn(data.class_num) if is_img
+                  else split_mlp(data.class_num))
+        return SplitNNEngine(cm, sm, data, cfg)
+
+    if algo == "vfl":
+        from fedml_tpu.algorithms.vertical_fl import VFLEngine
+        from fedml_tpu.data import load_vfl_data
+        x, y, splits = load_vfl_data(
+            cfg.dataset if cfg.dataset in ("nus_wide", "lending_club")
+            else "lending_club", data_dir=cfg.data_dir)
+        eng = VFLEngine(splits, cfg)
+        eng._vfl_data = (x, y)          # consumed by main()
+        return eng
+
+    raise ValueError(f"unknown algorithm {algo!r}")
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    cfg = FedConfig.from_args(args)
+    cfg.ci = bool(args.ci)
+
+    from fedml_tpu.utils.metrics import RunLogger
+    logger = RunLogger(root=args.run_dir, project="fedml_tpu",
+                       name=args.run_name, config=vars(args))
+    ckpt = None
+    if args.ckpt_dir:
+        from fedml_tpu.utils.checkpoint import FedCheckpointManager
+        ckpt = FedCheckpointManager(args.ckpt_dir)
+
+    if args.algorithm == "vfl":
+        eng = build_engine(args, cfg, None)
+        x, y = eng._vfl_data
+        params = eng.fit(x, y, epochs=cfg.comm_round)
+        logger.log({"train_acc": eng.score(params, x, y)})
+        logger.finish()
+        return 0
+
+    data = _load(cfg)
+    eng = build_engine(args, cfg, data)
+
+    import inspect
+    run_params = inspect.signature(eng.run).parameters
+    engine_logs = "logger" in run_params
+
+    def _run():
+        kw = {}
+        if engine_logs:
+            kw = dict(logger=logger, ckpt=ckpt,
+                      ckpt_every=args.ckpt_every, resume=args.resume)
+        eng.run(**kw)
+
+    if args.profile_dir:
+        from fedml_tpu.utils.profiling import trace
+        with trace(args.profile_dir):
+            _run()
+    else:
+        _run()
+
+    # engines that took the logger already logged each eval round
+    if eng.metrics_history and not engine_logs:
+        logger.log(eng.metrics_history[-1])
+    logger.finish()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

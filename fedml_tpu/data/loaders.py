@@ -48,7 +48,16 @@ SPECS = {
     "synthetic_0_0": DatasetSpec(30, 10, 10),
     "synthetic_0.5_0.5": DatasetSpec(30, 10, 10),
     "synthetic_1_1": DatasetSpec(30, 10, 10),
+    "imagenet": DatasetSpec(100, 1000, 32),
+    "gld23k": DatasetSpec(233, 203, 32),
+    "gld160k": DatasetSpec(1262, 2028, 32),
+    "susy": DatasetSpec(30, 2, 32),
+    "room_occupancy": DatasetSpec(30, 2, 32),
 }
+
+# feature dims for the tabular/streaming UCI tasks (reference
+# UCI/data_loader_for_susy_and_ro.py)
+_TABULAR_DIMS = {"susy": 18, "room_occupancy": 5}
 
 
 def _partition(labels, n_clients, method, alpha, seed):
@@ -239,6 +248,77 @@ def load_data(dataset: str,
         return _make(x_tr, y_tr, xt, yt, idx_map, bs, n_classes,
                      max_batches_per_client, None, seed, synthetic=synth)
 
+    if dataset == "imagenet":
+        # reference ImageNet/data_loader.py:1-300 (per-client index maps over
+        # ILSVRC2012).  Synthetic stand-in uses 64×64 (memory-sane shape
+        # proxy; the loader path and partition semantics are identical).
+        try:
+            x_tr, y_tr, xt, yt = readers.read_image_folder(data_dir)
+            synth = False
+            idx_map = _partition(y_tr, C, partition_method, partition_alpha,
+                                 seed)
+        except FileNotFoundError:
+            synth = True
+            n = sc(4000)
+            x, y = synthetic.synthetic_classification_images(
+                n, (64, 64), 3, 1000, seed=seed)
+            n_te = n // 5
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = _partition(y_tr, C, "homo", partition_alpha, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, 1000,
+                     max_batches_per_client, None, seed, synthetic=synth)
+
+    if dataset in ("gld23k", "gld160k"):
+        # Google Landmarks federated split (Landmarks/data_loader.py:1-285):
+        # natural per-user partition from the CSV mapping.
+        n_classes = spec.class_num
+        try:
+            split_csv = ("mini_gld_train_split.csv" if dataset == "gld23k"
+                         else "federated_train.csv")
+            x_tr, y_tr, idx_map = readers.read_landmarks_csv(
+                data_dir, split_csv)
+            test_csv = ("mini_gld_test.csv" if dataset == "gld23k"
+                        else "test.csv")
+            xt, yt, _ = readers.read_landmarks_csv(data_dir, test_csv)
+            synth = False
+        except FileNotFoundError:
+            synth = True
+            n = sc(23080 if dataset == "gld23k" else 164172)
+            x, y = synthetic.synthetic_classification_images(
+                n, (64, 64), 3, n_classes, seed=seed)
+            n_te = n // 8
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = _partition(y_tr, C, "power_law", partition_alpha, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, n_classes,
+                     max_batches_per_client, None, seed, synthetic=synth)
+
+    if dataset in _TABULAR_DIMS:
+        # UCI SUSY / Room-Occupancy streaming tabular tasks for the
+        # decentralized online learners (UCI/data_loader_for_susy_and_ro.py).
+        dim = _TABULAR_DIMS[dataset]
+        fname = {"susy": "SUSY.csv",
+                 "room_occupancy": "datatraining.txt"}[dataset]
+        try:
+            if dataset == "susy":
+                label_col, feat_cols, hdr = 0, None, False
+            else:   # datatraining.txt: "id","date",T,H,Light,CO2,HR,Occupancy
+                label_col, feat_cols, hdr = -1, [2, 3, 4, 5, 6], True
+            x, y = readers.read_csv_tabular(
+                os.path.join(data_dir or "", fname), label_col=label_col,
+                feature_cols=feat_cols, skip_header=hdr)
+            synth = False
+        except FileNotFoundError:
+            synth = True
+            x, y = synthetic.synthetic_tabular(sc(20000), dim, seed=seed)
+        n_te = len(y) // 8
+        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+        # standardize with TRAIN statistics only (no test leakage)
+        mu, sd = x_tr.mean(axis=0), x_tr.std(axis=0) + 1e-8
+        x_tr, xt = (x_tr - mu) / sd, (xt - mu) / sd
+        idx_map = _partition(y_tr, C, "homo", partition_alpha, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, 2,
+                     max_batches_per_client, None, seed, synthetic=synth)
+
     if dataset.startswith("synthetic_"):
         ab = dataset.split("_")[1:]
         alpha, beta = float(ab[0]), float(ab[1])
@@ -254,3 +334,39 @@ def load_data(dataset: str,
                      max_batches_per_client, None, seed)
 
     raise ValueError(f"unknown dataset {dataset!r}")
+
+
+# ---------------------------------------------------------------------------
+# Vertical-FL datasets: party-split features over shared samples
+# ---------------------------------------------------------------------------
+
+# (total feature dim, default per-party split) — reference NUS_WIDE
+# (634 image features + 1000 text tags, nus_wide_dataset.py:1-260) and
+# lending_club (lending_club_loan/, guest/host feature columns)
+_VFL_SPECS = {
+    "nus_wide": (1634, (634, 1000)),
+    "lending_club": (60, (30, 30)),
+}
+
+
+def load_vfl_data(dataset: str, data_dir: Optional[str] = None,
+                  n_samples: int = 4000, seed: int = 0):
+    """Load a vertical-FL task: returns (x [n, D], y [n] binary,
+    feature_splits) where feature_splits[p] is party p's slice width
+    (guest = party 0).  Real CSVs when present, synthetic stand-in
+    otherwise — the VFLEngine consumes either identically."""
+    if dataset not in _VFL_SPECS:
+        raise ValueError(f"unknown VFL dataset {dataset!r}; "
+                         f"known: {sorted(_VFL_SPECS)}")
+    dim, splits = _VFL_SPECS[dataset]
+    try:
+        fname = {"nus_wide": "nus_wide_features.csv",
+                 "lending_club": "loan_processed.csv"}[dataset]
+        x, y = readers.read_csv_tabular(
+            os.path.join(data_dir or "", fname), label_col=-1)
+        y = (y > 0).astype(np.int64)
+    except FileNotFoundError:
+        x, y = synthetic.synthetic_tabular(n_samples, dim, seed=seed)
+    mu, sd = x.mean(axis=0), x.std(axis=0) + 1e-8
+    x = (x - mu) / sd
+    return x.astype(np.float32), y, list(splits)

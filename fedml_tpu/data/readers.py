@@ -118,3 +118,55 @@ def read_image_folder(data_dir: str, splits=("train", "test"),
                 ys.append(ci)
         out += [np.stack(xs), np.asarray(ys, np.int64)]
     return tuple(out)
+
+
+def read_landmarks_csv(data_dir: str, split_csv: str, image_dir: str = "images",
+                       hw: int = 64):
+    """Google Landmarks federated CSV split (reference
+    Landmarks/data_loader.py:1-285): rows of (user_id, image_id, class).
+    Returns (x, y, net_dataidx_map) with images resized to hw×hw."""
+    import csv
+    path = os.path.join(data_dir, split_csv)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    from PIL import Image
+    rows = []
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            rows.append((row["user_id"], row["image_id"], int(row["class"])))
+    xs, ys, idx_map = [], [], {}
+    users = sorted({u for u, _, _ in rows})
+    uid_of = {u: i for i, u in enumerate(users)}
+    for u, image_id, cls in rows:
+        p = os.path.join(data_dir, image_dir, f"{image_id}.jpg")
+        try:
+            with Image.open(p) as im:
+                im = im.convert("RGB").resize((hw, hw))
+                xs.append(np.asarray(im, np.float32) / 255.0)
+        except FileNotFoundError as e:
+            # the split CSV exists, so the dataset IS present — a missing
+            # image is a partial download, not "fall back to synthetic"
+            raise RuntimeError(
+                f"landmarks dataset is partially downloaded: {p}") from e
+        idx_map.setdefault(uid_of[u], []).append(len(ys))
+        ys.append(cls)
+    return (np.stack(xs), np.asarray(ys, np.int64),
+            {k: np.asarray(v) for k, v in idx_map.items()})
+
+
+def read_csv_tabular(path: str, label_col: int, feature_cols=None,
+                     skip_header: bool = True, max_rows: Optional[int] = None):
+    """Plain-CSV tabular reader (UCI SUSY / Room-Occupancy / lending-club,
+    reference UCI/data_loader_for_susy_and_ro.py:1-143).  Returns
+    (x float32 [n,d], y int64 [n])."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    data = np.genfromtxt(path, delimiter=",",
+                         skip_header=1 if skip_header else 0,
+                         max_rows=max_rows)
+    y = data[:, label_col].astype(np.int64)
+    if feature_cols is None:
+        feature_cols = [c for c in range(data.shape[1]) if c != label_col]
+    x = data[:, feature_cols].astype(np.float32)
+    x = np.nan_to_num(x)
+    return x, y

@@ -77,3 +77,13 @@ def synthetic_multilabel(n: int, dim: int, n_tags: int, seed: int = 0):
     logits = x @ proj
     y = (logits > np.percentile(logits, 90, axis=1, keepdims=True)).astype(np.float32)
     return x, y
+
+
+def synthetic_tabular(n: int, dim: int, seed: int = 0, n_classes: int = 2):
+    """Gaussian-blob tabular task (UCI SUSY / room-occupancy / lending-club
+    stand-in): linearly separable with noise, so accuracy climbs."""
+    rng = np.random.RandomState(seed)
+    w = rng.normal(0, 1, (dim, n_classes)).astype(np.float32)
+    x = rng.normal(0, 1, (n, dim)).astype(np.float32)
+    y = np.argmax(x @ w + rng.normal(0, 0.5, (n, n_classes)), axis=1)
+    return x, y.astype(np.int64)

@@ -11,6 +11,8 @@ from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
 from fedml_tpu.models.resnet_gn import ResNet18GN
 from fedml_tpu.models.resnet_cifar import resnet20, resnet32, resnet44, resnet56
 from fedml_tpu.models.mobilenet import MobileNetV1
+from fedml_tpu.models.mobilenet_v3 import MobileNetV3
+from fedml_tpu.models.efficientnet import EfficientNet
 from fedml_tpu.models.vgg import VGG11, VGG16
 
 
@@ -36,6 +38,15 @@ def create_model(model_name: str, output_dim: int, input_dim: int | None = None,
         return resnet20(num_classes=output_dim, **kw)
     if name == "mobilenet":
         return MobileNetV1(num_classes=output_dim, **kw)
+    if name == "mobilenet_v3":
+        return MobileNetV3(num_classes=output_dim, **kw)
+    if name.startswith("efficientnet"):     # efficientnet-b0 .. -b7
+        variant = name.rsplit("-", 1)[-1] if "-" in name else "b0"
+        return EfficientNet(num_classes=output_dim, variant=variant, **kw)
+    if name == "darts":
+        from fedml_tpu.models.darts import DARTS_V2, DartsNetwork
+        return DartsNetwork(num_classes=output_dim,
+                            genotype=kw.pop("genotype", DARTS_V2), **kw)
     if name in ("vgg11",):
         return VGG11(num_classes=output_dim, **kw)
     if name in ("vgg16",):

@@ -1,0 +1,113 @@
+"""EfficientNet B0–B7 (reference fedml_api/model/cv/efficientnet.py, 404 LoC
++ efficientnet_utils.py, 584 LoC torch).
+
+MBConv (inverted residual + SE + swish) trunk with the published
+width/depth/resolution compound-scaling coefficients.  TPU-first choices:
+NHWC layout, `nn.swish` (the native silu XLA fuses), stochastic depth as a
+per-example bernoulli on the residual branch (the reference's
+drop-connect, efficientnet_utils.py `drop_connect`).
+CIFAR-sized stride-1 stem by default; `imagenet_stem=True` for 224 inputs.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# (width_mult, depth_mult, resolution, dropout) — published B0-B7 scaling
+PARAMS = {
+    "b0": (1.0, 1.0, 224, 0.2), "b1": (1.0, 1.1, 240, 0.2),
+    "b2": (1.1, 1.2, 260, 0.3), "b3": (1.2, 1.4, 300, 0.3),
+    "b4": (1.4, 1.8, 380, 0.4), "b5": (1.6, 2.2, 456, 0.4),
+    "b6": (1.8, 2.6, 528, 0.5), "b7": (2.0, 3.1, 600, 0.5),
+}
+
+# (expand, channels, repeats, stride, kernel) — the B0 base architecture
+_BASE = [
+    (1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3), (6, 112, 3, 1, 5), (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+]
+
+
+def _round_filters(f: int, wm: float, divisor: int = 8) -> int:
+    f = f * wm
+    new_f = max(divisor, int(f + divisor / 2) // divisor * divisor)
+    if new_f < 0.9 * f:
+        new_f += divisor
+    return int(new_f)
+
+
+def _round_repeats(r: int, dm: float) -> int:
+    return int(math.ceil(dm * r))
+
+
+class MBConv(nn.Module):
+    expand: int
+    out_ch: int
+    stride: int
+    kernel: int
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3)
+        inp = x.shape[-1]
+        mid = inp * self.expand
+        h = x
+        if self.expand != 1:
+            h = nn.swish(norm()(nn.Conv(mid, (1, 1), use_bias=False)(h)))
+        h = nn.Conv(mid, (self.kernel, self.kernel), strides=self.stride,
+                    padding="SAME", feature_group_count=mid,
+                    use_bias=False)(h)
+        h = nn.swish(norm()(h))
+        # squeeze-excite at 0.25 of the INPUT channels (reference semantics)
+        s = jnp.mean(h, axis=(1, 2))
+        s = nn.swish(nn.Dense(max(1, inp // 4))(s))
+        s = nn.sigmoid(nn.Dense(mid)(s))
+        h = h * s[:, None, None, :]
+        h = norm()(nn.Conv(self.out_ch, (1, 1), use_bias=False)(h))
+        if self.stride == 1 and inp == self.out_ch:
+            if train and self.drop_rate > 0.0:    # drop-connect
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(rng, keep, (h.shape[0], 1, 1, 1))
+                h = h * mask.astype(h.dtype) / keep
+            h = h + x
+        return h
+
+
+class EfficientNet(nn.Module):
+    num_classes: int = 10
+    variant: str = "b0"
+    drop_connect_rate: float = 0.2
+    imagenet_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        wm, dm, _res, dropout = PARAMS[self.variant]
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3)
+        stem_stride = 2 if self.imagenet_stem else 1
+        x = nn.Conv(_round_filters(32, wm), (3, 3), strides=stem_stride,
+                    padding="SAME", use_bias=False)(x)
+        x = nn.swish(norm()(x))
+        blocks = [(e, _round_filters(c, wm), _round_repeats(r, dm), s, k)
+                  for e, c, r, s, k in _BASE]
+        total = sum(r for _, _, r, _, _ in blocks)
+        idx = 0
+        for expand, ch, repeats, stride, kernel in blocks:
+            for i in range(repeats):
+                dr = self.drop_connect_rate * idx / total
+                x = MBConv(expand, ch, stride if i == 0 else 1, kernel,
+                           drop_rate=dr)(x, train)
+                idx += 1
+        x = nn.swish(norm()(nn.Conv(_round_filters(1280, wm), (1, 1),
+                                    use_bias=False)(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,121 @@
+"""MobileNetV3 (reference fedml_api/model/cv/mobilenet_v3.py, 257 LoC torch).
+
+Inverted-residual bottlenecks with squeeze-excite and hard-swish, in the
+published Large/Small configurations.  CIFAR-sized stem (stride 1) to match
+the reference's cross-silo CIFAR usage; pass `imagenet_stem=True` for the
+224×224 stride-2 stem.  NHWC; depthwise = feature_group_count convolution.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def hard_swish(x):
+    return x * hard_sigmoid(x)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcite(nn.Module):
+    reduce_ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(_make_divisible(self.reduce_ch))(s))
+        s = hard_sigmoid(nn.Dense(c)(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    """expand (1×1) → depthwise (k×k, stride) → [SE] → project (1×1)."""
+    kernel: int
+    exp_ch: int
+    out_ch: int
+    use_se: bool
+    use_hs: bool
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5)
+        act = hard_swish if self.use_hs else nn.relu
+        inp = x.shape[-1]
+        h = x
+        if self.exp_ch != inp:
+            h = act(norm()(nn.Conv(self.exp_ch, (1, 1), use_bias=False)(h)))
+        h = nn.Conv(self.exp_ch, (self.kernel, self.kernel),
+                    strides=self.stride, padding="SAME",
+                    feature_group_count=self.exp_ch, use_bias=False)(h)
+        h = act(norm()(h))
+        if self.use_se:
+            h = SqueezeExcite(self.exp_ch // 4)(h)
+        h = norm()(nn.Conv(self.out_ch, (1, 1), use_bias=False)(h))
+        if self.stride == 1 and inp == self.out_ch:
+            h = h + x
+        return h
+
+
+# (kernel, exp, out, SE, HS, stride) — the published V3 configurations
+_LARGE = [
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1),
+]
+_SMALL = [
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1),
+]
+
+
+class MobileNetV3(nn.Module):
+    num_classes: int = 10
+    mode: str = "large"            # "large" | "small"
+    width_mult: float = 1.0
+    dropout: float = 0.2
+    imagenet_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = _LARGE if self.mode == "large" else _SMALL
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5)
+        wm = self.width_mult
+        stem_stride = 2 if self.imagenet_stem else 1
+        x = nn.Conv(_make_divisible(16 * wm), (3, 3), strides=stem_stride,
+                    padding="SAME", use_bias=False)(x)
+        x = hard_swish(norm()(x))
+        for k, exp, out, se, hs, s in cfg:
+            x = InvertedResidual(k, _make_divisible(exp * wm),
+                                 _make_divisible(out * wm), se, hs, s)(
+                                     x, train)
+        last = _make_divisible((960 if self.mode == "large" else 576) * wm)
+        x = hard_swish(norm()(nn.Conv(last, (1, 1), use_bias=False)(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        x = hard_swish(nn.Dense(1280 if self.mode == "large" else 1024)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,16 @@
+"""Pallas TPU kernels for the server-side aggregation hot path.
+
+The reference's server aggregation is a Python loop over state_dict keys on
+CPU (FedAVGAggregator.py:59-88); XLA already turns our tree-level weighted
+mean into fused HBM-bandwidth kernels, and these pallas kernels go one step
+further: the entire cohort aggregation — including the robust norm-clip
+pipeline — runs as a single pass over the stacked client weights in VMEM
+tiles, with the reduction on the MXU.
+"""
+from fedml_tpu.ops.aggregate import (flatten_stacked_tree,
+                                     robust_weighted_mean_pallas,
+                                     unflatten_to_tree,
+                                     weighted_mean_pallas)
+
+__all__ = ["weighted_mean_pallas", "robust_weighted_mean_pallas",
+           "flatten_stacked_tree", "unflatten_to_tree"]

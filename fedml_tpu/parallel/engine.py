@@ -145,31 +145,14 @@ class MeshFedAvgEngine(FedAvgEngine):
         ids = np.concatenate([ids, np.zeros(pad, ids.dtype)])
         return jnp.asarray(ids), jnp.asarray(wmask)
 
-    def run(self, variables: Optional[Pytree] = None,
-            rounds: Optional[int] = None) -> Pytree:
-        cfg = self.cfg
-        variables = variables if variables is not None else self.init_variables()
-        variables = jax.device_put(variables, replicated_sharding(self.mesh))
-        server_state = self.server_init(variables)
-        rng = jax.random.PRNGKey(cfg.seed + 1)
-        rounds = rounds if rounds is not None else cfg.comm_round
+    # the base FedAvgEngine.run drives the loop through these two hooks
+    def _prepare_variables(self, variables: Pytree) -> Pytree:
+        return jax.device_put(variables, replicated_sharding(self.mesh))
+
+    def _round_args(self, round_idx: int) -> tuple:
         stack, stack_w = self._device_stack()
-        for round_idx in range(rounds):
-            t0 = time.time()
-            ids, wmask = self.sample_padded(round_idx)
-            rng, round_rng = jax.random.split(rng)
-            variables, server_state, m = self.round_fn(
-                variables, server_state, stack, stack_w, ids, wmask,
-                round_rng)
-            if (round_idx % cfg.frequency_of_the_test == 0
-                    or round_idx == rounds - 1):
-                stats = self.evaluate(variables)
-                stats.update(round=round_idx,
-                             train_loss=float(m["train_loss"]),
-                             round_time=time.time() - t0)
-                self.metrics_history.append(stats)
-                log.info("round %d: %s", round_idx, stats)
-        return variables
+        ids, wmask = self.sample_padded(round_idx)
+        return (stack, stack_w, ids, wmask)
 
 
 class MeshFedProxEngine(MeshFedAvgEngine):

@@ -1,1 +1,5 @@
 from fedml_tpu.utils.config import FedConfig
+from fedml_tpu.utils.metrics import RunLogger
+from fedml_tpu.utils.profiling import StepTimer, annotate, trace
+
+__all__ = ["FedConfig", "RunLogger", "StepTimer", "annotate", "trace"]

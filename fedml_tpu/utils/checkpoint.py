@@ -1,0 +1,83 @@
+"""Round-level checkpoint/resume via orbax.
+
+The reference has essentially no FL-state checkpointing (SURVEY.md §5:
+FedGKT saves a server .pth.tar, DARTS saves genotypes, nothing resumes a
+round).  Here any engine's (variables, server_state, round_idx) checkpoints
+atomically every N rounds and training resumes exactly — the deterministic
+per-round client sampler (np.random.seed(round_idx)) makes a resumed run
+bitwise-identical to an uninterrupted one.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:                      # pragma: no cover
+    _HAVE_ORBAX = False
+
+
+class FedCheckpointManager:
+    """Save/restore (round_idx, variables, server_state) under `directory`.
+
+    Thin wrapper over orbax's CheckpointManager: keeps `max_to_keep`
+    newest rounds, atomic renames, async-safe.  `server_state` may be any
+    pytree (optax states included); restore needs the matching template
+    structure, which every engine can produce via server_init."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        if not _HAVE_ORBAX:
+            raise RuntimeError("orbax is not available in this environment")
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, round_idx: int, variables: Pytree,
+             server_state: Pytree = ()) -> None:
+        state = {"variables": variables,
+                 "server_state": _wrap_empty(server_state)}
+        self._mgr.save(round_idx, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_round(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, variables_template: Pytree,
+                server_state_template: Pytree = (),
+                round_idx: Optional[int] = None):
+        """Returns (round_idx, variables, server_state); templates define
+        the pytree structure/dtypes (pass engine.init_variables() /
+        engine.server_init(v))."""
+        step = round_idx if round_idx is not None else self.latest_step_or_raise()
+        template = {"variables": variables_template,
+                    "server_state": _wrap_empty(server_state_template)}
+        out = self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        return step, out["variables"], _unwrap_empty(out["server_state"])
+
+    def latest_step_or_raise(self) -> int:
+        step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return step
+
+    def close(self):
+        self._mgr.close()
+
+
+def _wrap_empty(tree: Pytree):
+    # orbax rejects totally-empty pytrees (e.g. FedAvg's () server state);
+    # carry a sentinel leaf alongside
+    return {"state": tree, "_nonempty": np.zeros((1,), np.int32)}
+
+
+def _unwrap_empty(wrapped):
+    return wrapped["state"]

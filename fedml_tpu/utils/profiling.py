@@ -1,0 +1,57 @@
+"""Profiling/tracing — first-class replacement for the reference's coarse
+wall-clock timers (SURVEY.md §5: aggregation timers FedAVGAggregator.py:60,
+TRPC latency microbench).
+
+`trace(dir)` captures a full XLA/TPU profile viewable in TensorBoard or
+Perfetto; `annotate(name)` scopes a named region inside it; `StepTimer`
+gives the reference-style wall-clock numbers (rounds/sec, per-phase means)
+without any profiler overhead.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler trace of everything inside the block (device + host)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (shows up on the TraceMe timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Accumulates wall-clock per named phase; blocking-safe (call `stop`
+    after block_until_ready for honest device timings)."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+        self._open: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def mean(self, name: str) -> float:
+        return self.totals[name] / max(self.counts[name], 1)
+
+    def report(self) -> dict[str, float]:
+        return {f"{k}_mean_s": self.mean(k) for k in self.totals}

@@ -1,0 +1,85 @@
+"""Aux subsystems: orbax checkpoint/resume, run logger, step timer."""
+import json
+import os
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms import FedAvgEngine, FedOptEngine
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.models import create_model
+from fedml_tpu.utils.checkpoint import FedCheckpointManager
+from fedml_tpu.utils.config import FedConfig
+from fedml_tpu.utils.metrics import RunLogger
+from fedml_tpu.utils.profiling import StepTimer
+from tests.test_fednas import tiny_data
+
+
+def make_engine(cls=FedAvgEngine, **cfg_kw):
+    cfg = FedConfig(client_num_in_total=3, client_num_per_round=2,
+                    comm_round=4, epochs=1, batch_size=4, lr=0.1,
+                    frequency_of_the_test=1, **cfg_kw)
+    data = tiny_data(n_clients=3, bs=4, hw=8)
+    return cls(ClientTrainer(create_model("lr", 10), lr=0.1), data, cfg,
+               donate=False)
+
+
+def test_checkpoint_resume_bitwise(tmp_path):
+    """Run 4 rounds straight vs 2 rounds + checkpoint + resume: identical
+    final variables (fold_in rngs + per-round sampler reseed)."""
+    e1 = make_engine()
+    v_straight = e1.run(rounds=4)
+
+    ck = FedCheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    e2 = make_engine()
+    e2.run(rounds=2, ckpt=ck, ckpt_every=1)
+    assert ck.latest_round() == 1
+    e3 = make_engine()
+    v_resumed = e3.run(rounds=4, ckpt=ck, resume=True)
+    for a, b in zip(jax.tree.leaves(v_straight), jax.tree.leaves(v_resumed)):
+        np.testing.assert_allclose(a, b, rtol=0, atol=0)
+    ck.close()
+
+
+def test_checkpoint_nontrivial_server_state(tmp_path):
+    """FedOpt's optax server state round-trips through orbax."""
+    ck = FedCheckpointManager(str(tmp_path / "ck2"))
+    e = make_engine(FedOptEngine, server_optimizer="adam", server_lr=0.01)
+    e.run(rounds=2, ckpt=ck, ckpt_every=2)
+    e2 = make_engine(FedOptEngine, server_optimizer="adam", server_lr=0.01)
+    v0 = e2.init_variables()
+    rd, v, ss = ck.restore(v0, e2.server_init(v0))
+    assert rd == 1
+    assert jax.tree.structure(ss) == jax.tree.structure(e2.server_init(v0))
+    ck.close()
+
+
+def test_run_logger_summary_contract(tmp_path):
+    lg = RunLogger(root=str(tmp_path), project="p", name="r1")
+    lg.log({"test_acc": 0.5, "train_loss": 1.0}, step=0)
+    lg.log({"test_acc": 0.9}, step=1)
+    lg.finish()
+    summary = RunLogger.read_summary(lg.dir)
+    assert summary["test_acc"] == 0.9       # last value wins
+    assert summary["train_loss"] == 1.0
+    lines = open(os.path.join(lg.dir, "history.jsonl")).read().splitlines()
+    assert len(lines) == 2 and json.loads(lines[1])["_step"] == 1
+
+
+def test_engine_logs_to_logger(tmp_path):
+    lg = RunLogger(root=str(tmp_path), project="p", name="r2")
+    e = make_engine()
+    e.run(rounds=2, logger=lg)
+    lg.finish()
+    s = RunLogger.read_summary(lg.dir)
+    assert "test_acc" in s and s["round"] == 1
+
+
+def test_step_timer():
+    t = StepTimer()
+    with t.phase("train"):
+        pass
+    with t.phase("train"):
+        pass
+    assert t.counts["train"] == 2
+    assert "train_mean_s" in t.report()

@@ -1,0 +1,54 @@
+"""Unified launcher smoke tests (the reference's CI-script-fedavg.sh runs
+standalone mains on tiny configs; same idea through the one CLI)."""
+import json
+import os
+
+import pytest
+
+from fedml_tpu.cli import main
+
+COMMON = ["--synthetic_scale", "0.001", "--client_num_in_total", "4",
+          "--client_num_per_round", "4", "--comm_round", "2",
+          "--batch_size", "4", "--frequency_of_the_test", "1"]
+
+
+def run_cli(tmp_path, *extra):
+    rc = main([*COMMON, "--run_dir", str(tmp_path), "--run_name", "t",
+               *extra])
+    assert rc == 0
+    summary = json.load(
+        open(os.path.join(tmp_path, "fedml_tpu", "t", "summary.json")))
+    return summary
+
+
+def test_cli_fedavg_mnist(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--lr", "0.1")
+    assert "test_acc" in s
+
+
+def test_cli_fedopt(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "fedopt", "--dataset", "mnist",
+                "--model", "lr", "--server_optimizer", "adam",
+                "--server_lr", "0.01")
+    assert "test_acc" in s
+
+
+def test_cli_hierarchical(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "hierarchical", "--dataset", "mnist",
+                "--model", "lr", "--group_num", "2")
+    assert "test_acc" in s
+
+
+def test_cli_vfl(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "vfl", "--dataset", "lending_club")
+    assert "train_acc" in s
+
+
+def test_cli_checkpointing(tmp_path):
+    run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+            "--model", "lr", "--ckpt_dir", str(tmp_path / "ck"),
+            "--ckpt_every", "1")
+    assert os.path.isdir(tmp_path / "ck")
+    run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+            "--model", "lr", "--ckpt_dir", str(tmp_path / "ck"), "--resume")

@@ -1,0 +1,54 @@
+"""Model zoo shape checks (reference has only cv/test_cnn.py, a 13-LoC
+shape test; here every factory entry gets one)."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedml_tpu.models import create_model
+
+IMG32 = (2, 32, 32, 3)
+IMG28 = (2, 28, 28, 1)
+
+
+def _forward(model, shape, train=False, **init_kw):
+    x = jnp.zeros(shape, jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False, **init_kw)
+    if train:
+        out = model.apply(variables, x, train=True,
+                          rngs={"dropout": jax.random.PRNGKey(1)},
+                          mutable=["batch_stats"])
+        return out[0]
+    return model.apply(variables, x, train=False)
+
+
+@pytest.mark.parametrize("name,shape,classes", [
+    ("mobilenet_v3", IMG32, 10),
+    ("efficientnet-b0", IMG32, 10),
+])
+def test_new_cv_models_forward(name, shape, classes):
+    logits = _forward(create_model(name, classes), shape)
+    assert logits.shape == (shape[0], classes)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+def test_mobilenet_v3_small_mode():
+    m = create_model("mobilenet_v3", 10, mode="small")
+    logits = _forward(m, IMG32)
+    assert logits.shape == (2, 10)
+
+
+def test_efficientnet_train_mode_with_drop_connect():
+    m = create_model("efficientnet-b0", 10)
+    logits = _forward(m, IMG32, train=True)
+    assert logits.shape == (2, 10)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+def test_efficientnet_variant_scaling():
+    from fedml_tpu.models.efficientnet import PARAMS
+    assert set(PARAMS) == {f"b{i}" for i in range(8)}
+
+
+def test_factory_rejects_unknown():
+    with pytest.raises(ValueError):
+        create_model("no_such_model", 10)
