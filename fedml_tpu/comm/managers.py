@@ -30,7 +30,19 @@ def _build_backend(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
         from fedml_tpu.comm.grpc_backend import GrpcBackend
         return GrpcBackend(rank, kw["ip_config"],
                            base_port=kw.get("base_port", 50000))
+    if b == "NATIVE_TCP":
+        # explicit selection may compile the library on first use
+        from fedml_tpu.comm.native_tcp import NativeTcpBackend
+        return NativeTcpBackend(rank, kw["ip_config"],
+                                kw.get("base_port", 52000))
     if b == "TCP":
+        # auto-upgrade to the native transport only when the .so is already
+        # built (never run a compile inside backend construction)
+        from fedml_tpu.native import library_built
+        if library_built() and not kw.pop("force_python_tcp", False):
+            from fedml_tpu.comm.native_tcp import NativeTcpBackend
+            return NativeTcpBackend(rank, kw["ip_config"],
+                                    kw.get("base_port", 52000))
         from fedml_tpu.comm.tcp_backend import TcpBackend
         return TcpBackend(rank, kw["ip_config"],
                           base_port=kw.get("base_port", 52000))

@@ -1,0 +1,112 @@
+"""NativeTcpBackend — the C++ transport behind the comm interface.
+
+Same wire format and constructor as the pure-Python TcpBackend (its
+behavioral spec): 8-byte LE length ‖ MessageCodec frame.  Socket accept,
+framing, and the inbound queue live in native threads
+(fedml_tpu/native/fedml_host.cpp); Python only decodes Messages — so the
+GIL never gates frame reassembly, the reference's known chokepoint (its
+comm daemons are Python threads, mpi_receive_thread.py:19-28).
+
+Falls back is the caller's job: `native_available()` says whether the
+library loaded; managers select backend "NATIVE_TCP" explicitly or "TCP"
+picks native automatically when present.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+from typing import Union
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.native import load_library
+
+log = logging.getLogger(__name__)
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+class NativeTcpBackend(BaseCommManager):
+    def __init__(self, rank: int, ip_config: Union[str, dict],
+                 base_port: int = 52000):
+        super().__init__()
+        from fedml_tpu.comm.grpc_backend import load_ip_config
+        self._lib = load_library()
+        if self._lib is None:
+            raise RuntimeError("native transport unavailable (no g++?)")
+        self.rank = rank
+        self.ip_config = load_ip_config(ip_config)
+        self.base_port = base_port
+        self._server = self._lib.fh_server_create(base_port + rank)
+        if not self._server:
+            raise OSError(f"cannot listen on port {base_port + rank}")
+        self._conns: dict[int, int] = {}
+        self._conn_lock = threading.Lock()
+        self._alive = True
+        self._drain = threading.Thread(target=self._drain_loop, daemon=True)
+        self._drain.start()
+
+    def _drain_loop(self) -> None:
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        length = ctypes.c_long()
+        while self._alive:
+            rc = self._lib.fh_recv(self._server, ctypes.byref(buf),
+                                   ctypes.byref(length), 200)
+            if rc == -2:          # server closed
+                return
+            if rc != 0:           # timeout — re-check aliveness
+                continue
+            try:
+                payload = ctypes.string_at(buf, length.value)
+            finally:
+                self._lib.fh_buf_free(buf)
+            try:
+                self._on_message(MessageCodec.decode(payload))
+            except Exception:     # malformed frame: drop, keep serving
+                log.exception("undecodable frame (%d bytes)", length.value)
+
+    def _connect_locked(self, receiver: int):
+        c = self._conns.get(receiver)
+        if c is None:
+            host = self.ip_config[receiver].encode()
+            c = self._lib.fh_connect(host, self.base_port + receiver)
+            if not c:
+                raise ConnectionError(
+                    f"cannot reach rank {receiver} at "
+                    f"{self.ip_config[receiver]}:{self.base_port + receiver}")
+            self._conns[receiver] = c
+        return c
+
+    def send_message(self, msg: Message) -> None:
+        payload = MessageCodec.encode(msg)
+        rx = msg.get_receiver_id()
+        # the whole connect+send (and the dead-connection retry) runs under
+        # _conn_lock, like the pure-Python spec's sendall — so a failing
+        # sender can never fh_conn_close a handle another thread is using
+        with self._conn_lock:
+            conn = self._connect_locked(rx)
+            if self._lib.fh_send(conn, payload, len(payload)) != 0:
+                stale = self._conns.pop(rx, None)
+                if stale is not None:
+                    self._lib.fh_conn_close(stale)
+                conn = self._connect_locked(rx)
+                if self._lib.fh_send(conn, payload, len(payload)) != 0:
+                    raise ConnectionError(f"send to rank {rx} failed")
+
+    def close(self) -> None:
+        if not self._alive:
+            return
+        self._alive = False
+        with self._conn_lock:
+            for c in self._conns.values():
+                self._lib.fh_conn_close(c)
+            self._conns.clear()
+        # the drain thread may be inside fh_recv on the Server's condvar —
+        # it must exit (≤200 ms timeout tick) BEFORE fh_server_close deletes
+        # the Server, or the wait is a use-after-free
+        self._drain.join(timeout=5)
+        self._lib.fh_server_close(self._server)
+        self._server = None

@@ -1,0 +1,72 @@
+"""Native (C++) components — build + ctypes loading.
+
+`load_library()` returns the ctypes handle for libfedml_host.so, compiling
+it with g++ on first use (cached beside the source).  Returns None when no
+toolchain is available; callers fall back to the pure-Python paths.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libfedml_host.so")
+_SRC = os.path.join(_DIR, "fedml_host.cpp")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.fh_server_create.restype = ctypes.c_void_p
+    lib.fh_server_create.argtypes = [ctypes.c_int]
+    lib.fh_recv.restype = ctypes.c_int
+    lib.fh_recv.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                            ctypes.POINTER(ctypes.c_long), ctypes.c_int]
+    lib.fh_buf_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+    lib.fh_connect.restype = ctypes.c_void_p
+    lib.fh_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.fh_send.restype = ctypes.c_int
+    lib.fh_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
+    lib.fh_conn_close.argtypes = [ctypes.c_void_p]
+    lib.fh_server_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def library_built() -> bool:
+    """True iff the .so already exists — cheap check, never compiles."""
+    return os.path.exists(_SO)
+
+
+def load_library():
+    """Build (once) and load the native transport; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread",
+                     "-Wall", "-shared", "-o", _SO, _SRC],
+                    check=True, capture_output=True, text=True, timeout=120)
+                log.info("built %s", _SO)
+            except (OSError, subprocess.SubprocessError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                log.warning("native transport build failed: %s", detail)
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_SO))
+        except OSError as e:
+            log.warning("native transport load failed: %s", e)
+            _lib = None
+        return _lib
