@@ -72,7 +72,8 @@ def main() -> None:
         test_client_shards=None, class_num=10, synthetic=True)
 
     model = create_model("resnet18_gn", output_dim=10)
-    trainer = ClientTrainer(model, lr=cfg.lr)
+    # bf16 compute / f32 masters: the MXU fast path (core/trainer.py)
+    trainer = ClientTrainer(model, lr=cfg.lr, train_dtype=jnp.bfloat16)
     mesh = make_mesh()
     engine = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh)
 

@@ -57,6 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ci", type=int, default=0)
     p.add_argument("--synthetic_scale", type=float, default=1.0)
+    p.add_argument("--train_dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
     p.add_argument("--max_batches_per_client", type=int, default=None)
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
     p.add_argument("--mesh", action="store_true",
@@ -91,16 +93,18 @@ def _load(cfg: FedConfig):
 
 
 def _trainer(cfg: FedConfig, data):
+    import jax.numpy as jnp
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.models import create_model
     loss = "bce" if cfg.dataset == "stackoverflow_lr" else "ce"
     has_time = cfg.dataset in ("shakespeare", "fed_shakespeare",
                                "stackoverflow_nwp")
     model = create_model(cfg.model, data.class_num)
+    dtype = jnp.bfloat16 if cfg.train_dtype == "bfloat16" else jnp.float32
     return ClientTrainer(model, loss=loss, optimizer=cfg.client_optimizer,
                          lr=cfg.lr, momentum=cfg.momentum,
                          weight_decay=cfg.wd, prox_mu=cfg.prox_mu,
-                         has_time_axis=has_time)
+                         has_time_axis=has_time, train_dtype=dtype)
 
 
 def build_engine(args, cfg: FedConfig, data):

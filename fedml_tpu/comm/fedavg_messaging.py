@@ -44,6 +44,7 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_LOCAL_LOSS = "local_loss"
+    MSG_ARG_KEY_ROUND = "round_idx"
 
 
 def _to_numpy(tree: Pytree) -> Pytree:
@@ -74,16 +75,28 @@ class FedAvgAggregator:
             return all(self.flag_client_model_uploaded)
 
     def aggregate(self) -> Pytree:
+        """Aggregate over every slot that uploaded this round.  With the
+        all-received barrier that is all of them; under a straggler
+        timeout it is the received subset (sample-weighted, so absent
+        clients simply drop out of the mean)."""
         with self._lock:
+            got = [i for i in range(self.worker_num)
+                   if self.flag_client_model_uploaded[i]]
             stacked = jax.tree.map(
                 lambda *xs: np.stack(xs),
-                *[self.model_dict[i] for i in range(self.worker_num)])
-            w = np.asarray([self.sample_num_dict[i]
-                            for i in range(self.worker_num)], np.float32)
+                *[self.model_dict[i] for i in got])
+            w = np.asarray([self.sample_num_dict[i] for i in got],
+                           np.float32)
             self.variables = _to_numpy(
                 tree_weighted_mean(stacked, jnp.asarray(w)))
             self.flag_client_model_uploaded = [False] * self.worker_num
+            self.model_dict.clear()
+            self.sample_num_dict.clear()
             return self.variables
+
+    def received_count(self) -> int:
+        with self._lock:
+            return sum(self.flag_client_model_uploaded)
 
     def client_sampling(self, round_idx: int) -> np.ndarray:
         return self.sampler.sample(round_idx)
@@ -95,12 +108,20 @@ class FedAvgServerManager(ServerManager):
     def __init__(self, aggregator: FedAvgAggregator, comm_round: int,
                  rank: int = 0, size: int = 1, backend: str = "INPROC",
                  on_round_done: Optional[Callable[[int, Pytree], None]] = None,
-                 **kw):
+                 straggler_timeout: Optional[float] = None, **kw):
+        """straggler_timeout: seconds to wait for the full cohort after a
+        round's first upload; then aggregate the received subset and move
+        on.  None = the reference's hang-forever barrier
+        (check_whether_all_receive, FedAVGAggregator.py:50-57)."""
         super().__init__(rank, size, backend, **kw)
         self.aggregator = aggregator
         self.round_num = comm_round
         self.round_idx = 0
         self.on_round_done = on_round_done
+        self.straggler_timeout = straggler_timeout
+        self._round_lock = threading.Lock()
+        self._watchdog: Optional[threading.Timer] = None
+        self.partial_rounds = 0           # observability: timed-out rounds
         self.done = threading.Event()
 
     def send_init_msg(self) -> None:
@@ -114,6 +135,7 @@ class FedAvgServerManager(ServerManager):
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
                        self.aggregator.variables)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, client_idx)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
         self.send_message(msg)
 
     def register_message_receive_handlers(self) -> None:
@@ -123,11 +145,44 @@ class FedAvgServerManager(ServerManager):
 
     def _handle_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
-        all_received = self.aggregator.add_local_trained_result(
-            sender - 1, msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS),
-            msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
-        if not all_received:
-            return
+        upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND)
+        with self._round_lock:
+            if (upload_round is not None
+                    and int(upload_round) != self.round_idx):
+                return    # straggler from a round already closed by timeout
+            all_received = self.aggregator.add_local_trained_result(
+                sender - 1, msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS),
+                msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+            if self.straggler_timeout is not None and self._watchdog is None \
+                    and not all_received:
+                self._arm_watchdog(self.round_idx)
+            if not all_received:
+                return
+            self._finish_round()
+
+    def _arm_watchdog(self, armed_round: int) -> None:
+        self._watchdog = threading.Timer(
+            self.straggler_timeout, self._on_straggler_timeout,
+            args=(armed_round,))
+        self._watchdog.daemon = True
+        self._watchdog.start()
+
+    def _on_straggler_timeout(self, armed_round: int) -> None:
+        with self._round_lock:
+            self._watchdog = None
+            if self.round_idx != armed_round:
+                return                      # round completed normally
+            if self.aggregator.received_count() == 0:
+                self._arm_watchdog(armed_round)   # nothing to aggregate yet
+                return
+            self.partial_rounds += 1
+            self._finish_round()
+
+    def _finish_round(self) -> None:
+        """Aggregate + advance; caller holds _round_lock."""
+        if self._watchdog is not None:
+            self._watchdog.cancel()
+            self._watchdog = None
         self.aggregator.aggregate()
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.aggregator.variables)
@@ -168,6 +223,7 @@ class FedAvgClientManager(ClientManager):
     def _handle_sync(self, msg: Message) -> None:
         variables = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        round_idx = msg.get(MyMessage.MSG_ARG_KEY_ROUND)
         shard = jax.tree.map(lambda a: jnp.asarray(a[client_idx]),
                              self.data.client_shards)
         self._rng, rng = jax.random.split(self._rng)
@@ -178,6 +234,8 @@ class FedAvgClientManager(ClientManager):
         out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
         out.add_params(MyMessage.MSG_ARG_KEY_LOCAL_LOSS, float(loss))
+        if round_idx is not None:       # echo for stale-upload rejection
+            out.add_params(MyMessage.MSG_ARG_KEY_ROUND, int(round_idx))
         self.send_message(out)
 
 
@@ -190,6 +248,7 @@ def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",
 
     worker_num = worker_num or cfg.client_num_per_round
     size = worker_num + 1
+    straggler_timeout = backend_kw.pop("straggler_timeout", None)
     router = backend_kw.pop("router", None)
     if backend.upper() == "INPROC" and router is None:
         router = InProcRouter()
@@ -201,7 +260,8 @@ def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",
                              jnp.asarray(data.client_shards["x"][0, 0]))
     agg = FedAvgAggregator(init_vars, worker_num,
                            cfg.client_num_in_total, worker_num)
-    server = FedAvgServerManager(agg, cfg.comm_round, 0, size, backend, **kw)
+    server = FedAvgServerManager(agg, cfg.comm_round, 0, size, backend,
+                                 straggler_timeout=straggler_timeout, **kw)
     clients = [FedAvgClientManager(trainer, data, cfg.epochs, r, size,
                                    backend, **kw)
                for r in range(1, size)]

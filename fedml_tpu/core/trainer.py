@@ -126,18 +126,38 @@ class ClientTrainer:
     def init_opt(self, variables: Pytree) -> Pytree:
         return self.tx.init(variables["params"])
 
+    # -- mixed precision ----------------------------------------------------
+    def _cast_floats(self, tree, dtype):
+        return jax.tree.map(
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
     # -- loss ---------------------------------------------------------------
     def _loss(self, params, rest, batch, rng, global_params=None):
+        """Masters (params/opt state/stats) stay float32; when train_dtype
+        is bfloat16 the forward/backward compute runs through bf16 casts —
+        the MXU recipe: bf16 matmuls, f32 accumulation and update."""
         x, y, mask = batch["x"], batch["y"], batch["mask"]
         rngs = {"dropout": rng}
-        if rest:
+        half = self.train_dtype != jnp.float32
+        apply_params = self._cast_floats(params, self.train_dtype) if half else params
+        # stats collections (BatchNorm running mean/var) are NOT cast: the
+        # EMA must accumulate on the f32 master or sub-0.4%-ulp increments
+        # vanish on the bf16 grid near convergence
+        apply_rest = rest
+        if half and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(self.train_dtype)
+        if apply_rest:
             logits, new_rest = self.model.apply(
-                {"params": params, **rest}, x, train=True,
-                mutable=list(rest.keys()), rngs=rngs)
+                {"params": apply_params, **apply_rest}, x, train=True,
+                mutable=list(apply_rest.keys()), rngs=rngs)
         else:
-            logits = self.model.apply({"params": params}, x, train=True,
+            logits = self.model.apply({"params": apply_params}, x, train=True,
                                       rngs=rngs)
-            new_rest = rest
+            new_rest = apply_rest
+        if half:
+            logits = logits.astype(jnp.float32)      # loss math in f32
+            new_rest = self._cast_floats(new_rest, jnp.float32)
         if self.has_time_axis and mask.ndim < y.ndim:
             mask = broadcast_mask(mask, y)
         if self.loss_name == "ce":

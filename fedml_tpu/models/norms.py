@@ -1,0 +1,34 @@
+"""Normalization utilities.
+
+Parity: reference cv/batchnorm_utils.py (462 LoC of manual sync-BN
+machinery — callbacks, device broadcasts — for multi-GPU FedSeg).  On TPU
+cross-replica BatchNorm needs none of that: flax's BatchNorm takes
+`axis_name` and psums batch statistics over that mapped mesh axis.
+`sync_batch_norm(...)` pins the convention so models opt in with one
+argument; the parameter tree is identical either way, so a model trained
+single-device loads onto a mesh unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+
+BATCH_AXIS = "clients"   # the mesh axis the engines map over
+                         # (parallel/mesh.py CLIENT_AXIS)
+
+
+def sync_batch_norm(use_running_average: Optional[bool] = None,
+                    sync: bool = True,
+                    axis_name: str = BATCH_AXIS,
+                    momentum: float = 0.9, epsilon: float = 1e-5,
+                    dtype: Any = None, **kw) -> nn.BatchNorm:
+    """BatchNorm constructor with cross-replica statistics.
+
+    sync=True + running under pmap/shard_map(axis_name=...) → statistics
+    psum over the axis (the reference's SynchronizedBatchNorm2d);
+    sync=False (or no mapped axis in scope) → plain per-replica BN."""
+    return nn.BatchNorm(use_running_average=use_running_average,
+                        axis_name=axis_name if sync else None,
+                        momentum=momentum, epsilon=epsilon, dtype=dtype,
+                        **kw)

@@ -42,6 +42,9 @@ class FedConfig:
     stddev: float = 0.0
     # eval cadence
     frequency_of_the_test: int = 5
+    # compute precision: "float32" | "bfloat16" (bf16 = the MXU fast path;
+    # masters/aggregation stay f32)
+    train_dtype: str = "float32"
     # misc
     seed: int = 0
     max_batches_per_client: Optional[int] = None

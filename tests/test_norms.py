@@ -1,0 +1,47 @@
+"""SyncBatchNorm parity (reference cv/batchnorm_utils.py): batch statistics
+psum over the mesh axis, identical param tree with/without sync."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.models.norms import sync_batch_norm
+from fedml_tpu.parallel.mesh import make_mesh
+
+
+class Net(nn.Module):
+    axis: str = "clients"
+    sync: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        return sync_batch_norm(use_running_average=not train,
+                               sync=self.sync, axis_name=self.axis)(x)
+
+
+def test_sync_bn_uses_global_stats():
+    mesh = make_mesh(8)
+    axis = mesh.axis_names[0]
+    net = Net(axis=axis)
+    x = np.random.RandomState(0).rand(32, 6).astype(np.float32)
+    v = net.init(jax.random.PRNGKey(0), x[:4], train=False)
+
+    def body(v, xb):
+        out, _ = net.apply(v, xb, train=True, mutable=["batch_stats"])
+        return out
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh,
+                              in_specs=(P(), P(axis)), out_specs=P(axis)))
+    out = np.asarray(f(v, x))
+    # normalized with GLOBAL batch stats → global mean 0 / std 1, which
+    # per-device BN (different per-shard distributions) cannot produce
+    assert np.abs(out.mean(0)).max() < 1e-4
+    assert np.abs(out.std(0) - 1).max() < 1e-2
+
+
+def test_sync_and_plain_share_param_tree():
+    x = jnp.zeros((4, 6))
+    v_sync = Net(sync=True).init(jax.random.PRNGKey(0), x, train=False)
+    v_plain = Net(sync=False).init(jax.random.PRNGKey(0), x, train=False)
+    assert jax.tree.structure(v_sync) == jax.tree.structure(v_plain)
