@@ -89,20 +89,26 @@ def main() -> None:
             variables, server_state, stack, stack_w, ids, wmask, r)
         return variables, server_state, rng, m
 
+    def force_completion(variables, m):
+        """Device→host scalar fetch: the only reliable completion barrier
+        on the tunnel platform (block_until_ready can return early there)."""
+        jax.block_until_ready(variables)
+        return float(m["train_loss"])
+
     for i in range(WARMUP_ROUNDS):
         variables, server_state, rng, m = one_round(
             variables, server_state, i, rng)
-    jax.block_until_ready(variables)
+    force_completion(variables, m)
 
     t0 = time.perf_counter()
     for i in range(TIMED_ROUNDS):
         variables, server_state, rng, m = one_round(
             variables, server_state, WARMUP_ROUNDS + i, rng)
-    jax.block_until_ready(variables)
+    last_loss = force_completion(variables, m)
     dt = time.perf_counter() - t0
 
     rps = TIMED_ROUNDS / dt
-    print(f"train_loss={float(m['train_loss']):.4f} "
+    print(f"train_loss={last_loss:.4f} "
           f"{dt / TIMED_ROUNDS:.3f}s/round", file=sys.stderr)
     print(json.dumps({
         "metric": "fedavg_cifar10_resnet18gn_128clients_rounds_per_sec",

@@ -158,7 +158,9 @@ class FedAvgServerManager(ServerManager):
                 self._arm_watchdog(self.round_idx)
             if not all_received:
                 return
-            self._finish_round()
+            last = self._finish_round()
+        if last:       # finish() outside _round_lock: it joins the receive
+            self.finish()   # thread, which may be waiting on that lock
 
     def _arm_watchdog(self, armed_round: int) -> None:
         self._watchdog = threading.Timer(
@@ -172,14 +174,18 @@ class FedAvgServerManager(ServerManager):
             self._watchdog = None
             if self.round_idx != armed_round:
                 return                      # round completed normally
-            if self.aggregator.received_count() == 0:
-                self._arm_watchdog(armed_round)   # nothing to aggregate yet
-                return
+            # the watchdog is armed only after a first upload, so at least
+            # one slot is filled whenever we get here
             self.partial_rounds += 1
-            self._finish_round()
+            last = self._finish_round()
+        if last:
+            self.finish()
 
-    def _finish_round(self) -> None:
-        """Aggregate + advance; caller holds _round_lock."""
+    def _finish_round(self) -> bool:
+        """Aggregate + advance; caller holds _round_lock.  Returns True
+        when this was the last round — the caller must then call finish()
+        AFTER releasing the lock (finish joins the receive thread, which
+        may itself be blocked on _round_lock)."""
         if self._watchdog is not None:
             self._watchdog.cancel()
             self._watchdog = None
@@ -189,13 +195,13 @@ class FedAvgServerManager(ServerManager):
         self.round_idx += 1
         if self.round_idx >= self.round_num:
             self.done.set()
-            self.finish()
-            return
+            return True
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         for rank in range(1, self.size):
             self._send_model(rank,
                              MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                              int(client_indexes[rank - 1]))
+        return False
 
 
 class FedAvgClientManager(ClientManager):

@@ -25,9 +25,12 @@ def sync_batch_norm(use_running_average: Optional[bool] = None,
                     dtype: Any = None, **kw) -> nn.BatchNorm:
     """BatchNorm constructor with cross-replica statistics.
 
-    sync=True + running under pmap/shard_map(axis_name=...) → statistics
-    psum over the axis (the reference's SynchronizedBatchNorm2d);
-    sync=False (or no mapped axis in scope) → plain per-replica BN."""
+    sync=True → statistics psum over `axis_name` (the reference's
+    SynchronizedBatchNorm2d); the model must then run under a mapped axis
+    of that name (shard_map/pmap) — training it outside one raises
+    `unbound axis name` at trace time.  sync=False → plain per-replica BN
+    usable anywhere.  Both produce the identical parameter tree, so the
+    flag can differ between training and deployment checkpoints."""
     return nn.BatchNorm(use_running_average=use_running_average,
                         axis_name=axis_name if sync else None,
                         momentum=momentum, epsilon=epsilon, dtype=dtype,
