@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
     p.add_argument("--mesh", action="store_true",
                    help="shard the cohort over all visible devices")
+    p.add_argument("--multihost", action="store_true",
+                   help="join the multi-host runtime first "
+                        "(jax.distributed.initialize; replaces mpirun)")
     p.add_argument("--group_num", type=int, default=2,
                    help="hierarchical: silo count")
     p.add_argument("--group_comm_round", type=int, default=2)
@@ -224,6 +227,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     cfg = FedConfig.from_args(args)
     cfg.ci = bool(args.ci)
+    if args.multihost:
+        from fedml_tpu.parallel.multihost import init_multihost
+        init_multihost()
 
     from fedml_tpu.utils.metrics import RunLogger
     logger = RunLogger(root=args.run_dir, project="fedml_tpu",

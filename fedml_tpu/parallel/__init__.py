@@ -22,3 +22,5 @@ from fedml_tpu.parallel.engine import (MeshFedAvgEngine, MeshFedOptEngine,
                                        MeshFedProxEngine, MeshRobustEngine)
 from fedml_tpu.parallel.hierarchical import MeshHierarchicalEngine
 from fedml_tpu.parallel.gossip import MeshGossipEngine
+from fedml_tpu.parallel.multihost import (init_multihost, make_global_mesh,
+                                          make_hierarchical_host_mesh)

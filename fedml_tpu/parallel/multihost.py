@@ -1,0 +1,82 @@
+"""Multi-host (DCN) runtime bootstrap.
+
+The reference scales across machines with `mpirun -np N -hostfile ...`
+(run_fedavg_distributed_pytorch.sh:16-35) — one OS process per client rank
+over MPI.  TPU-native, multi-host is one SPMD program: every host runs the
+same code, `jax.distributed.initialize` wires the hosts into a single
+runtime, and `jax.devices()` becomes the global chip list.  The engines in
+parallel/ are already global-view (shard_map over a Mesh, device_put with
+NamedShardings), so they run unchanged on a multi-host mesh — XLA routes
+in-slice collectives over ICI and cross-slice traffic over DCN.
+
+Mesh layout guidance (the scaling-book recipe): put the axis with the
+heaviest collective traffic (the client/cohort axis — its psum moves the
+whole model) INSIDE a slice so it rides ICI; put the hierarchical silo
+axis across slices so only the second-tier reduction crosses DCN —
+`make_hierarchical_host_mesh` encodes exactly that on top of
+mesh.make_mesh_2d.
+
+IMPORTANT: init_multihost() must run before ANY jax call that initializes
+the XLA backend (so: first thing in main) — jax.distributed.initialize
+refuses to run afterwards.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from fedml_tpu.parallel.mesh import (CLIENT_AXIS, SILO_AXIS, make_mesh,
+                                     make_mesh_2d)
+
+log = logging.getLogger(__name__)
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Join this host into the global runtime (idempotent).
+
+    With no arguments, relies on the cluster's auto-detection (TPU pods
+    expose the coordinator via metadata) and degrades gracefully to
+    single-process mode on a dev box.  With EXPLICIT arguments a failure
+    raises — silently training independent single-host replicas would
+    corrupt the run.  Replaces the reference's mpirun/hostfile bootstrap."""
+    try:
+        if jax.distributed.is_initialized():
+            return
+    except AttributeError:              # older jax: no is_initialized
+        pass
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        log.info("multihost: process %d/%d, %d global devices",
+                 jax.process_index(), jax.process_count(),
+                 len(jax.devices()))
+    except Exception as e:
+        if explicit:
+            raise RuntimeError(
+                f"multi-host initialization failed for coordinator "
+                f"{coordinator_address!r}: {e}") from e
+        log.info("multihost init skipped (%s); single-process mode", e)
+
+
+def make_global_mesh(axis_name: str = CLIENT_AXIS) -> Mesh:
+    """1-D mesh over ALL chips of ALL hosts — the cohort axis spans the
+    pod; psum rides ICI within a slice and DCN across."""
+    return make_mesh(axis_name=axis_name)
+
+
+def make_hierarchical_host_mesh(silos: Optional[int] = None) -> Mesh:
+    """2-D (silo × clients) mesh with one silo per host by default: the
+    inner FedAvg psum stays on each host's ICI, only the per-silo means
+    cross DCN — the two-tier reduction of hierarchical FL mapped onto the
+    physical network (SURVEY.md §2.5 'hierarchical aggregation')."""
+    silos = silos or max(jax.process_count(), 1)
+    n = len(jax.devices())
+    assert n % silos == 0, (n, silos)
+    return make_mesh_2d(n_silos=silos)

@@ -129,3 +129,17 @@ def test_gossip_learns():
     wv = eng.run(rounds=6)
     acc = eng.evaluate(eng.consensus_variables(wv))["train_acc"]
     assert acc > 0.5, acc
+
+
+def test_multihost_mesh_helpers():
+    """Single-process: helpers still build valid meshes over local devices
+    (multi-host wiring is a no-op here)."""
+    from fedml_tpu.parallel.multihost import (init_multihost,
+                                              make_global_mesh,
+                                              make_hierarchical_host_mesh)
+    init_multihost()          # must be safe on a single host
+    mesh = make_global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    h = make_hierarchical_host_mesh(silos=2)
+    assert h.shape["silo"] == 2
+    assert h.shape["silo"] * h.shape["clients"] == len(jax.devices())
