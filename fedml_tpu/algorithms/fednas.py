@@ -39,7 +39,8 @@ from fedml_tpu.core.sampling import ClientSampler
 from fedml_tpu.core.trainer import masked_cross_entropy
 from fedml_tpu.data.federated import FederatedData
 from fedml_tpu.models.darts import (DartsNetwork, DartsSearchNetwork,
-                                    derive_genotype, init_alphas)
+                                    derive_genotype, init_alphas,
+                                    st_gumbel_softmax)
 from fedml_tpu.utils.config import FedConfig
 
 log = logging.getLogger(__name__)
@@ -52,7 +53,8 @@ class FedNASSearchEngine:
     def __init__(self, data: FederatedData, cfg: FedConfig,
                  num_classes: Optional[int] = None, C: int = 16,
                  layers: int = 8, steps: int = 4, multiplier: int = 4,
-                 unrolled: bool = False,
+                 unrolled: bool = False, gdas: bool = False,
+                 gdas_tau: float = 1.0,
                  arch_lr: float = 3e-4, arch_weight_decay: float = 1e-3,
                  momentum: float = 0.9, weight_decay: float = 3e-4,
                  grad_clip: float = 5.0, donate: bool = True):
@@ -60,9 +62,14 @@ class FedNASSearchEngine:
         self.cfg = cfg
         self.steps = steps
         self.multiplier = multiplier
+        # GDAS (model_search_gdas.py): one sampled op per edge via
+        # straight-through gumbel — the supernet then receives pre-mixed
+        # weights instead of raw logits
+        self.gdas = gdas
+        self.gdas_tau = gdas_tau
         self.model = DartsSearchNetwork(
             num_classes=num_classes or data.class_num, C=C, layers=layers,
-            steps=steps, multiplier=multiplier)
+            steps=steps, multiplier=multiplier, softmax_weights=not gdas)
         self.unrolled = unrolled
         self.eta = cfg.lr                       # inner lr for the unroll
         # w optimizer: SGD + momentum + weight decay (FedNASTrainer.py:66-71)
@@ -94,24 +101,37 @@ class FedNASSearchEngine:
         return params, alphas
 
     # -- losses --------------------------------------------------------------
-    def _loss(self, params, alphas, batch):
+    def _mix(self, alphas, rng):
+        """GDAS: logits → straight-through one-hot samples per edge."""
+        rn, rr = jax.random.split(rng)
+        return {"normal": st_gumbel_softmax(alphas["normal"], rn,
+                                            self.gdas_tau),
+                "reduce": st_gumbel_softmax(alphas["reduce"], rr,
+                                            self.gdas_tau)}
+
+    def _loss(self, params, alphas, batch, gumbel_rng=None):
+        if self.gdas:
+            alphas = self._mix(alphas, gumbel_rng)
         logits = self.model.apply({"params": params}, batch["x"], alphas)
         return masked_cross_entropy(logits, batch["y"], batch["mask"])
 
-    def _arch_grad(self, params, alphas, train_batch, val_batch):
+    def _arch_grad(self, params, alphas, train_batch, val_batch, rng=None):
         if not self.unrolled:
             # first-order: ∇α L_val(w, α)   (architect.py step_single_level)
-            return jax.grad(self._loss, argnums=1)(params, alphas, val_batch)
+            return jax.grad(self._loss, argnums=1)(params, alphas,
+                                                   val_batch, rng)
 
         # exact second-order: differentiate through w' = w − η ∇w L_train
         def unrolled_val(alphas):
-            gw = jax.grad(self._loss)(params, alphas, train_batch)
+            gw = jax.grad(self._loss)(params, alphas, train_batch, rng)
             w2 = jax.tree.map(lambda w, g: w - self.eta * g, params, gw)
-            return self._loss(w2, alphas, val_batch)
+            return self._loss(w2, alphas, val_batch, rng)
         return jax.grad(unrolled_val)(alphas)
 
     # -- one client's local search (epochs × batches, scanned) ---------------
-    def _local_search(self, params, alphas, shard, epochs: int):
+    def _local_search(self, params, alphas, shard, epochs: int,
+                      rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
         # disjoint 50/50 split of the batch stream: w trains on the first
         # half, alphas validate on the second (ref FedNASTrainer.py:49-60).
         B = shard["mask"].shape[0]
@@ -127,20 +147,22 @@ class FedNASSearchEngine:
         a_opt = self.a_tx.init(alphas)
 
         def batch_body(carry, batches):
-            params, alphas, w_opt, a_opt = carry
+            params, alphas, w_opt, a_opt, rng = carry
+            rng, gr1, gr2 = jax.random.split(rng, 3)
             tb, vb = batches
             has_data = jnp.sum(tb["mask"]) > 0
             # alpha step on the val batch
-            ga = self._arch_grad(params, alphas, tb, vb)
+            ga = self._arch_grad(params, alphas, tb, vb, gr1)
             ua, a_opt2 = self.a_tx.update(ga, a_opt, alphas)
             alphas2 = optax.apply_updates(alphas, ua)
             # w step on the train batch (with the updated alphas)
-            loss, gw = jax.value_and_grad(self._loss)(params, alphas2, tb)
+            loss, gw = jax.value_and_grad(self._loss)(params, alphas2, tb,
+                                                      gr2)
             uw, w_opt2 = self.w_tx.update(gw, w_opt, params)
             params2 = optax.apply_updates(params, uw)
             keep = functools.partial(tree_select, has_data)
             carry = (keep(params2, params), keep(alphas2, alphas),
-                     keep(w_opt2, w_opt), keep(a_opt2, a_opt))
+                     keep(w_opt2, w_opt), keep(a_opt2, a_opt), rng)
             return carry, (jnp.where(has_data, loss, 0.0),
                            jnp.sum(tb["mask"]))
 
@@ -150,15 +172,19 @@ class FedNASSearchEngine:
             return carry, jnp.sum(losses * counts) / jnp.maximum(
                 jnp.sum(counts), 1.0)
 
-        (params, alphas, _, _), epoch_losses = jax.lax.scan(
-            epoch_body, (params, alphas, w_opt, a_opt), None, length=epochs)
+        (params, alphas, _, _, _), epoch_losses = jax.lax.scan(
+            epoch_body, (params, alphas, w_opt, a_opt, rng), None,
+            length=epochs)
         return params, alphas, jnp.mean(epoch_losses), n_samples
 
     # -- one federated round -------------------------------------------------
-    def _round(self, params, alphas, cohort):
-        def one(shard):
-            return self._local_search(params, alphas, shard, self.cfg.epochs)
-        ps, als, losses, ns = jax.vmap(one)(cohort)
+    def _round(self, params, alphas, cohort, rng):
+        K = cohort["mask"].shape[0]
+        rngs = jax.random.split(rng, K)
+        def one(shard, crng):
+            return self._local_search(params, alphas, shard,
+                                      self.cfg.epochs, crng)
+        ps, als, losses, ns = jax.vmap(one)(cohort, rngs)
         # server averages weights AND alphas separately, sample-weighted
         # (FedNASAggregator.py:71-113)
         new_params = tree_weighted_mean(ps, ns)
@@ -168,6 +194,10 @@ class FedNASSearchEngine:
 
     # -- eval ----------------------------------------------------------------
     def _eval_shard_metrics(self, params, alphas, shard):
+        if self.gdas:
+            # deterministic eval: the argmax (sampled-free) architecture
+            alphas = {k: jax.nn.one_hot(jnp.argmax(v, -1), v.shape[-1])
+                      for k, v in alphas.items()}
         def body(carry, batch):
             logits = self.model.apply({"params": params}, batch["x"], alphas)
             ce = optax.softmax_cross_entropy_with_integer_labels(
@@ -190,11 +220,14 @@ class FedNASSearchEngine:
         cfg = self.cfg
         params, alphas = self.init_state()
         rounds = rounds if rounds is not None else cfg.comm_round
+        rng_base = jax.random.PRNGKey(cfg.seed + 11)
         for round_idx in range(rounds):
             t0 = time.time()
             ids = self.sampler.sample(round_idx)
             cohort, _ = self.data.cohort(ids)
-            params, alphas, m = self.round_fn(params, alphas, cohort)
+            params, alphas, m = self.round_fn(
+                params, alphas, cohort, jax.random.fold_in(rng_base,
+                                                           round_idx))
             if (round_idx % cfg.frequency_of_the_test == 0
                     or round_idx == rounds - 1):
                 stats = self.evaluate(params, alphas)

@@ -74,6 +74,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decentralized: ring|ws (Watts-Strogatz)")
     p.add_argument("--unrolled", action="store_true",
                    help="fednas: 2nd-order architect")
+    p.add_argument("--gdas", action="store_true",
+                   help="fednas: GDAS single-path gumbel sampling")
+    p.add_argument("--nas_channels", type=int, default=16)
+    p.add_argument("--nas_layers", type=int, default=8)
+    p.add_argument("--nas_steps", type=int, default=4)
+    p.add_argument("--nas_multiplier", type=int, default=4)
     # observability / checkpointing (SURVEY.md §5 gaps the build fills)
     p.add_argument("--run_dir", type=str, default="./runs")
     p.add_argument("--run_name", type=str, default=None)
@@ -182,7 +188,11 @@ def build_engine(args, cfg: FedConfig, data):
 
     if algo == "fednas":
         from fedml_tpu.algorithms import FedNASSearchEngine
-        return FedNASSearchEngine(data, cfg, unrolled=args.unrolled)
+        return FedNASSearchEngine(data, cfg, unrolled=args.unrolled,
+                                  gdas=args.gdas, C=args.nas_channels,
+                                  layers=args.nas_layers,
+                                  steps=args.nas_steps,
+                                  multiplier=args.nas_multiplier)
 
     if algo == "fedgan":
         from fedml_tpu.algorithms.fedgan import FedGANEngine
@@ -229,7 +239,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     cfg.ci = bool(args.ci)
     if args.multihost:
         from fedml_tpu.parallel.multihost import init_multihost
-        init_multihost()
+        init_multihost(required=True)
 
     from fedml_tpu.utils.metrics import RunLogger
     logger = RunLogger(root=args.run_dir, project="fedml_tpu",

@@ -199,22 +199,41 @@ class SearchCell(nn.Module):
         return jnp.concatenate(states[-self.multiplier:], axis=-1)
 
 
+def st_gumbel_softmax(logits, rng, tau: float = 1.0):
+    """Straight-through Gumbel-softmax over the op axis: hard one-hot on
+    the forward pass, soft gradients — the GDAS single-path sampler
+    (model_search_gdas.py; arXiv:1910.04465)."""
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(rng, logits.shape, minval=1e-20, maxval=1.0)
+        ) + 1e-20)
+    soft = jax.nn.softmax((logits + g) / tau, axis=-1)
+    hard = jax.nn.one_hot(jnp.argmax(soft, axis=-1), logits.shape[-1],
+                          dtype=soft.dtype)
+    return jax.lax.stop_gradient(hard - soft) + soft
+
+
 class DartsSearchNetwork(nn.Module):
     """Search-phase supernet (model_search.py:172-231).  Reduction cells at
     layers//3 and 2*layers//3.  `__call__(x, alphas)` with
-    alphas = {"normal": [k, O], "reduce": [k, O]} raw logits."""
+    alphas = {"normal": [k, O], "reduce": [k, O]} raw logits — or, with
+    softmax_weights=False, already-mixed edge weights (the GDAS path
+    passes straight-through gumbel samples)."""
     num_classes: int
     C: int = 16
     layers: int = 8
     steps: int = 4
     multiplier: int = 4
     stem_multiplier: int = 3
+    softmax_weights: bool = True
 
     @nn.compact
     def __call__(self, x, alphas, train: bool = True):
         del train
-        w_normal = jax.nn.softmax(alphas["normal"], axis=-1)
-        w_reduce = jax.nn.softmax(alphas["reduce"], axis=-1)
+        if self.softmax_weights:
+            w_normal = jax.nn.softmax(alphas["normal"], axis=-1)
+            w_reduce = jax.nn.softmax(alphas["reduce"], axis=-1)
+        else:
+            w_normal, w_reduce = alphas["normal"], alphas["reduce"]
         C_curr = self.stem_multiplier * self.C
         s = nn.Conv(C_curr, (3, 3), padding="SAME", use_bias=False)(x)
         s0 = s1 = _gn(C_curr)(s)

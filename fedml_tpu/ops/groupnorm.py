@@ -109,20 +109,28 @@ def _fwd_kernel(x_ref, gt_ref, bt_ref, y_ref, mean_ref, rstd_ref,
     ftile, n_chunks = _chunk_layout(F, C)
     onehots = _group_onehots(ftile, C, G)
     m = jnp.float32(F // G)
-    # pass 1 over VMEM-resident chunks: per-group Σx, Σx²
+    # pass 1 over VMEM-resident chunks: per-group Σx → mean
     s = [jnp.zeros((B, 1), jnp.float32) for _ in range(G)]
-    ss = [jnp.zeros((B, 1), jnp.float32) for _ in range(G)]
     for t in range(n_chunks):
         xc = x_ref[:, pl.ds(t * ftile, ftile)].astype(jnp.float32)
         for g, oh in enumerate(onehots):
             s[g] = s[g] + jnp.sum(xc * oh, axis=1, keepdims=True)
-            ss[g] = ss[g] + jnp.sum(xc * xc * oh, axis=1, keepdims=True)
     mean = jnp.concatenate(s, axis=1) / m
-    msq = jnp.concatenate(ss, axis=1) / m
-    rstd = jax.lax.rsqrt(jnp.maximum(msq - mean * mean, 0.0) + eps)
+    # pass 2: Σ(x−μ)² — two-pass variance, matching the reference spec
+    # (the one-pass E[x²]−μ² form cancels catastrophically for
+    # large-mean inputs); chunks are VMEM reads, so the extra pass is
+    # compute-only, not HBM traffic
+    v = [jnp.zeros((B, 1), jnp.float32) for _ in range(G)]
+    for t in range(n_chunks):
+        xc = x_ref[:, pl.ds(t * ftile, ftile)].astype(jnp.float32)
+        for g, oh in enumerate(onehots):
+            d = (xc - mean[:, g][:, None]) * oh
+            v[g] = v[g] + jnp.sum(d * d, axis=1, keepdims=True)
+    var = jnp.concatenate(v, axis=1) / m
+    rstd = jax.lax.rsqrt(var + eps)
     mean_ref[:] = mean
     rstd_ref[:] = rstd
-    # pass 2: normalize chunk-by-chunk
+    # pass 3: normalize chunk-by-chunk
     for t in range(n_chunks):
         xc = x_ref[:, pl.ds(t * ftile, ftile)].astype(jnp.float32)
         mean_f = jnp.zeros((B, ftile), jnp.float32)
@@ -305,14 +313,14 @@ def _gn_bwd(num_groups, eps, res, dy):
 group_norm.defvjp(_gn_fwd, _gn_bwd)
 
 
-class FusedGroupNorm:
-    """flax-compatible GroupNorm module backed by the fused kernels.
+_fused_gn_cls = None
 
-    Parameter names/shapes match nn.GroupNorm ("scale", "bias" of [C]), so
-    checkpoints are interchangeable with the plain-XLA module.  Import is
-    deferred to keep ops/ free of a hard flax dependency at module load.
-    """
-    def __new__(cls, num_groups: int = 8, epsilon: float = 1e-5, name=None):
+
+def _get_fused_gn_cls():
+    """Build the flax module class ONCE (flax import deferred; a fresh
+    class per construction would defeat jit caches keyed on module type)."""
+    global _fused_gn_cls
+    if _fused_gn_cls is None:
         import flax.linen as nn
 
         class _FusedGN(nn.Module):
@@ -327,4 +335,13 @@ class FusedGroupNorm:
                 return group_norm(x, scale, bias, self.num_groups,
                                   self.epsilon)
 
-        return _FusedGN(num_groups=num_groups, epsilon=epsilon, name=name)
+        _fused_gn_cls = _FusedGN
+    return _fused_gn_cls
+
+
+def FusedGroupNorm(num_groups: int = 8, epsilon: float = 1e-5, name=None):
+    """flax-compatible GroupNorm module backed by the fused kernels.
+    Parameter names/shapes match nn.GroupNorm ("scale", "bias" of [C]), so
+    checkpoints are interchangeable with the plain-XLA module."""
+    return _get_fused_gn_cls()(num_groups=num_groups, epsilon=epsilon,
+                               name=name)

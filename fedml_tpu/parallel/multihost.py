@@ -36,20 +36,23 @@ log = logging.getLogger(__name__)
 
 def init_multihost(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
-                   process_id: Optional[int] = None) -> None:
+                   process_id: Optional[int] = None,
+                   required: bool = False) -> None:
     """Join this host into the global runtime (idempotent).
 
     With no arguments, relies on the cluster's auto-detection (TPU pods
     expose the coordinator via metadata) and degrades gracefully to
-    single-process mode on a dev box.  With EXPLICIT arguments a failure
-    raises — silently training independent single-host replicas would
-    corrupt the run.  Replaces the reference's mpirun/hostfile bootstrap."""
+    single-process mode on a dev box.  With EXPLICIT arguments — or
+    required=True (the CLI's --multihost sets it) — a failure raises:
+    silently training independent single-host replicas would corrupt the
+    run.  Replaces the reference's mpirun/hostfile bootstrap."""
     try:
         if jax.distributed.is_initialized():
             return
     except AttributeError:              # older jax: no is_initialized
         pass
-    explicit = coordinator_address is not None or num_processes is not None
+    explicit = (required or coordinator_address is not None
+                or num_processes is not None or process_id is not None)
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
@@ -76,7 +79,12 @@ def make_hierarchical_host_mesh(silos: Optional[int] = None) -> Mesh:
     inner FedAvg psum stays on each host's ICI, only the per-silo means
     cross DCN — the two-tier reduction of hierarchical FL mapped onto the
     physical network (SURVEY.md §2.5 'hierarchical aggregation')."""
+    devs = jax.devices()
     silos = silos or max(jax.process_count(), 1)
-    n = len(jax.devices())
-    assert n % silos == 0, (n, silos)
-    return make_mesh_2d(n_silos=silos)
+    if len(devs) % silos != 0:
+        raise ValueError(f"{len(devs)} devices not divisible into "
+                         f"{silos} silos")
+    # global device order is NOT guaranteed host-contiguous; sort by
+    # process so each silo row really sits on one host's ICI
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    return make_mesh_2d(n_silos=silos, devices=devs)

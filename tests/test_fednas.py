@@ -34,12 +34,13 @@ def tiny_data(n_clients=2, bs=2, n_batches=2, hw=8, classes=10):
         test_client_shards=None, class_num=classes, synthetic=True)
 
 
-def micro_engine(data, unrolled=False):
+def micro_engine(data, unrolled=False, **kw):
     cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
                     comm_round=1, epochs=1, batch_size=2, lr=0.05,
                     frequency_of_the_test=1)
     return FedNASSearchEngine(data, cfg, C=4, layers=1, steps=2,
-                              multiplier=2, unrolled=unrolled, donate=False)
+                              multiplier=2, unrolled=unrolled, donate=False,
+                              **kw)
 
 
 def test_search_network_forward():
@@ -123,3 +124,23 @@ def test_fixed_network_from_published_genotype():
     logits = model.apply(variables, x)
     assert logits.shape == (2, 10)
     assert jnp.all(jnp.isfinite(logits))
+
+
+def test_gdas_single_path_search():
+    """GDAS mode (model_search_gdas.py): straight-through gumbel samples
+    one op per edge; search still moves both trees and eval works."""
+    from fedml_tpu.models.darts import st_gumbel_softmax
+    import jax.numpy as jnp
+    w = st_gumbel_softmax(jnp.zeros((5, 8)), jax.random.PRNGKey(0))
+    # forward value is exactly one-hot per edge
+    np.testing.assert_allclose(np.asarray(jnp.sum(w, -1)), np.ones(5),
+                               rtol=1e-6)
+    assert float(jnp.max(w)) == 1.0
+
+    data = tiny_data()
+    eng = micro_engine(data, gdas=True)
+    p0, a0 = eng.init_state()
+    params, alphas = eng.run(rounds=1)
+    assert eng.metrics_history and "test_acc" in eng.metrics_history[-1]
+    assert not np.allclose(np.asarray(alphas["reduce"]),
+                           np.asarray(a0["reduce"]))

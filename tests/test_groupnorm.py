@@ -1,6 +1,8 @@
 """Fused GroupNorm: value and gradient parity with flax nn.GroupNorm (the
-spec), on the reference path (CPU) — the pallas path is exercised on real
-TPU hardware by bench.py and shares the same custom-VJP math."""
+spec), on the reference path (the test platform is CPU, where _use_pallas
+is False).  The pallas TPU path shares the custom-VJP plumbing but its
+kernels only compile on hardware — run `python tools/tpu_smoke.py` on a
+TPU host to check pallas-vs-reference parity there."""
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,56 @@
+"""On-hardware smoke checks (run on a TPU host: `python tools/tpu_smoke.py`).
+
+Covers the paths the CPU test suite cannot reach: pallas kernels compiled
+by Mosaic (fused GroupNorm fwd/bwd, aggregation kernels) and a real
+mesh FedAvg round — the complement of tests/ (which pins JAX_PLATFORMS=cpu).
+"""
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    if jax.default_backend() != "tpu":
+        print(f"not on TPU (backend={jax.default_backend()}); nothing to do")
+        return 0
+
+    from fedml_tpu.ops.groupnorm import _gn_reference, _use_pallas, group_norm
+    rs = np.random.RandomState(0)
+    # include a large-mean input: the two-pass variance must survive it
+    for scale, shift in [(1.0, 0.0), (1.0, 1000.0)]:
+        x = jnp.asarray(rs.rand(16, 32, 32, 64) * scale + shift, jnp.float32)
+        g = jnp.asarray(rs.rand(64), jnp.float32)
+        b = jnp.asarray(rs.rand(64), jnp.float32)
+        assert _use_pallas(x.shape, 8)
+        got = group_norm(x, g, b, 8)
+        want = _gn_reference(x, g, b, 8, 1e-5)
+        d = float(jnp.max(jnp.abs(got - want)))
+        print(f"GN fwd (shift={shift}): max diff {d:.2e}")
+        assert d < 1e-3, d
+        gp = jax.grad(lambda *a: jnp.sum(jnp.sin(group_norm(*a, 8))),
+                      argnums=(0, 1, 2))(x, g, b)
+        gr = jax.grad(lambda *a: jnp.sum(jnp.sin(_gn_reference(*a, 8, 1e-5))),
+                      argnums=(0, 1, 2))(x, g, b)
+        for name, a_, c_ in zip("x g b".split(), gp, gr):
+            d = float(jnp.max(jnp.abs(a_ - c_)))
+            print(f"GN grad {name}: max diff {d:.2e}")
+            assert d < 5e-2, (name, d)
+
+    from fedml_tpu.ops import weighted_mean_pallas
+    from fedml_tpu.core.pytree import tree_weighted_mean
+    stack = {"w": jnp.asarray(rs.rand(8, 1000), jnp.float32)}
+    wts = jnp.asarray(rs.rand(8), jnp.float32)
+    got = weighted_mean_pallas(stack, wts)["w"]
+    want = tree_weighted_mean(stack, wts)["w"]
+    d = float(jnp.max(jnp.abs(got - want)))
+    print(f"pallas weighted mean: max diff {d:.2e}")
+    assert d < 1e-5
+
+    print("TPU SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
