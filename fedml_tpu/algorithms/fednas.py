@@ -132,13 +132,17 @@ class FedNASSearchEngine:
     def _local_search(self, params, alphas, shard, epochs: int,
                       rng=None):
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        # disjoint 50/50 split of the batch stream: w trains on the first
-        # half, alphas validate on the second (ref FedNASTrainer.py:49-60).
+        # disjoint 50/50 split of the batch stream (ref FedNASTrainer.py:49-60).
+        # Interleaved even/odd — NOT first/second half: padding batches are
+        # tail-appended, so a contiguous split would hand any client with
+        # <= B/2 real batches an all-padding validation half and silently
+        # zero its architecture signal. Interleaving shares the padding tail
+        # proportionally between the two streams.
         B = shard["mask"].shape[0]
         half = B // 2
         if half > 0:
-            train_shard = jax.tree.map(lambda a: a[:half], shard)
-            val_shard = jax.tree.map(lambda a: a[half:2 * half], shard)
+            train_shard = jax.tree.map(lambda a: a[0::2][:half], shard)
+            val_shard = jax.tree.map(lambda a: a[1::2][:half], shard)
         else:            # single-batch client: degenerate single-level mode
             train_shard = val_shard = shard
         n_samples = jnp.sum(shard["mask"])   # full-shard sample weight
@@ -151,18 +155,23 @@ class FedNASSearchEngine:
             rng, gr1, gr2 = jax.random.split(rng, 3)
             tb, vb = batches
             has_data = jnp.sum(tb["mask"]) > 0
-            # alpha step on the val batch
+            has_val = jnp.sum(vb["mask"]) > 0
+            # alpha step on the val batch — gated on the VAL batch's mask:
+            # an empty val batch must not turn the alpha step into pure
+            # Adam-normalized weight decay
             ga = self._arch_grad(params, alphas, tb, vb, gr1)
             ua, a_opt2 = self.a_tx.update(ga, a_opt, alphas)
             alphas2 = optax.apply_updates(alphas, ua)
+            keep_a = functools.partial(tree_select, has_val)
+            alphas2, a_opt2 = keep_a(alphas2, alphas), keep_a(a_opt2, a_opt)
             # w step on the train batch (with the updated alphas)
             loss, gw = jax.value_and_grad(self._loss)(params, alphas2, tb,
                                                       gr2)
             uw, w_opt2 = self.w_tx.update(gw, w_opt, params)
             params2 = optax.apply_updates(params, uw)
             keep = functools.partial(tree_select, has_data)
-            carry = (keep(params2, params), keep(alphas2, alphas),
-                     keep(w_opt2, w_opt), keep(a_opt2, a_opt), rng)
+            carry = (keep(params2, params), alphas2,
+                     keep(w_opt2, w_opt), a_opt2, rng)
             return carry, (jnp.where(has_data, loss, 0.0),
                            jnp.sum(tb["mask"]))
 

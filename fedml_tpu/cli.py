@@ -133,8 +133,17 @@ def build_engine(args, cfg: FedConfig, data):
     if algo in ("fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
                 "turboaggregate", "centralized"):
         trainer = _trainer(cfg, data)
-        if mesh is not None and algo in ("fedavg", "fedopt", "fedprox",
-                                         "fedavg_robust"):
+        if (mesh is not None and algo == "fedavg_robust"
+                and args.defense != "norm_clip"):
+            # MeshRobustEngine implements norm_clip only; silently swapping
+            # the requested krum/median/trimmed_mean for clipping would be
+            # a different threat model — fall back like the no-mesh-engine
+            # path does
+            logging.getLogger(__name__).warning(
+                "--mesh robust engine only implements norm_clip; running "
+                "the single-device path for --defense %s", args.defense)
+        elif mesh is not None and algo in ("fedavg", "fedopt", "fedprox",
+                                           "fedavg_robust"):
             from fedml_tpu.parallel import (MeshFedAvgEngine,
                                             MeshFedOptEngine,
                                             MeshFedProxEngine,

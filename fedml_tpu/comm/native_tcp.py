@@ -106,7 +106,14 @@ class NativeTcpBackend(BaseCommManager):
             self._conns.clear()
         # the drain thread may be inside fh_recv on the Server's condvar —
         # it must exit (≤200 ms timeout tick) BEFORE fh_server_close deletes
-        # the Server, or the wait is a use-after-free
+        # the Server, or the wait is a use-after-free.  If it hasn't exited
+        # (e.g. an _on_message observer callback is wedged) the Server is
+        # deliberately leaked: a leak is recoverable, a freed condvar under
+        # a waiting thread is not.
         self._drain.join(timeout=5)
+        if self._drain.is_alive():
+            log.warning("drain thread still running after 5s; leaking "
+                        "native server to avoid use-after-free")
+            return
         self._lib.fh_server_close(self._server)
         self._server = None

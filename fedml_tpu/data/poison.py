@@ -31,7 +31,10 @@ def pixel_trigger(x: np.ndarray, strength: float = 3.0) -> np.ndarray:
     if x.ndim >= 3 and x.shape[-1] <= 4:
         x[..., -3:, -3:, :] = pat[..., None].astype(x.dtype)
     else:
-        x[..., -9:] = pat.reshape(-1).astype(x.dtype)
+        # narrow tabular inputs (e.g. room_occupancy's 5 features) take a
+        # truncated patch instead of a broadcast error
+        k = min(9, x.shape[-1])
+        x[..., -k:] = pat.reshape(-1)[:k].astype(x.dtype)
     return x
 
 
