@@ -1,0 +1,175 @@
+"""Decompose the north-star bench round cost on the real chip.
+
+Experiments (all CIFAR10-shaped, ResNet-18-GN, bf16 compute, 128 clients,
+bs=32, 13 batches/client = 50k samples/round):
+  A. full bench round via MeshFedAvgEngine (reference point, = bench.py)
+  B. centralized ceiling: SAME total FLOPs with ONE shared-weight model,
+     13 steps of effective batch 4096 -- what XLA can do when the conv
+     kernels are NOT per-client
+  F8/F16/F32. chunked cohort: lax.scan over client chunks of size k,
+     vmap(local_train) inside the chunk, weighted-sum accumulated in the
+     scan carry -- peak HBM ~ O(k * params) instead of O(128 * params)
+
+Usage: python tools/profile_bench.py [A B F16 ...]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.models import create_model
+
+N_CLIENTS = 128
+BS = 32
+SPC = 50_000 // N_CLIENTS
+N_BATCHES = (SPC + BS - 1) // BS  # 13
+
+
+def force(x):
+    """device->host fetch: the only reliable completion barrier on the
+    tunnel platform (block_until_ready can return early there)."""
+    return float(jax.device_get(jax.tree.leaves(x)[0]).ravel()[0])
+
+
+def timeit(fn, warmup=2, iters=5):
+    for _ in range(warmup):
+        out = fn()
+    force(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    force(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def client_batches(rs, n_clients=N_CLIENTS, n_batches=N_BATCHES):
+    x = rs.rand(n_clients, n_batches, BS, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 10, (n_clients, n_batches, BS)).astype(np.int32)
+    m = np.ones((n_clients, n_batches, BS), np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y), "mask": jnp.asarray(m)}
+
+
+def exp_A():
+    """Full bench round via MeshFedAvgEngine (same code path as bench.py)."""
+    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+    from fedml_tpu.utils.config import FedConfig
+
+    cfg = FedConfig(model="resnet18_gn", dataset="cifar10",
+                    client_num_in_total=N_CLIENTS,
+                    client_num_per_round=N_CLIENTS,
+                    epochs=1, batch_size=BS, lr=0.1,
+                    frequency_of_the_test=10_000)
+    rs = np.random.RandomState(0)
+    n = N_CLIENTS * SPC
+    x = rs.rand(n, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 10, n).astype(np.int64)
+    idx = {i: np.arange(i * SPC, (i + 1) * SPC) for i in range(N_CLIENTS)}
+    ev = build_eval_shard(x[:BS], y[:BS], BS)
+    data = FederatedData(
+        train_data_num=n, test_data_num=n, train_global=ev, test_global=ev,
+        client_shards=build_client_shards(x, y, idx, BS),
+        client_num_samples=np.full(N_CLIENTS, SPC, np.float32),
+        test_client_shards=None, class_num=10, synthetic=True)
+    model = create_model("resnet18_gn", output_dim=10)
+    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
+    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(),
+                              donate=False)
+    variables = engine.init_variables()
+    server_state = engine.server_init(variables)
+    stack, stack_w = engine._device_stack()
+    ids, wmask = engine.sample_padded(0)
+    rng = jax.random.PRNGKey(0)
+
+    def round_once():
+        v, s, m = engine.round_fn(variables, server_state, stack, stack_w,
+                                  ids, wmask, rng)
+        return m["train_loss"]
+
+    dt = timeit(round_once, warmup=2, iters=3)
+    print(f"A full_round: {dt:.3f}s/round", flush=True)
+
+
+def exp_B():
+    """Centralized ceiling: shared weights, 13 steps of effective batch 4096."""
+    model = create_model("resnet18_gn", output_dim=10)
+    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    x = rs.rand(N_BATCHES, BS * N_CLIENTS, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 10, (N_BATCHES, BS * N_CLIENTS)).astype(np.int32)
+    shard = {"x": jnp.asarray(x), "y": jnp.asarray(y),
+             "mask": jnp.ones((N_BATCHES, BS * N_CLIENTS), np.float32)}
+    variables = trainer.init(jax.random.PRNGKey(0), shard["x"][0, :1])
+    fn = jax.jit(lambda v, s, r: trainer.local_train(v, s, r, 1)[1])
+    rng = jax.random.PRNGKey(1)
+    dt = timeit(lambda: fn(variables, shard, rng))
+    print(f"B centralized_ceiling: {dt:.3f}s/round-equivalent", flush=True)
+
+
+def _chunked_round(chunk):
+    """Chunked cohort: scan over 128/chunk groups, weighted-sum in carry."""
+    model = create_model("resnet18_gn", output_dim=10)
+    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    shard = client_batches(rs)
+    weights = jnp.full((N_CLIENTS,), float(SPC), jnp.float32)
+    variables = trainer.init(jax.random.PRNGKey(0), shard["x"][0, 0, :1])
+    rngs = jax.random.split(jax.random.PRNGKey(1), N_CLIENTS)
+    n_chunks = N_CLIENTS // chunk
+
+    def round_fn(variables, shard, weights, rngs):
+        sh = jax.tree.map(
+            lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]), shard)
+        w = weights.reshape(n_chunks, chunk)
+        r = rngs.reshape(n_chunks, chunk, -1)
+
+        def one(v, s, cr):
+            nv, loss, _ = trainer.local_train(v, s, cr, 1)
+            return nv, loss
+
+        def chunk_body(carry, xs):
+            num, den, lsum = carry
+            cs, cw, cr = xs
+            vs, losses = jax.vmap(one, in_axes=(None, 0, 0))(variables, cs, cr)
+            num = jax.tree.map(
+                lambda acc, v: acc + jnp.einsum(
+                    "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
+            return (num, den + jnp.sum(cw),
+                    lsum + jnp.sum(losses * cw)), None
+
+        zeros = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                             variables)
+        (num, den, lsum), _ = jax.lax.scan(
+            chunk_body, (zeros, jnp.float32(0), jnp.float32(0)), (sh, w, r))
+        avg = jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype),
+                           num, variables)
+        return avg, lsum / den
+
+    fn = jax.jit(round_fn)
+    dt = timeit(lambda: fn(variables, shard, weights, rngs)[1])
+    return dt
+
+
+def exp_F8():
+    print(f"F8 chunked(8): {_chunked_round(8):.3f}s/round", flush=True)
+
+
+def exp_F16():
+    print(f"F16 chunked(16): {_chunked_round(16):.3f}s/round", flush=True)
+
+
+def exp_F32():
+    print(f"F32 chunked(32): {_chunked_round(32):.3f}s/round", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["A", "B", "F16"]
+    for name in which:
+        globals()[f"exp_{name}"]()
