@@ -106,9 +106,12 @@ def _trainer(cfg: FedConfig, data):
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.models import create_model
     loss = "bce" if cfg.dataset == "stackoverflow_lr" else "ce"
-    has_time = cfg.dataset in ("shakespeare", "fed_shakespeare",
-                               "stackoverflow_nwp")
-    model = create_model(cfg.model, data.class_num)
+    # LEAF shakespeare is a scalar next-char task (model predicts the last
+    # position only, reference rnn.py:30-33); the TFF variants are per-position
+    has_time = cfg.dataset in ("fed_shakespeare", "stackoverflow_nwp")
+    kw = ({"last_only": True}
+          if cfg.model == "rnn" and cfg.dataset == "shakespeare" else {})
+    model = create_model(cfg.model, data.class_num, **kw)
     dtype = jnp.bfloat16 if cfg.train_dtype == "bfloat16" else jnp.float32
     return ClientTrainer(model, loss=loss, optimizer=cfg.client_optimizer,
                          lr=cfg.lr, momentum=cfg.momentum,

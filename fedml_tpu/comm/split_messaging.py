@@ -1,0 +1,300 @@
+"""Remote SplitNN — the per-batch activation/gradient protocol over the
+message layer.
+
+Parity: fedml_api/distributed/split_nn/ — message_define.py:5-25 (types),
+client_manager.py:17-107 (semaphore round-robin, acts up / grads down,
+per-epoch validation), server_manager.py:14-45, client.py:24-41,
+server.py:40-72.  SURVEY.md §3.4 calls this the comm-layer stress test: the
+process boundary is crossed TWICE PER MINIBATCH.
+
+TPU-native split of labor: the numerics are jitted XLA programs
+(`SplitClientCompute.forward/backward`, `SplitServerCompute.train_step`)
+with persistent optimizer state; the protocol layer just moves numpy
+activations/gradients through Message frames, so it runs over any backend
+(INPROC, GRPC, TCP/native).  Unlike the reference we also ship the batch
+mask (our shards are padded) and reset per-epoch batch counters cleanly
+(the reference reuses a single counter across train and eval, client_
+manager.py:40-56).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.trainer import (make_optimizer, masked_accuracy_sums,
+                                    masked_cross_entropy)
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+
+class SplitNNMessage:
+    """Message-type constants (message_define.py:5-25)."""
+    MSG_TYPE_S2C_GRADS = 1
+    MSG_TYPE_C2S_SEND_ACTS = 2
+    MSG_TYPE_C2S_VALIDATION_MODE = 3
+    MSG_TYPE_C2S_VALIDATION_OVER = 4
+    MSG_TYPE_C2S_PROTOCOL_FINISHED = 5
+    MSG_TYPE_C2C_SEMAPHORE = 6
+
+    MSG_ARG_KEY_ACTS = "activations"
+    MSG_ARG_KEY_LABELS = "labels"
+    MSG_ARG_KEY_MASK = "mask"
+    MSG_ARG_KEY_GRADS = "activation_grads"
+
+
+class SplitClientCompute:
+    """Client lower-net numerics: forward to the cut, backward from the
+    server's activation gradients (client.py:24-35).  Optimizer state
+    persists across batches (the reference builds optim.SGD once)."""
+
+    def __init__(self, model, lr: float = 0.1, momentum: float = 0.9,
+                 weight_decay: float = 5e-4, optimizer: str = "sgd"):
+        self.model = model
+        self.tx = make_optimizer(optimizer, lr, momentum, weight_decay)
+        self._fwd = jax.jit(self._forward)
+        self._bwd = jax.jit(self._backward)
+
+    def init(self, rng, sample_x):
+        params = self.model.init(rng, sample_x)["params"]
+        return params, self.tx.init(params)
+
+    def _forward(self, params, x):
+        return self.model.apply({"params": params}, x)
+
+    def _backward(self, params, opt_state, x, g):
+        _acts, vjp = jax.vjp(
+            lambda p: self.model.apply({"params": p}, x), params)
+        grads = vjp(g)[0]
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def forward(self, params, x) -> jax.Array:
+        return self._fwd(params, jnp.asarray(x))
+
+    def backward(self, params, opt_state, x, grads):
+        return self._bwd(params, opt_state, jnp.asarray(x),
+                         jnp.asarray(grads))
+
+
+class SplitServerCompute:
+    """Server upper-net numerics: logits + loss + activation gradients in
+    one jitted step (server.py:40-60 forward_pass+backward_pass fused)."""
+
+    def __init__(self, model, lr: float = 0.1, momentum: float = 0.9,
+                 weight_decay: float = 5e-4, optimizer: str = "sgd"):
+        self.model = model
+        self.tx = make_optimizer(optimizer, lr, momentum, weight_decay)
+        self._step = jax.jit(self._train_step)
+        self._ev = jax.jit(self._eval_step)
+
+    def init(self, rng, sample_acts):
+        params = self.model.init(rng, sample_acts)["params"]
+        return params, self.tx.init(params)
+
+    def _train_step(self, params, opt_state, acts, y, mask):
+        def loss_fn(p, a):
+            logits = self.model.apply({"params": p}, a)
+            return masked_cross_entropy(logits, y, mask), logits
+        (loss, logits), (gp, ga) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, acts)
+        updates, opt_state = self.tx.update(gp, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        correct, count = masked_accuracy_sums(logits, y, mask)
+        return params, opt_state, ga, loss, correct, count
+
+    def _eval_step(self, params, acts, y, mask):
+        logits = self.model.apply({"params": params}, acts)
+        loss = masked_cross_entropy(logits, y, mask)
+        correct, count = masked_accuracy_sums(logits, y, mask)
+        return loss, correct, count
+
+    def train_step(self, params, opt_state, acts, y, mask):
+        return self._step(params, opt_state, jnp.asarray(acts),
+                          jnp.asarray(y), jnp.asarray(mask))
+
+    def eval_step(self, params, acts, y, mask):
+        return self._ev(params, jnp.asarray(acts), jnp.asarray(y),
+                        jnp.asarray(mask))
+
+
+class SplitNNClientManager(ClientManager):
+    """client_manager.py:17-107 over the new comm layer.  Clients are ranks
+    1..max_rank; rank 1 starts the protocol; after each epoch+validation the
+    semaphore passes to node_right."""
+
+    def __init__(self, compute: SplitClientCompute, params, opt_state,
+                 train_shard: dict, test_shard: dict, rank: int,
+                 max_rank: int, epochs: int, server_rank: int = 0,
+                 backend: str = "INPROC", **kw):
+        super().__init__(rank, max_rank + 1, backend, **kw)
+        self.compute = compute
+        self.params, self.opt_state = params, opt_state
+        self.train_shard, self.test_shard = train_shard, test_shard
+        self.max_rank = max_rank
+        self.node_right = 1 if rank == max_rank else rank + 1
+        self.server_rank = server_rank
+        self.max_epochs = epochs          # MAX_EPOCH_PER_NODE
+        self.epoch_count = 0              # this node's completed epochs
+        self.batch_idx = 0
+        self.phase = "train"
+        self.done = threading.Event()
+
+    # -- protocol ------------------------------------------------------------
+    def start_protocol(self):
+        """Rank 1 kicks off training (client_manager.py:17-21 run())."""
+        if self.rank == 1:
+            self.run_forward_pass()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            SplitNNMessage.MSG_TYPE_C2C_SEMAPHORE, self.handle_semaphore)
+        self.register_message_receive_handler(
+            SplitNNMessage.MSG_TYPE_S2C_GRADS, self.handle_gradients)
+
+    def _shard(self):
+        return self.train_shard if self.phase == "train" else self.test_shard
+
+    def _n_batches(self):
+        return self._shard()["x"].shape[0]
+
+    def _batch(self):
+        i = self.batch_idx
+        s = self._shard()
+        return s["x"][i], s["y"][i], s["mask"][i]
+
+    def run_forward_pass(self):
+        x, y, mask = self._batch()
+        acts = np.asarray(self.compute.forward(self.params, x))
+        self._last_x = x
+        m = Message(SplitNNMessage.MSG_TYPE_C2S_SEND_ACTS, self.rank,
+                    self.server_rank)
+        m.add_params(SplitNNMessage.MSG_ARG_KEY_ACTS, acts)
+        m.add_params(SplitNNMessage.MSG_ARG_KEY_LABELS, np.asarray(y))
+        m.add_params(SplitNNMessage.MSG_ARG_KEY_MASK, np.asarray(mask))
+        self.send_message(m)
+        self.batch_idx += 1
+
+    def handle_semaphore(self, _msg: Message):
+        self.phase, self.batch_idx = "train", 0
+        self.run_forward_pass()
+
+    def handle_gradients(self, msg: Message):
+        grads = msg.get(SplitNNMessage.MSG_ARG_KEY_GRADS)
+        self.params, self.opt_state = self.compute.backward(
+            self.params, self.opt_state, self._last_x, grads)
+        if self.batch_idx == self._n_batches():
+            self.run_eval()
+        else:
+            self.run_forward_pass()
+
+    def run_eval(self):
+        """Per-epoch validation sweep, then hand the semaphore on
+        (client_manager.py:44-60)."""
+        self.send_signal(SplitNNMessage.MSG_TYPE_C2S_VALIDATION_MODE)
+        self.phase, self.batch_idx = "eval", 0
+        for _ in range(self._n_batches()):
+            self.run_forward_pass()
+        self.send_signal(SplitNNMessage.MSG_TYPE_C2S_VALIDATION_OVER)
+        self.epoch_count += 1
+        if (self.epoch_count == self.max_epochs
+                and self.rank == self.max_rank):
+            self.send_signal(SplitNNMessage.MSG_TYPE_C2S_PROTOCOL_FINISHED)
+        else:
+            m = Message(SplitNNMessage.MSG_TYPE_C2C_SEMAPHORE, self.rank,
+                        self.node_right)
+            self.send_message(m)
+        if self.epoch_count == self.max_epochs:
+            self.done.set()
+            self.finish()
+
+    def send_signal(self, msg_type):
+        self.send_message(Message(msg_type, self.rank, self.server_rank))
+
+
+class SplitNNServerManager(ServerManager):
+    """server_manager.py:14-45 + server.py:40-72: owns the upper net,
+    answers every train activation with gradients, accumulates validation
+    stats, rotates the active node on validation-over."""
+
+    def __init__(self, compute: SplitServerCompute, params, opt_state,
+                 max_rank: int, rank: int = 0, backend: str = "INPROC", **kw):
+        super().__init__(rank, max_rank + 1, backend, **kw)
+        self.compute = compute
+        self.params, self.opt_state = params, opt_state
+        self.max_rank = max_rank
+        self.active_node = 1
+        self.phase = "train"
+        self.epoch = 0
+        self._reset_stats()
+        self.val_history: list[dict] = []
+        self.done = threading.Event()
+
+    def _reset_stats(self):
+        self.total = 0.0
+        self.correct = 0.0
+        self.val_loss_sum = 0.0
+        self.step = 0
+
+    def register_message_receive_handlers(self):
+        M = SplitNNMessage
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_ACTS, self.handle_acts)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_VALIDATION_MODE, self.handle_validation_mode)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_VALIDATION_OVER, self.handle_validation_over)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_PROTOCOL_FINISHED, self.handle_finish)
+
+    def handle_acts(self, msg: Message):
+        acts = msg.get(SplitNNMessage.MSG_ARG_KEY_ACTS)
+        y = msg.get(SplitNNMessage.MSG_ARG_KEY_LABELS)
+        mask = msg.get(SplitNNMessage.MSG_ARG_KEY_MASK)
+        if self.phase == "train":
+            (self.params, self.opt_state, ga, loss, correct,
+             count) = self.compute.train_step(self.params, self.opt_state,
+                                              acts, y, mask)
+            reply = Message(SplitNNMessage.MSG_TYPE_S2C_GRADS, self.rank,
+                            msg.get_sender_id())
+            reply.add_params(SplitNNMessage.MSG_ARG_KEY_GRADS,
+                             np.asarray(ga))
+            self.send_message(reply)
+        else:
+            loss, correct, count = self.compute.eval_step(
+                self.params, acts, y, mask)
+            self.val_loss_sum += float(loss)
+        self.correct += float(correct)
+        self.total += float(count)
+        self.step += 1
+
+    def handle_validation_mode(self, _msg: Message):
+        self.phase = "validation"
+        self._reset_stats()
+
+    def handle_validation_over(self, _msg: Message):
+        """server.py:62-72 validation_over: record stats, rotate the active
+        node, back to train mode."""
+        acc = self.correct / max(self.total, 1.0)
+        self.val_history.append({
+            "epoch": self.epoch, "val_acc": acc,
+            "val_loss": self.val_loss_sum / max(self.step, 1),
+            "active_node": self.active_node})
+        log.info("splitnn epoch %d: val_acc=%.4f (node %d)", self.epoch,
+                 acc, self.active_node)
+        self.epoch += 1
+        self.active_node = (self.active_node % self.max_rank) + 1
+        self.phase = "train"
+        self._reset_stats()
+
+    def handle_finish(self, _msg: Message):
+        self.done.set()
+        self.finish()

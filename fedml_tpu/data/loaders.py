@@ -19,7 +19,7 @@ from fedml_tpu.core.partition import (partition_dirichlet, partition_homo,
                                       partition_power_law)
 from fedml_tpu.data.federated import (FederatedData, build_client_shards,
                                       build_eval_shard)
-from fedml_tpu.data import readers, synthetic
+from fedml_tpu.data import readers, synthetic, text
 
 CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)
 CIFAR10_STD = (0.2470, 0.2435, 0.2616)
@@ -194,32 +194,122 @@ def load_data(dataset: str,
         return _make(x_tr, y_tr, xt, yt, idx_map, bs, 100,
                      max_batches_per_client, None, seed, synthetic=synth)
 
-    if dataset in ("shakespeare", "fed_shakespeare"):
-        seq_len, vocab = 80, 90
-        x, y = synthetic.synthetic_sequences(sc(16000), seq_len, vocab, seed=seed)
-        n_te = sc(16000) // 8
-        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
-        idx_map = partition_homo(len(y_tr), C, seed)
+    if dataset == "shakespeare":
+        # LEAF JSON text: 80-char windows -> next char (reference
+        # shakespeare/data_loader.py:11-87, language_utils.py:31-55)
+        seq_len, vocab = text.SHAKESPEARE_SEQ_LEN, text.SHAKESPEARE_VOCAB_SIZE
+        try:
+            users, user_data = readers.read_leaf_dir(
+                os.path.join(data_dir or "", "train"))
+            users_te, user_data_te = readers.read_leaf_dir(
+                os.path.join(data_dir, "test"))
+            x_tr, y_tr, idx_map = text.leaf_shakespeare_to_arrays(
+                users[:C], user_data)
+            xt, yt, te_map = text.leaf_shakespeare_to_arrays(
+                users_te[:C], user_data_te)
+            synth = False
+        except FileNotFoundError:
+            synth, te_map = True, None
+            x, y = synthetic.synthetic_sequences(sc(16000), seq_len, vocab,
+                                                 seed=seed)
+            n_te = sc(16000) // 8
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            # next-char task: label = last-position next token
+            y_tr, yt = y_tr[:, -1], yt[:, -1]
+            idx_map = partition_homo(len(y_tr), C, seed)
         return _make(x_tr, y_tr, xt, yt, idx_map, bs, vocab,
-                     max_batches_per_client, None, seed, synthetic=True)
+                     max_batches_per_client, te_map, seed, synthetic=synth)
+
+    if dataset == "fed_shakespeare":
+        # TFF h5 snippets -> 80-token shifted sequences (reference
+        # fed_shakespeare/utils.py:53-82, data_loader.py:24-69)
+        seq_len, vocab = text.SHAKESPEARE_SEQ_LEN, text.SHAKESPEARE_VOCAB_SIZE
+        try:
+            h5 = readers.read_tff_h5(
+                os.path.join(data_dir or "", "shakespeare_train.h5"),
+                ("snippets",))
+            h5t = readers.read_tff_h5(
+                os.path.join(data_dir, "shakespeare_test.h5"), ("snippets",))
+            xs, ys, idx_map, off = [], [], {}, 0
+            for i, cid in enumerate(sorted(h5)[:C]):
+                sx, sy = text.tff_snippets_to_sequences(
+                    text._decode(h5[cid]["snippets"]), seq_len)
+                xs.append(sx); ys.append(sy)
+                idx_map[i] = np.arange(off, off + len(sy)); off += len(sy)
+            x_tr, y_tr = np.concatenate(xs), np.concatenate(ys)
+            parts = [text.tff_snippets_to_sequences(
+                text._decode(h5t[c]["snippets"]), seq_len) for c in sorted(h5t)]
+            xt = np.concatenate([p[0] for p in parts])
+            yt = np.concatenate([p[1] for p in parts])
+            synth = False
+        except FileNotFoundError:
+            synth = True
+            x, y = synthetic.synthetic_sequences(sc(16000), seq_len, vocab,
+                                                 seed=seed)
+            n_te = sc(16000) // 8
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = partition_homo(len(y_tr), C, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, vocab,
+                     max_batches_per_client, None, seed, synthetic=synth)
 
     if dataset == "stackoverflow_nwp":
-        seq_len, vocab = 20, 10004
-        x, y = synthetic.synthetic_sequences(sc(20000), seq_len, vocab, seed=seed)
-        n_te = sc(20000) // 8
-        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
-        idx_map = partition_homo(len(y_tr), C, seed)
-        return _make(x_tr, y_tr, xt, yt, idx_map, bs, vocab,
-                     max_batches_per_client, None, seed, synthetic=True)
+        # TFF h5 word streams + stackoverflow.word_count vocabulary
+        # (reference stackoverflow_nwp/utils.py:27-86, dataset.py:45-51)
+        seq_len, vocab_len = 20, 10004
+        try:
+            words = text.read_word_count_vocab(
+                os.path.join(data_dir or "", "stackoverflow.word_count"))
+            wv = text.WordVocab(words)
+            h5 = readers.read_tff_h5(
+                os.path.join(data_dir, "stackoverflow_train.h5"), ("tokens",))
+            h5t = readers.read_tff_h5(
+                os.path.join(data_dir, "stackoverflow_test.h5"), ("tokens",))
+            x_tr, y_tr, idx_map = text.stackoverflow_nwp_arrays(
+                h5, wv, seq_len, max_clients=C)
+            xt, yt, te_map = text.stackoverflow_nwp_arrays(
+                h5t, wv, seq_len, max_clients=C)
+            vocab_len = wv.vocab_len
+            synth = False
+        except FileNotFoundError:
+            synth, te_map = True, None
+            x, y = synthetic.synthetic_sequences(sc(20000), seq_len, vocab_len,
+                                                 seed=seed)
+            n_te = sc(20000) // 8
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = partition_homo(len(y_tr), C, seed)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, vocab_len,
+                     max_batches_per_client, te_map, seed, synthetic=synth)
 
     if dataset == "stackoverflow_lr":
+        # bag-of-words -> multi-hot tags, vocab/tag files + h5
+        # (reference stackoverflow_lr/utils.py:33-131, dataset.py:54-62)
         dim, n_tags = 10000, 500
-        x, y = synthetic.synthetic_multilabel(sc(20000), dim, n_tags, seed=seed)
-        n_te = sc(20000) // 8
-        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
-        idx_map = partition_homo(len(y_tr), C, seed)
+        try:
+            words = text.BagOfWordsVocab(text.read_word_count_vocab(
+                os.path.join(data_dir or "", "stackoverflow.word_count"), dim))
+            tags = text.TagVocab(text.read_tag_count_vocab(
+                os.path.join(data_dir, "stackoverflow.tag_count"), n_tags))
+            h5 = readers.read_tff_h5(
+                os.path.join(data_dir, "stackoverflow_train.h5"),
+                ("tokens", "title", "tags"))
+            h5t = readers.read_tff_h5(
+                os.path.join(data_dir, "stackoverflow_test.h5"),
+                ("tokens", "title", "tags"))
+            x_tr, y_tr, idx_map = text.stackoverflow_lr_arrays(
+                h5, words, tags, max_clients=C)
+            xt, yt, te_map = text.stackoverflow_lr_arrays(
+                h5t, words, tags, max_clients=C)
+            dim, n_tags = words.dim, tags.dim
+            synth = False
+        except FileNotFoundError:
+            synth, te_map = True, None
+            x, y = synthetic.synthetic_multilabel(sc(20000), dim, n_tags,
+                                                  seed=seed)
+            n_te = sc(20000) // 8
+            x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+            idx_map = partition_homo(len(y_tr), C, seed)
         return _make(x_tr, y_tr, xt, yt, idx_map, bs, n_tags,
-                     max_batches_per_client, None, seed, synthetic=True)
+                     max_batches_per_client, te_map, seed, synthetic=synth)
 
     if dataset in ("cifar10", "cifar100", "cinic10"):
         n_classes = 100 if dataset == "cifar100" else 10

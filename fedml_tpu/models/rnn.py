@@ -15,15 +15,21 @@ import jax.numpy as jnp
 
 
 class RNNOriginalFedAvg(nn.Module):
+    """`last_only=True` is the LEAF-shakespeare mode: one next-char logit
+    from the final hidden state (reference rnn.py:30-33); False is the
+    fed_shakespeare per-position mode (rnn.py:34-36)."""
     vocab_size: int = 90
     embedding_dim: int = 8
     hidden_size: int = 256
+    last_only: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
         h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
         h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        if self.last_only:
+            h = h[:, -1]
         return nn.Dense(self.vocab_size)(h)
 
 

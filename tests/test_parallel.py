@@ -131,6 +131,57 @@ def test_gossip_learns():
     assert acc > 0.5, acc
 
 
+def test_streaming_matches_resident():
+    """Streaming cohort upload (host-gather, VERDICT r1 #5) must reproduce
+    the HBM-resident path exactly — same sampling, same chunked round."""
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=3)
+    trainer, data = _setup(cfg)
+    res = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False)
+    v0 = res.init_variables()
+    v_res = res.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    stream = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                              donate=False, streaming=True)
+    v_str = stream.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for a, b in zip(jax.tree.leaves(v_res), jax.tree.leaves(v_str)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_chunk_size_invariance():
+    """The chunked cohort scan (perf: bounds live model replicas) must not
+    change results vs one full-width chunk."""
+    cfg = _mnist_like_cfg(comm_round=2)
+    trainer, data = _setup(cfg)
+    wide = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                            donate=False, chunk=16)
+    v0 = wide.init_variables()
+    v_w = wide.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    narrow = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                              donate=False, chunk=1)
+    v_n = narrow.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_w), jax.tree.leaves(v_n)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_streaming_large_client_count():
+    """Femnist-shaped scale proxy: many clients, tiny per-round cohort —
+    the streaming path never uploads the full stack."""
+    cfg = _mnist_like_cfg(client_num_in_total=96, client_num_per_round=8,
+                          comm_round=2)
+    data = load_data("mnist", client_num_in_total=96, batch_size=8,
+                     synthetic_scale=0.02, seed=0)
+    model = create_model("lr", output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=0.1)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           streaming=True)
+    assert eng._stack is None
+    v = eng.run(rounds=2)
+    assert eng._stack is None          # full stack never touched the device
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
+
+
 def test_multihost_mesh_helpers():
     """Single-process: helpers still build valid meshes over local devices
     (multi-host wiring is a no-op here)."""

@@ -113,12 +113,15 @@ def exp_B():
     print(f"B centralized_ceiling: {dt:.3f}s/round-equivalent", flush=True)
 
 
-def _chunked_round(chunk):
+def _chunked_round(chunk, data_dtype=None):
     """Chunked cohort: scan over 128/chunk groups, weighted-sum in carry."""
     model = create_model("resnet18_gn", output_dim=10)
     trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
     rs = np.random.RandomState(0)
     shard = client_batches(rs)
+    if data_dtype is not None:
+        shard = {"x": shard["x"].astype(data_dtype), "y": shard["y"],
+                 "mask": shard["mask"]}
     weights = jnp.full((N_CLIENTS,), float(SPC), jnp.float32)
     variables = trainer.init(jax.random.PRNGKey(0), shard["x"][0, 0, :1])
     rngs = jax.random.split(jax.random.PRNGKey(1), N_CLIENTS)
@@ -157,6 +160,10 @@ def _chunked_round(chunk):
     return dt
 
 
+def exp_F4():
+    print(f"F4 chunked(4): {_chunked_round(4):.3f}s/round", flush=True)
+
+
 def exp_F8():
     print(f"F8 chunked(8): {_chunked_round(8):.3f}s/round", flush=True)
 
@@ -167,6 +174,21 @@ def exp_F16():
 
 def exp_F32():
     print(f"F32 chunked(32): {_chunked_round(32):.3f}s/round", flush=True)
+
+
+def exp_F64():
+    print(f"F64 chunked(64): {_chunked_round(64):.3f}s/round", flush=True)
+
+
+def exp_H16():
+    """chunked(16) with the data stack stored bf16 (halves HBM reads)."""
+    print(f"H16 chunked(16,bf16 data): "
+          f"{_chunked_round(16, jnp.bfloat16):.3f}s/round", flush=True)
+
+
+def exp_H32():
+    print(f"H32 chunked(32,bf16 data): "
+          f"{_chunked_round(32, jnp.bfloat16):.3f}s/round", flush=True)
 
 
 if __name__ == "__main__":
