@@ -1,0 +1,7 @@
+"""`python -m fedml_tpu` — the unified launcher (cli.py)."""
+import sys
+
+from fedml_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

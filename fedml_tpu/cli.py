@@ -60,6 +60,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--train_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--max_batches_per_client", type=int, default=None)
+    p.add_argument("--augment", action="store_true",
+                   help="crop+flip(+cutout) augmentation in the train step")
+    # real multi-process deployment (the reference's run_fedavg_grpc.sh /
+    # run_fedavg_trpc.sh launch pattern): one process per rank
+    p.add_argument("--deploy", choices=("server", "client"), default=None,
+                   help="run ONE deployment rank over sockets instead of "
+                        "the in-process simulation")
+    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--world_size", type=int, default=3,
+                   help="server + clients (deployment mode)")
+    p.add_argument("--comm_backend", type=str, default="TCP",
+                   choices=("GRPC", "TCP", "NATIVE_TCP"))
+    p.add_argument("--base_port", type=int, default=52000)
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
     p.add_argument("--mesh", action="store_true",
                    help="shard the cohort over all visible devices")
@@ -113,10 +126,19 @@ def _trainer(cfg: FedConfig, data):
           if cfg.model == "rnn" and cfg.dataset == "shakespeare" else {})
     model = create_model(cfg.model, data.class_num, **kw)
     dtype = jnp.bfloat16 if cfg.train_dtype == "bfloat16" else jnp.float32
+    aug = None
+    if cfg.augment:
+        if data.client_shards["x"].ndim != 6:   # [C, B, bs, H, W, ch] images
+            raise SystemExit("--augment requires an image dataset")
+        from fedml_tpu.data.augment import make_augment_fn
+        cut = 16 if cfg.dataset in ("cifar10", "cifar100", "cinic10",
+                                    "fed_cifar100") else None
+        aug = make_augment_fn(crop_padding=4, flip=True, cutout_length=cut)
     return ClientTrainer(model, loss=loss, optimizer=cfg.client_optimizer,
                          lr=cfg.lr, momentum=cfg.momentum,
                          weight_decay=cfg.wd, prox_mu=cfg.prox_mu,
-                         has_time_axis=has_time, train_dtype=dtype)
+                         has_time_axis=has_time, train_dtype=dtype,
+                         augment=aug)
 
 
 def build_engine(args, cfg: FedConfig, data):
@@ -242,6 +264,54 @@ def build_engine(args, cfg: FedConfig, data):
     raise ValueError(f"unknown algorithm {algo!r}")
 
 
+def _run_deployment(args, cfg: FedConfig, logger) -> int:
+    """One deployment rank over real sockets (reference run_fedavg_grpc.sh /
+    run_fedavg_trpc.sh: N OS processes, rank 0 = server).  Both roles load
+    the dataset (clients need shards, the server needs the init model and
+    eval split); the model exchange runs the fedavg_messaging FSM."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.comm.fedavg_messaging import (FedAvgAggregator,
+                                                 FedAvgClientManager,
+                                                 FedAvgServerManager)
+
+    data = _load(cfg)
+    trainer = _trainer(cfg, data)
+    size = args.world_size
+    ip_config = {r: "127.0.0.1" for r in range(size)}
+    kw = dict(ip_config=ip_config, base_port=args.base_port)
+
+    if args.deploy == "server":
+        init_vars = trainer.init(
+            jax.random.PRNGKey(cfg.seed),
+            jnp.asarray(data.client_shards["x"][0, 0]))
+        agg = FedAvgAggregator(init_vars, size - 1,
+                               cfg.client_num_in_total, size - 1)
+        server = FedAvgServerManager(agg, cfg.comm_round, 0, size,
+                                     args.comm_backend, **kw)
+        server.run_async()
+        server.send_init_msg()
+        if not server.done.wait(timeout=600):
+            server.finish()
+            raise TimeoutError("deployment server: rounds did not finish")
+        server.finish()
+        variables = jax.tree.map(jnp.asarray, agg.variables)
+        eval_fn = jax.jit(trainer.evaluate)
+        sums = eval_fn(variables, jax.tree.map(jnp.asarray,
+                                               data.test_global))
+        cnt = max(float(sums["count"]), 1.0)
+        logger.log({"test_acc": float(sums["correct"]) / cnt,
+                    "test_loss": float(sums["loss_sum"]) / cnt,
+                    "rounds": server.round_idx})
+        return 0
+
+    client = FedAvgClientManager(trainer, data, cfg.epochs, args.rank, size,
+                                 args.comm_backend,
+                                 total_rounds=cfg.comm_round, **kw)
+    client.run()            # blocks until total_rounds uploads are done
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
@@ -256,6 +326,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     from fedml_tpu.utils.metrics import RunLogger
     logger = RunLogger(root=args.run_dir, project="fedml_tpu",
                        name=args.run_name, config=vars(args))
+
+    if args.deploy:
+        rc = _run_deployment(args, cfg, logger)
+        logger.finish()
+        return rc
     ckpt = None
     if args.ckpt_dir:
         from fedml_tpu.utils.checkpoint import FedCheckpointManager

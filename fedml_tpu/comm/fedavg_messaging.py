@@ -209,11 +209,20 @@ class FedAvgClientManager(ClientManager):
     train locally (the jitted ClientTrainer hot loop), upload."""
 
     def __init__(self, trainer, data, epochs: int, rank: int, size: int,
-                 backend: str = "INPROC", **kw):
+                 backend: str = "INPROC", total_rounds: Optional[int] = None,
+                 **kw):
+        """total_rounds: in multi-PROCESS deployments the client must stop
+        itself — it counts model syncs (the server sends exactly one per
+        round, reference FedAvgClientManager.py:60-66) and finishes after
+        uploading the last one.  None (in-process simulation) leaves
+        shutdown to the launcher."""
         super().__init__(rank, size, backend, **kw)
         self.trainer = trainer
         self.data = data
         self.epochs = epochs
+        self.total_rounds = total_rounds
+        self.rounds_seen = 0
+        self.done = threading.Event()
         self._local_train = jax.jit(
             lambda v, shard, rng: trainer.local_train(
                 v, shard, rng, self.epochs),
@@ -243,6 +252,11 @@ class FedAvgClientManager(ClientManager):
         if round_idx is not None:       # echo for stale-upload rejection
             out.add_params(MyMessage.MSG_ARG_KEY_ROUND, int(round_idx))
         self.send_message(out)
+        self.rounds_seen += 1
+        if (self.total_rounds is not None
+                and self.rounds_seen >= self.total_rounds):
+            self.done.set()
+            self.finish()
 
 
 def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",

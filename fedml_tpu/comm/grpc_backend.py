@@ -87,7 +87,10 @@ class GrpcBackend(BaseCommManager):
 
     def send_message(self, msg: Message) -> None:
         payload = MessageCodec.encode(msg)
-        self._stub(msg.get_receiver_id())(payload, timeout=1800)
+        # wait_for_ready rides out the multi-process startup race (peer's
+        # server not bound yet) instead of failing UNAVAILABLE immediately
+        self._stub(msg.get_receiver_id())(payload, timeout=1800,
+                                          wait_for_ready=True)
 
     def close(self) -> None:
         for ch in self._channels.values():

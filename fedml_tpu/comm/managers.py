@@ -49,7 +49,8 @@ def _build_backend(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
     if b == "MQTT":
         from fedml_tpu.comm.mqtt_backend import MqttBackend
         return MqttBackend(rank, size, host=kw.get("host", "127.0.0.1"),
-                           port=kw.get("port", 1883))
+                           port=kw.get("port", 1883),
+                           client_factory=kw.get("client_factory"))
     raise ValueError(f"unknown comm backend {backend!r}")
 
 

@@ -23,17 +23,24 @@ _TOPIC_C2S = "fedml_"      # client <id> → server
 
 class MqttBackend(BaseCommManager):
     def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
-                 port: int = 1883, keepalive: int = 180):
+                 port: int = 1883, keepalive: int = 180,
+                 client_factory=None):
+        """client_factory(client_id=...) -> paho-compatible client; defaults
+        to paho.mqtt.Client.  Tests inject an in-memory broker's factory so
+        the topic scheme is verifiable without a broker daemon."""
         super().__init__()
-        try:
-            import paho.mqtt.client as mqtt
-        except ImportError as e:          # pragma: no cover - env-dependent
-            raise RuntimeError(
-                "MQTT backend requires paho-mqtt, which is not installed in "
-                "this image; use GRPC or TCP for remote participants") from e
+        if client_factory is None:
+            try:
+                import paho.mqtt.client as mqtt
+            except ImportError as e:      # pragma: no cover - env-dependent
+                raise RuntimeError(
+                    "MQTT backend requires paho-mqtt, which is not installed "
+                    "in this image; use GRPC or TCP for remote participants, "
+                    "or inject a client_factory") from e
+            client_factory = mqtt.Client
         self.rank = rank
         self.size = size
-        self._mqtt = mqtt.Client(client_id=f"fedml_tpu_{rank}")
+        self._mqtt = client_factory(client_id=f"fedml_tpu_{rank}")
         self._mqtt.on_message = self._on_mqtt_message
         self._mqtt.connect(host, port, keepalive)
         if rank == 0:   # server listens to every client's uplink

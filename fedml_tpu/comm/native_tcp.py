@@ -16,6 +16,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import threading
+import time
 from typing import Union
 
 from fedml_tpu.comm.base import BaseCommManager
@@ -68,15 +69,25 @@ class NativeTcpBackend(BaseCommManager):
             except Exception:     # malformed frame: drop, keep serving
                 log.exception("undecodable frame (%d bytes)", length.value)
 
-    def _connect_locked(self, receiver: int):
+    def _connect_locked(self, receiver: int, retry_for: float = 30.0):
         c = self._conns.get(receiver)
         if c is None:
             host = self.ip_config[receiver].encode()
-            c = self._lib.fh_connect(host, self.base_port + receiver)
-            if not c:
-                raise ConnectionError(
-                    f"cannot reach rank {receiver} at "
-                    f"{self.ip_config[receiver]}:{self.base_port + receiver}")
+            # ride out the multi-process startup race (peer's listener not
+            # bound yet).  This holds _conn_lock while retrying — acceptable
+            # because this transport serializes sends by design (see
+            # send_message) and the race only exists at launch.
+            deadline = time.monotonic() + retry_for
+            while True:
+                c = self._lib.fh_connect(host, self.base_port + receiver)
+                if c:
+                    break
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"cannot reach rank {receiver} at "
+                        f"{self.ip_config[receiver]}:"
+                        f"{self.base_port + receiver}")
+                time.sleep(0.2)
             self._conns[receiver] = c
         return c
 

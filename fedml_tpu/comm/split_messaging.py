@@ -49,6 +49,7 @@ class SplitNNMessage:
     MSG_ARG_KEY_LABELS = "labels"
     MSG_ARG_KEY_MASK = "mask"
     MSG_ARG_KEY_GRADS = "activation_grads"
+    MSG_ARG_KEY_PHASE = "phase"
 
 
 class SplitClientCompute:
@@ -180,6 +181,13 @@ class SplitNNClientManager(ClientManager):
         m.add_params(SplitNNMessage.MSG_ARG_KEY_ACTS, acts)
         m.add_params(SplitNNMessage.MSG_ARG_KEY_LABELS, np.asarray(y))
         m.add_params(SplitNNMessage.MSG_ARG_KEY_MASK, np.asarray(mask))
+        # the phase rides WITH the activations: over real sockets, messages
+        # from different clients arrive on different connections and can
+        # reorder vs the VALIDATION_MODE/OVER signals — the server must not
+        # infer this batch's phase from its own (possibly stale) state, or
+        # a train batch handled in 'validation' never gets its gradients
+        # back and that client deadlocks
+        m.add_params(SplitNNMessage.MSG_ARG_KEY_PHASE, self.phase)
         self.send_message(m)
         self.batch_idx += 1
 
@@ -259,7 +267,9 @@ class SplitNNServerManager(ServerManager):
         acts = msg.get(SplitNNMessage.MSG_ARG_KEY_ACTS)
         y = msg.get(SplitNNMessage.MSG_ARG_KEY_LABELS)
         mask = msg.get(SplitNNMessage.MSG_ARG_KEY_MASK)
-        if self.phase == "train":
+        # per-message phase (see client): ordering-independent branch
+        phase = msg.get(SplitNNMessage.MSG_ARG_KEY_PHASE, self.phase)
+        if phase == "train":
             (self.params, self.opt_state, ga, loss, correct,
              count) = self.compute.train_step(self.params, self.opt_state,
                                               acts, y, mask)

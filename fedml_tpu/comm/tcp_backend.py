@@ -15,6 +15,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Union
 
 from fedml_tpu.comm.base import BaseCommManager
@@ -70,16 +71,34 @@ class TcpBackend(BaseCommManager):
         except (ConnectionError, OSError):
             conn.close()
 
-    def _connect(self, receiver: int) -> socket.socket:
+    def _connect(self, receiver: int, retry_for: float = 60.0) -> socket.socket:
         with self._conn_lock:
             s = self._conns.get(receiver)
-            if s is None:
+        if s is not None:
+            return s
+        # multi-process launches race: the peer's listener may not be bound
+        # yet (run_fedavg_grpc.sh starts all ranks at once), so refused
+        # connections retry with backoff — OUTSIDE the lock, so one slow
+        # peer cannot stall sends to the others (or close())
+        deadline = time.monotonic() + retry_for
+        while True:
+            try:
                 s = socket.create_connection(
                     (self.ip_config[receiver], self.base_port + receiver),
                     timeout=30)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[receiver] = s
-            return s
+                break
+            except ConnectionRefusedError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._conn_lock:
+            racer = self._conns.get(receiver)
+            if racer is not None:           # lost a concurrent connect race
+                s.close()
+                return racer
+            self._conns[receiver] = s
+        return s
 
     def send_message(self, msg: Message) -> None:
         payload = MessageCodec.encode(msg)

@@ -111,13 +111,17 @@ class ClientTrainer:
                  lr: float = 0.03, momentum: float = 0.0,
                  weight_decay: float = 0.0, prox_mu: float = 0.0,
                  has_time_axis: bool = False,
-                 train_dtype=jnp.float32):
+                 train_dtype=jnp.float32,
+                 augment: Optional[Callable] = None):
         self.model = model
         self.loss_name = loss
         self.tx = make_optimizer(optimizer, lr, momentum, weight_decay)
         self.prox_mu = prox_mu
         self.has_time_axis = has_time_axis
         self.train_dtype = train_dtype
+        # training-time augmentation (rng, x) -> x, applied ONLY in the
+        # train-step loss (data/augment.py); eval paths never see it
+        self.augment = augment
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array, sample_input: jax.Array) -> Pytree:
@@ -138,6 +142,9 @@ class ClientTrainer:
         is bfloat16 the forward/backward compute runs through bf16 casts —
         the MXU recipe: bf16 matmuls, f32 accumulation and update."""
         x, y, mask = batch["x"], batch["y"], batch["mask"]
+        if self.augment is not None:
+            rng, aug_rng = jax.random.split(rng)
+            x = self.augment(aug_rng, x)
         rngs = {"dropout": rng}
         half = self.train_dtype != jnp.float32
         apply_params = self._cast_floats(params, self.train_dtype) if half else params

@@ -1,0 +1,75 @@
+"""Jit-compatible training-time image augmentation.
+
+Parity: the reference's torchvision pipeline — RandomCrop(32, padding=4) +
+RandomHorizontalFlip + Cutout(16) (cifar10/data_loader.py:57-98) — runs on
+CPU workers per sample.  TPU-native, augmentation is a pure batched
+function of (rng, x) executed INSIDE the jitted train step: per-sample
+crop offsets via vmapped dynamic_slice, flips and cutout as masked selects.
+XLA fuses the whole thing into the input pipeline of the first conv —
+zero host round-trips, reproducible from the client rng.
+
+Eval paths never call this (ClientTrainer applies it only under
+train=True), so augmentation is a no-op at eval by construction.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop(rng: jax.Array, x: jax.Array, padding: int = 4) -> jax.Array:
+    """RandomCrop(H, padding): zero-pad then take a random HxW window per
+    sample.  x: [bs, H, W, C]."""
+    bs, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (padding, padding), (padding, padding), (0, 0)))
+    ry, rx = jax.random.split(rng)
+    ys = jax.random.randint(ry, (bs,), 0, 2 * padding + 1)
+    xs = jax.random.randint(rx, (bs,), 0, 2 * padding + 1)
+
+    def one(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (y0, x0, 0), (h, w, c))
+
+    return jax.vmap(one)(xp, ys, xs)
+
+
+def random_flip(rng: jax.Array, x: jax.Array) -> jax.Array:
+    """RandomHorizontalFlip (p=0.5) per sample."""
+    flip = jax.random.bernoulli(rng, 0.5, (x.shape[0],))
+    return jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+
+
+def cutout(rng: jax.Array, x: jax.Array, length: int = 16) -> jax.Array:
+    """Cutout(length): zero a length x length square at a uniform center,
+    clipped at the borders (data_loader.py:57-77 semantics: the center is
+    uniform over the image, so edge squares are partially cut)."""
+    bs, h, w, _ = x.shape
+    ry, rx = jax.random.split(rng)
+    cy = jax.random.randint(ry, (bs, 1, 1), 0, h)
+    cx = jax.random.randint(rx, (bs, 1, 1), 0, w)
+    yy = jnp.arange(h)[None, :, None]
+    xx = jnp.arange(w)[None, None, :]
+    inside = ((yy >= cy - length // 2) & (yy < cy + length // 2)
+              & (xx >= cx - length // 2) & (xx < cx + length // 2))
+    return x * (~inside)[..., None].astype(x.dtype)
+
+
+def make_augment_fn(crop_padding: int = 4, flip: bool = True,
+                    cutout_length: Optional[int] = 16):
+    """Compose the reference CIFAR pipeline as one (rng, x) -> x function.
+    Set cutout_length=None to disable cutout (the reference only applies it
+    to CIFAR-10/100-style sets)."""
+
+    def augment(rng: jax.Array, x: jax.Array) -> jax.Array:
+        r1, r2, r3 = jax.random.split(rng, 3)
+        if crop_padding:
+            x = random_crop(r1, x, crop_padding)
+        if flip:
+            x = random_flip(r2, x)
+        if cutout_length:
+            x = cutout(r3, x, cutout_length)
+        return x
+
+    return augment

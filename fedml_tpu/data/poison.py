@@ -65,6 +65,108 @@ def poison_federated_data(data: FederatedData,
     return dataclasses.replace(data, client_shards=shards)
 
 
+def load_edge_case_pool(data_dir: Optional[str], poison_type: str,
+                        image_shape: Sequence[int] = (32, 32, 3),
+                        n_fallback: int = 784, seed: int = 7):
+    """Edge-case example pool (reference `load_poisoned_dataset`,
+    edge_case_examples/data_loader.py:283-420): naturally-plausible inputs
+    from OUTSIDE the task distribution that the attacker relabels.
+
+    Real packs when present:
+      southwest: southwest_images_new_train.pkl / southwest_images_new_test.pkl
+                 (pickled uint8 [N,32,32,3] CIFAR-shaped airline images)
+      ardis:     ARDIS/ardis_train_dataset.pt / ardis_test_dataset.pt
+                 (torch-saved MNIST-shaped digit images)
+    Fallback (zero-egress image): a tight off-distribution Gaussian cluster
+    with the same shapes — edge-case semantics (plausible, consistent,
+    unseen) without the real pixels.
+
+    Returns (x_train [N,...], x_test [M,...]) float32 in the dataset's
+    input scale."""
+    import os
+    import pickle
+    if poison_type not in ("southwest", "ardis"):
+        raise ValueError(f"unknown edge-case poison {poison_type!r}")
+    try:
+        if poison_type == "southwest":
+            base = os.path.join(data_dir or "", "southwest_cifar10")
+            with open(os.path.join(base, "southwest_images_new_train.pkl"),
+                      "rb") as f:
+                x_tr = pickle.load(f)
+            with open(os.path.join(base, "southwest_images_new_test.pkl"),
+                      "rb") as f:
+                x_te = pickle.load(f)
+            x_tr = np.asarray(x_tr, np.float32) / 255.0
+            x_te = np.asarray(x_te, np.float32) / 255.0
+        else:
+            import torch
+            base = os.path.join(data_dir or "", "ARDIS")
+            # the packs are pickled Dataset objects (arbitrary classes), so
+            # weights_only loading (torch>=2.6 default) cannot apply
+            tr = torch.load(os.path.join(base, "ardis_train_dataset.pt"),
+                            weights_only=False)
+            te = torch.load(os.path.join(base, "ardis_test_dataset.pt"),
+                            weights_only=False)
+            x_tr = np.asarray(tr.data, np.float32) / 255.0
+            x_te = np.asarray(te.data, np.float32) / 255.0
+            if x_tr.ndim == 3:
+                x_tr, x_te = x_tr[..., None], x_te[..., None]
+        return x_tr, x_te
+    except (FileNotFoundError, OSError, ImportError):
+        # ImportError: torch absent for the ardis .pt packs — same
+        # fall-back contract as a missing file
+        rs = np.random.RandomState(seed)
+        shape = tuple(image_shape)
+        # one coherent off-distribution prototype + small jitter: the
+        # "edge case" property is that the examples resemble each OTHER,
+        # not the training data
+        proto = rs.normal(2.5, 0.3, shape).astype(np.float32)
+        n_te = max(n_fallback // 4, 1)
+        x = proto + rs.normal(0, 0.2, (n_fallback + n_te,) + shape)
+        return (x[:n_fallback].astype(np.float32),
+                x[n_fallback:].astype(np.float32))
+
+
+def poison_edge_case(data: FederatedData, attacker_ids: Sequence[int],
+                     target_label: int, pool: np.ndarray,
+                     poison_frac: float = 0.5,
+                     seed: int = 0) -> FederatedData:
+    """Replace `poison_frac` of each attacker's real samples with edge-case
+    pool images labeled `target_label` (data_loader.py mixing semantics:
+    the attacker's shard is a clean/edge mixture)."""
+    rs = np.random.RandomState(seed)
+    shards = {k: np.array(v, copy=True) for k, v in data.client_shards.items()}
+    C, B, bs = shards["mask"].shape
+    for cid in attacker_ids:
+        real = np.argwhere(shards["mask"][cid].reshape(-1) > 0).reshape(-1)
+        n_poison = int(len(real) * poison_frac)
+        if n_poison == 0:
+            continue
+        chosen = rs.choice(real, n_poison, replace=False)
+        picks = rs.randint(0, len(pool), n_poison)
+        bi, si = np.unravel_index(chosen, (B, bs))
+        shards["x"][cid, bi, si] = pool[picks].astype(shards["x"].dtype)
+        shards["y"][cid, bi, si] = target_label
+    return dataclasses.replace(data, client_shards=shards)
+
+
+def edge_case_test_shard(pool_test: np.ndarray, target_label: int,
+                         batch_size: int = 64) -> dict:
+    """Backdoor-success eval shard: every edge-case test image, labeled with
+    the attacker's target (targetted_task_test_loader parity)."""
+    n = len(pool_test)
+    B = (n + batch_size - 1) // batch_size
+    pad = B * batch_size - n
+    x = np.concatenate([pool_test,
+                        np.zeros((pad,) + pool_test.shape[1:],
+                                 pool_test.dtype)])
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    y = np.full(B * batch_size, target_label, np.int64)
+    return {"x": x.reshape((B, batch_size) + pool_test.shape[1:]),
+            "y": y.reshape(B, batch_size),
+            "mask": mask.reshape(B, batch_size)}
+
+
 def backdoor_test_shard(data: FederatedData, target_label: int,
                         trigger_fn: Callable = pixel_trigger) -> dict:
     """Poisoned test set for the backdoor-success metric: every non-target

@@ -71,9 +71,11 @@ def chars_to_ids(strings: Iterable[str], lut: np.ndarray = _LEAF_LUT,
             for s in strings]
     if width is None:
         width = max((len(r) for r in rows), default=0)
+    # byte 0 is never in the vocabulary, so short strings pad to OOV ids
     out = np.zeros((len(rows), width), np.uint8)
     for i, r in enumerate(rows):
-        out[i, :width] = r[:width]
+        r = r[:width]
+        out[i, :len(r)] = r
     return lut[out]
 
 

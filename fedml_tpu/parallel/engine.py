@@ -163,33 +163,12 @@ class MeshFedAvgEngine(FedAvgEngine):
         loss = jax.lax.psum(lsum, axes) / den
         return avg, loss
 
-    def _mesh_round(self, variables, server_state, stack, stack_w, ids,
-                    wmask, rng):
-        mesh, axes = self.mesh, self.mesh.axis_names
-
-        # cohort gather: device-side take along the sharded client axis; XLA
-        # lowers the cross-shard gather to ICI collectives.
-        csh = P(axes)
-        cohort = {k: jax.lax.with_sharding_constraint(
-            jnp.take(v, ids, axis=0), NamedSharding(mesh, csh))
-            for k, v in stack.items()}
-        weights = jnp.take(stack_w, ids) * wmask
-        rng, agg_rng = jax.random.split(rng)
-        client_rngs = jax.random.split(rng, ids.shape[0])
-
-        avg, train_loss = jax.shard_map(
-            self._shard_body, mesh=mesh,
-            in_specs=(P(), csh, csh, csh), out_specs=(P(), P()))(
-                variables, cohort, weights, client_rngs)
-        new_variables, server_state = self.server_update(
-            avg, variables, server_state, agg_rng)
-        return new_variables, server_state, {"train_loss": train_loss}
-
-    def _mesh_round_streaming(self, variables, server_state, cohort, weights,
-                              rng):
-        """Streaming round: the cohort was gathered on HOST (only the
-        sampled clients' shards were uploaded, sharded over the mesh) — the
-        device never holds the full client stack."""
+    def _train_and_update(self, variables, server_state, cohort, weights,
+                          rng):
+        """Common round tail for the resident and streaming entry points:
+        shard_map the chunked cohort training, then the (replicated) server
+        update — so subclass overrides of _shard_body/server_update apply to
+        BOTH paths identically."""
         mesh = self.mesh
         csh = P(mesh.axis_names)
         rng, agg_rng = jax.random.split(rng)
@@ -201,6 +180,26 @@ class MeshFedAvgEngine(FedAvgEngine):
         new_variables, server_state = self.server_update(
             avg, variables, server_state, agg_rng)
         return new_variables, server_state, {"train_loss": train_loss}
+
+    def _mesh_round(self, variables, server_state, stack, stack_w, ids,
+                    wmask, rng):
+        # cohort gather: device-side take along the sharded client axis; XLA
+        # lowers the cross-shard gather to ICI collectives.
+        csh = P(self.mesh.axis_names)
+        cohort = {k: jax.lax.with_sharding_constraint(
+            jnp.take(v, ids, axis=0), NamedSharding(self.mesh, csh))
+            for k, v in stack.items()}
+        weights = jnp.take(stack_w, ids) * wmask
+        return self._train_and_update(variables, server_state, cohort,
+                                      weights, rng)
+
+    def _mesh_round_streaming(self, variables, server_state, cohort, weights,
+                              rng):
+        """Streaming round: the cohort was gathered on HOST (only the
+        sampled clients' shards were uploaded, sharded over the mesh) — the
+        device never holds the full client stack."""
+        return self._train_and_update(variables, server_state, cohort,
+                                      weights, rng)
 
     def stream_cohort(self, round_idx: int):
         """Host-side cohort gather for the streaming path: sample, pad to a

@@ -45,6 +45,9 @@ class FedConfig:
     # compute precision: "float32" | "bfloat16" (bf16 = the MXU fast path;
     # masters/aggregation stay f32)
     train_dtype: str = "float32"
+    # training-time image augmentation (crop+flip+cutout inside the jitted
+    # train step, data/augment.py; reference cifar10/data_loader.py:57-98)
+    augment: bool = False
     # misc
     seed: int = 0
     max_batches_per_client: Optional[int] = None

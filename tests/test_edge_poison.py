@@ -1,0 +1,119 @@
+"""Edge-case backdoor pool tests (data/poison.py, reference
+edge_case_examples/data_loader.py:283-420: southwest/ardis packs).
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.data.poison import (edge_case_test_shard, load_edge_case_pool,
+                                   poison_edge_case)
+
+
+def test_fallback_pool_shapes_and_determinism():
+    tr, te = load_edge_case_pool(None, "southwest", (32, 32, 3))
+    assert tr.shape[1:] == (32, 32, 3) and te.shape[1:] == (32, 32, 3)
+    tr2, _ = load_edge_case_pool(None, "southwest", (32, 32, 3))
+    np.testing.assert_array_equal(tr, tr2)          # seeded
+    # edge-case property: samples resemble each other (tight cluster)
+    assert np.std(tr.mean(axis=(1, 2, 3))) < 0.1
+
+
+def test_real_southwest_pickles(tmp_path):
+    rng = np.random.RandomState(0)
+    d = tmp_path / "southwest_cifar10"
+    os.makedirs(str(d))
+    imgs = rng.randint(0, 255, (20, 32, 32, 3), np.uint8)
+    for name, arr in (("southwest_images_new_train.pkl", imgs),
+                      ("southwest_images_new_test.pkl", imgs[:5])):
+        with open(str(d / name), "wb") as f:
+            pickle.dump(arr, f)
+    tr, te = load_edge_case_pool(str(tmp_path), "southwest")
+    assert tr.shape == (20, 32, 32, 3) and te.shape == (5, 32, 32, 3)
+    assert tr.max() <= 1.0                           # /255 applied
+
+
+class _DS:
+    """Stands in for the torch Dataset object inside the ardis packs."""
+
+    def __init__(self, data):
+        self.data = data
+
+
+def test_real_ardis_torch_pack(tmp_path):
+    torch = pytest.importorskip("torch")
+    d = tmp_path / "ARDIS"
+    os.makedirs(str(d))
+    rng = np.random.RandomState(0)
+    torch.save(_DS(torch.from_numpy(
+        rng.randint(0, 255, (12, 28, 28), np.uint8))),
+        str(d / "ardis_train_dataset.pt"))
+    torch.save(_DS(torch.from_numpy(
+        rng.randint(0, 255, (4, 28, 28), np.uint8))),
+        str(d / "ardis_test_dataset.pt"))
+    tr, te = load_edge_case_pool(str(tmp_path), "ardis")
+    assert tr.shape == (12, 28, 28, 1) and te.shape == (4, 28, 28, 1)
+
+
+def test_poison_edge_case_mixes_attacker_shards():
+    data = load_data("cifar10", client_num_in_total=4, batch_size=8,
+                     synthetic_scale=0.005, partition_method="homo")
+    pool, _ = load_edge_case_pool(None, "southwest", (32, 32, 3))
+    poisoned = poison_edge_case(data, attacker_ids=[1], target_label=9,
+                                pool=pool, poison_frac=0.5)
+    m = poisoned.client_shards["mask"]
+    y0, y1 = poisoned.client_shards["y"][0], poisoned.client_shards["y"][1]
+    # attacker 1: ~half its real samples are now the target label
+    n_real = int(m[1].sum())
+    n_target = int(((y1 == 9) * m[1]).sum())
+    assert n_target >= n_real // 2
+    # non-attacker untouched
+    np.testing.assert_array_equal(y0, data.client_shards["y"][0])
+    np.testing.assert_array_equal(poisoned.client_shards["x"][0],
+                                  data.client_shards["x"][0])
+    # the poisoned x's actually come from the pool (distribution shift)
+    changed = (poisoned.client_shards["x"][1] != data.client_shards["x"][1])
+    assert changed.any()
+
+
+def test_edge_case_test_shard_layout():
+    _, te = load_edge_case_pool(None, "southwest", (32, 32, 3), n_fallback=100)
+    shard = edge_case_test_shard(te, target_label=9, batch_size=16)
+    B = shard["x"].shape[0]
+    assert shard["x"].shape[1:] == (16, 32, 32, 3)
+    assert (shard["y"] == 9).all()
+    assert int(shard["mask"].sum()) == len(te)       # padding masked out
+    assert shard["mask"].shape == (B, 16)
+
+
+def test_edge_backdoor_succeeds_without_defense():
+    """An attacker training on relabeled edge-case images implants the
+    backdoor: the model labels the edge-case TEST pool as the target while
+    clean accuracy stays useful (the reference's attack-success metric,
+    SURVEY.md §3.5)."""
+    from fedml_tpu.algorithms import FedAvgEngine
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+    from fedml_tpu.utils.config import FedConfig
+
+    data = load_data("mnist", client_num_in_total=4, batch_size=10,
+                     synthetic_scale=0.01)
+    pool_tr, pool_te = load_edge_case_pool(None, "southwest", (784,),
+                                           n_fallback=256)
+    poisoned = poison_edge_case(data, attacker_ids=[0, 1], target_label=3,
+                                pool=pool_tr, poison_frac=0.6)
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=8, lr=0.1, frequency_of_the_test=100)
+    trainer = ClientTrainer(create_model("lr", 10), lr=0.1)
+    eng = FedAvgEngine(trainer, poisoned, cfg)
+    v = eng.run(rounds=8)
+
+    import jax
+    bd = jax.tree.map(np.asarray, edge_case_test_shard(pool_te, 3, 10))
+    sums = eng.eval_fn(v, bd)
+    success = float(sums["correct"]) / max(float(sums["count"]), 1.0)
+    clean_acc = eng.evaluate(v)["test_acc"]
+    assert success > 0.8, success        # backdoor implanted
+    assert clean_acc > 0.7, clean_acc    # main task still works

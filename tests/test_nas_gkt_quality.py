@@ -1,0 +1,123 @@
+"""Search/distillation QUALITY tests (VERDICT r1 next-round #9).
+
+FedNAS: the derived genotype must carry real signal — evaluated one-hot in
+the searched supernet (shared weights, the exact DARTS discretization
+argument), it beats the average random genotype.
+FedGKT: the client→server distillation pipeline must actually learn — the
+ensemble's accuracy climbs well above chance and improves over rounds.
+
+Both use tiny SEPARABLE tasks (class templates + noise) so learning is
+possible on the 1-core CPU test platform.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fednas import FedNASSearchEngine
+from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                      build_eval_shard)
+from fedml_tpu.models.darts import PRIMITIVES, derive_genotype
+from fedml_tpu.utils.config import FedConfig
+
+
+def separable_data(n_clients=2, bs=4, n_batches=4, hw=8, ch=3, classes=4,
+                   seed=0, noise=0.6):
+    rs = np.random.RandomState(seed)
+    n = n_clients * bs * n_batches
+    templates = rs.normal(0, 1, (classes, hw, hw, ch)).astype(np.float32)
+    y = rs.randint(0, classes, n).astype(np.int64)
+    x = (templates[y] + noise * rs.normal(0, 1, (n, hw, hw, ch))
+         ).astype(np.float32)
+    idx = {i: np.arange(i * bs * n_batches, (i + 1) * bs * n_batches)
+           for i in range(n_clients)}
+    n_te = 4 * bs
+    yt = rs.randint(0, classes, n_te).astype(np.int64)
+    xt = (templates[yt] + noise * rs.normal(0, 1, (n_te, hw, hw, ch))
+          ).astype(np.float32)
+    ev = build_eval_shard(xt, yt, bs)
+    return FederatedData(
+        train_data_num=n, test_data_num=n_te,
+        train_global=ev, test_global=ev,
+        client_shards=build_client_shards(x, y, idx, bs),
+        client_num_samples=np.full(n_clients, bs * n_batches, np.float32),
+        test_client_shards=None, class_num=classes, synthetic=True)
+
+
+def _edge_offset(node):
+    return sum(m + 2 for m in range(node))
+
+
+def _genotype_to_onehot_alphas(genotype, steps):
+    """One-hot supernet alphas for a discrete genotype: selected edges get
+    their op, every other edge gets 'none' (the DARTS discretization)."""
+    k = sum(i + 2 for i in range(steps))
+    none = PRIMITIVES.index("none")
+    out = {}
+    for key, gene in (("normal", genotype.normal),
+                      ("reduce", genotype.reduce)):
+        a = np.full((k, len(PRIMITIVES)), -10.0, np.float32)
+        a[:, none] = 10.0
+        for node in range(steps):
+            for op, j in gene[2 * node:2 * node + 2]:
+                e = _edge_offset(node) + j
+                a[e, :] = -10.0
+                a[e, PRIMITIVES.index(op)] = 10.0
+        out[key] = jnp.asarray(a)
+    return out
+
+
+def _random_genotype(rs, steps):
+    from fedml_tpu.models.darts import Genotype
+    ops = [p for p in PRIMITIVES if p != "none"]
+    def gene():
+        g = []
+        for node in range(steps):
+            for j in rs.choice(node + 2, 2, replace=False):
+                g.append((ops[rs.randint(len(ops))], int(j)))
+        return g
+    cc = list(range(2, steps + 2))
+    return Genotype(normal=gene(), normal_concat=cc,
+                    reduce=gene(), reduce_concat=cc)
+
+
+def test_derived_genotype_beats_random():
+    data = separable_data()
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=3, epochs=1, batch_size=4, lr=0.05,
+                    frequency_of_the_test=100)
+    eng = FedNASSearchEngine(data, cfg, C=4, layers=1, steps=2,
+                             multiplier=2, donate=False)
+    params, alphas = eng.run(rounds=3)
+    test_shard = jax.tree.map(jnp.asarray, data.test_global)
+
+    def acc_with(alpha_set):
+        return float(eng.eval_fn(params, alpha_set, test_shard)["acc"])
+
+    derived = derive_genotype(jax.tree.map(np.asarray, alphas), steps=2)
+    acc_d = acc_with(_genotype_to_onehot_alphas(derived, 2))
+    rs = np.random.RandomState(42)
+    rand_accs = [acc_with(_genotype_to_onehot_alphas(
+        _random_genotype(rs, 2), 2)) for _ in range(5)]
+    # shared supernet weights make this the exact DARTS discretization
+    # comparison: the argmax genotype must not lose to the random mean
+    assert acc_d >= np.mean(rand_accs) - 1e-9, (acc_d, rand_accs)
+
+
+def test_gkt_distillation_learns():
+    from fedml_tpu.algorithms.fedgkt import FedGKTEngine
+    from fedml_tpu.models.resnet_gkt import ResNetClientGKT, ResNetServerGKT
+
+    data = separable_data(n_clients=2, bs=4, n_batches=4, hw=16, classes=4,
+                          noise=0.4)
+    cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,
+                    comm_round=4, epochs=2, batch_size=4, lr=0.05,
+                    frequency_of_the_test=1)
+    eng = FedGKTEngine(ResNetClientGKT(num_classes=4, n_blocks=1),
+                       ResNetServerGKT(num_classes=4, n_per_stage=1),
+                       data, cfg)
+    eng.run(rounds=6)
+    accs = [m["test_acc"] for m in eng.metrics_history]
+    # chance = 0.25 on 4 classes; the ensemble must clearly beat chance and
+    # the distillation loop improve over its first round
+    assert accs[-1] > 0.4, accs
+    assert accs[-1] > accs[0], accs

@@ -1,0 +1,135 @@
+"""Isolate which program construct stalls the tunnel's remote compiler.
+
+Stages (all ResNet-18-GN, 128 clients, chunk 8, bf16):
+  1. plain   : chunk-scan round, no shard_map           (known-good F8)
+  2. smap    : same wrapped in shard_map over a 1-device mesh
+  3. gather  : smap + device-side take-gather of the stack by ids
+Each prints timing immediately (unbuffered)."""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel.mesh import make_mesh, pvary_tree
+
+N, BS, NB, CH = 128, 32, 13, 8
+
+
+def log(s):
+    print(s, flush=True)
+
+
+def data_stack(extra=4):
+    rs = np.random.RandomState(0)
+    n = N + extra
+    return {
+        "x": jnp.asarray(rs.rand(n, NB, BS, 32, 32, 3).astype(np.float32)),
+        "y": jnp.asarray(rs.randint(0, 10, (n, NB, BS)).astype(np.int32)),
+        "mask": jnp.ones((n, NB, BS), jnp.float32),
+    }
+
+
+def chunk_round_body(trainer, variables, cohort, weights, rngs, axes=None):
+    n_chunks = N // CH
+    resh = lambda a: a.reshape((n_chunks, CH) + a.shape[1:])
+    if axes:
+        variables = pvary_tree(variables, axes)
+
+    def one(shard, crng):
+        v, loss, _ = trainer.local_train(variables, shard, crng, 1)
+        return v, loss
+
+    def body(carry, xs):
+        num, den = carry
+        cs, cw, cr = xs
+        vs, _ = jax.vmap(one)(cs, cr)
+        num = jax.tree.map(
+            lambda acc, v: acc + jnp.einsum("k,k...->...", cw,
+                                            v.astype(jnp.float32)), num, vs)
+        return (num, den + jnp.sum(cw)), None
+
+    zeros = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), variables)
+    zf = jnp.float32(0)
+    if axes:
+        zeros, zf = pvary_tree(zeros, axes), pvary_tree(zf, axes)
+    (num, den), _ = jax.lax.scan(
+        body, (zeros, zf),
+        (jax.tree.map(resh, cohort), resh(weights), resh(rngs)))
+    if axes:
+        num = jax.lax.psum(num, axes)
+        den = jax.lax.psum(den, axes)
+    return jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype), num,
+                        variables)
+
+
+def run(stage):
+    trainer = ClientTrainer(create_model("resnet18_gn", output_dim=10),
+                            lr=0.1, train_dtype=jnp.bfloat16)
+    stack = data_stack()
+    weights = jnp.full((N,), 390.0, jnp.float32)
+    variables = trainer.init(jax.random.PRNGKey(0), stack["x"][0, 0, :1])
+    rngs = jax.random.split(jax.random.PRNGKey(1), N)
+    cohort = jax.tree.map(lambda a: a[:N], stack)
+    mesh = make_mesh()
+    axes = mesh.axis_names
+    csh = P(axes)
+
+    if stage == "plain":
+        fn = jax.jit(lambda v, c, w, r: chunk_round_body(trainer, v, c, w, r))
+        args = (variables, cohort, weights, rngs)
+    elif stage == "smap":
+        def outer(v, c, w, r):
+            return jax.shard_map(
+                lambda vv, cc, ww, rr: chunk_round_body(
+                    trainer, vv, cc, ww, rr, axes),
+                mesh=mesh, in_specs=(P(), csh, csh, csh), out_specs=P())(
+                    v, c, w, r)
+        fn = jax.jit(outer)
+        args = (variables, cohort, weights, rngs)
+    elif stage == "gather":
+        ids = jnp.arange(N, dtype=jnp.int32)
+
+        def outer(v, stk, w, i, r):
+            coh = {k: jax.lax.with_sharding_constraint(
+                jnp.take(a, i, axis=0), NamedSharding(mesh, csh))
+                for k, a in stk.items()}
+            ww = jnp.take(w, i)
+            return jax.shard_map(
+                lambda vv, cc, www, rr: chunk_round_body(
+                    trainer, vv, cc, www, rr, axes),
+                mesh=mesh, in_specs=(P(), csh, csh, csh), out_specs=P())(
+                    v, coh, ww, r)
+        fn = jax.jit(outer)
+        wfull = jnp.full((N + 4,), 390.0, jnp.float32)
+        args = (variables, stack, wfull, ids, rngs)
+    else:
+        raise SystemExit(f"unknown stage {stage}")
+
+    t0 = time.time()
+    log(f"[{stage}] lowering...")
+    lowered = fn.lower(*args)
+    log(f"[{stage}] lowered in {time.time()-t0:.1f}s; compiling...")
+    t0 = time.time()
+    compiled = lowered.compile()
+    log(f"[{stage}] compiled in {time.time()-t0:.1f}s; running...")
+    t0 = time.time()
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    log(f"[{stage}] first run {time.time()-t0:.1f}s")
+    t0 = time.time()
+    for _ in range(3):
+        out = compiled(*args)
+    jax.block_until_ready(out)
+    log(f"[{stage}] steady {(time.time()-t0)/3:.2f}s/round")
+
+
+if __name__ == "__main__":
+    for stage in (sys.argv[1:] or ["plain", "smap", "gather"]):
+        run(stage)

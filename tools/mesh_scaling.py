@@ -1,0 +1,98 @@
+"""Mesh-sharding overhead / scaling proxy on the virtual CPU mesh.
+
+Real multi-chip hardware is not reachable from this image (one tunneled
+v5e chip; ICI scaling can only be validated structurally).  Two proxies:
+
+1. OVERHEAD (fixed total cohort, 1/2/4/8 shards): the host has ONE core, so
+   ideal behavior is FLAT time — any growth is sharding overhead (psum
+   lowering, cross-shard gather, program partitioning).
+2. WEAK (per-shard cohort fixed, shards grow): on a 1-core host the ideal
+   is LINEAR time growth; the interesting output is the deviation factor
+   (overhead of the n-shard program beyond n x the 1-shard work).
+
+Writes SCALING.md at the repo root.
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python tools/mesh_scaling.py
+"""
+from __future__ import annotations
+
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel import MeshFedAvgEngine
+from fedml_tpu.parallel.mesh import make_mesh
+from fedml_tpu.utils.config import FedConfig
+
+
+def time_round(n_shards: int, n_clients: int, iters: int = 5) -> float:
+    cfg = FedConfig(model="lr", dataset="mnist",
+                    client_num_in_total=n_clients,
+                    client_num_per_round=n_clients, epochs=1, batch_size=8,
+                    lr=0.1, frequency_of_the_test=10_000)
+    data = load_data("mnist", client_num_in_total=n_clients, batch_size=8,
+                     synthetic_scale=0.01, seed=0)
+    trainer = ClientTrainer(create_model("lr", output_dim=10), lr=0.1)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(n_shards),
+                           donate=False)
+    v = eng.init_variables()
+    v = eng._prepare_variables(v)
+    s = eng.server_init(v)
+    args = eng._round_args(0)
+    rng = jax.random.PRNGKey(0)
+    out = eng.round_fn(v, s, *args, rng)          # compile + warm
+    jax.block_until_ready(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = eng.round_fn(v, s, *args, rng)
+    jax.block_until_ready(out[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    lines = ["# Mesh scaling (8 virtual CPU devices, ONE physical core)",
+             "",
+             "Structural proxy for ICI scaling — see tools/mesh_scaling.py "
+             "header for what flat/linear mean here.", ""]
+
+    lines += ["## Sharding overhead — fixed total cohort (16 clients)", "",
+              "| shards | s/round | vs 1 shard |", "|---|---|---|"]
+    base = None
+    for n in (1, 2, 4, 8):
+        dt = time_round(n, 16)
+        base = base or dt
+        lines.append(f"| {n} | {dt:.3f} | {dt / base:.2f}x |")
+        print(lines[-1], flush=True)
+
+    lines += ["", "## Weak scaling — 4 clients per shard", "",
+              "| shards | clients | s/round | time vs ideal-linear |",
+              "|---|---|---|---|"]
+    base = None
+    for n in (1, 2, 4, 8):
+        dt = time_round(n, 4 * n)
+        base = base or dt
+        lines.append(f"| {n} | {4 * n} | {dt:.3f} | "
+                     f"{dt / (base * n):.2f}x |")
+        print(lines[-1], flush=True)
+
+    with open(os.path.join(os.path.dirname(__file__), "..", "SCALING.md"),
+              "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("wrote SCALING.md", flush=True)
+
+
+if __name__ == "__main__":
+    main()
