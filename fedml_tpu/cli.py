@@ -278,6 +278,10 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
     data = _load(cfg)
     trainer = _trainer(cfg, data)
     size = args.world_size
+    if args.deploy == "client" and not 1 <= args.rank < size:
+        raise SystemExit(
+            f"--deploy client needs --rank in [1, {size - 1}] "
+            f"(rank 0 is the server); got {args.rank}")
     ip_config = {r: "127.0.0.1" for r in range(size)}
     kw = dict(ip_config=ip_config, base_port=args.base_port)
 

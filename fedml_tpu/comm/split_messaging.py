@@ -278,13 +278,19 @@ class SplitNNServerManager(ServerManager):
             reply.add_params(SplitNNMessage.MSG_ARG_KEY_GRADS,
                              np.asarray(ga))
             self.send_message(reply)
+            # a train batch reordered past a VALIDATION_MODE reset must not
+            # pollute the validation accumulators
+            if self.phase == "train":
+                self.correct += float(correct)
+                self.total += float(count)
+                self.step += 1
         else:
             loss, correct, count = self.compute.eval_step(
                 self.params, acts, y, mask)
             self.val_loss_sum += float(loss)
-        self.correct += float(correct)
-        self.total += float(count)
-        self.step += 1
+            self.correct += float(correct)
+            self.total += float(count)
+            self.step += 1
 
     def handle_validation_mode(self, _msg: Message):
         self.phase = "validation"

@@ -187,12 +187,19 @@ class ClientTrainer:
         (loss, new_rest), grads = jax.value_and_grad(self._loss, has_aux=True)(
             params, rest, batch, step_rng, global_params)
         updates, opt_state = self.tx.update(grads, state.opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        # empty-batch guard — see core/pytree.py:tree_select
+        # empty-batch guard: for params, scaling the UPDATES by the has-data
+        # flag is exactly equivalent to a post-hoc select (additive updates;
+        # u*0 leaves params bitwise unchanged) but fuses into apply_updates
+        # instead of costing an extra full-tree pass per step.  Stats
+        # collections and optimizer state are not additive, so they keep the
+        # select (core/pytree.py:tree_select).
         has_data = jnp.sum(batch["mask"]) > 0
+        g = has_data.astype(jnp.float32)
+        new_params = optax.apply_updates(
+            params, jax.tree.map(lambda u: u * g.astype(u.dtype), updates))
         keep = functools.partial(tree_select, has_data)
         return TrainState(
-            variables={"params": keep(new_params, params), **keep(new_rest, rest)},
+            variables={"params": new_params, **keep(new_rest, rest)},
             opt_state=keep(opt_state, state.opt_state),
             rng=rng), jnp.where(has_data, loss, 0.0)
 

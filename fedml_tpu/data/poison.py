@@ -89,6 +89,7 @@ def load_edge_case_pool(data_dir: Optional[str], poison_type: str,
         raise ValueError(f"unknown edge-case poison {poison_type!r}")
     try:
         if poison_type == "southwest":
+            from fedml_tpu.data.loaders import CIFAR10_MEAN, CIFAR10_STD
             base = os.path.join(data_dir or "", "southwest_cifar10")
             with open(os.path.join(base, "southwest_images_new_train.pkl"),
                       "rb") as f:
@@ -96,8 +97,14 @@ def load_edge_case_pool(data_dir: Optional[str], poison_type: str,
             with open(os.path.join(base, "southwest_images_new_test.pkl"),
                       "rb") as f:
                 x_te = pickle.load(f)
-            x_tr = np.asarray(x_tr, np.float32) / 255.0
-            x_te = np.asarray(x_te, np.float32) / 255.0
+            # same normalize transform the task data gets (reference applies
+            # transform_train to the southwest pack, data_loader.py:330+) —
+            # an un-normalized pool would make the backdoor a trivial
+            # pixel-scale artifact
+            mean = np.asarray(CIFAR10_MEAN, np.float32)
+            std = np.asarray(CIFAR10_STD, np.float32)
+            x_tr = (np.asarray(x_tr, np.float32) / 255.0 - mean) / std
+            x_te = (np.asarray(x_te, np.float32) / 255.0 - mean) / std
         else:
             import torch
             base = os.path.join(data_dir or "", "ARDIS")
@@ -107,8 +114,9 @@ def load_edge_case_pool(data_dir: Optional[str], poison_type: str,
                             weights_only=False)
             te = torch.load(os.path.join(base, "ardis_test_dataset.pt"),
                             weights_only=False)
-            x_tr = np.asarray(tr.data, np.float32) / 255.0
-            x_te = np.asarray(te.data, np.float32) / 255.0
+            # EMNIST normalization, as the reference's transform applies
+            x_tr = (np.asarray(tr.data, np.float32) / 255.0 - 0.1307) / 0.3081
+            x_te = (np.asarray(te.data, np.float32) / 255.0 - 0.1307) / 0.3081
             if x_tr.ndim == 3:
                 x_tr, x_te = x_tr[..., None], x_te[..., None]
         return x_tr, x_te

@@ -32,7 +32,9 @@ def test_real_southwest_pickles(tmp_path):
             pickle.dump(arr, f)
     tr, te = load_edge_case_pool(str(tmp_path), "southwest")
     assert tr.shape == (20, 32, 32, 3) and te.shape == (5, 32, 32, 3)
-    assert tr.max() <= 1.0                           # /255 applied
+    # /255 then CIFAR mean/std normalize (same transform as the task data)
+    assert tr.max() < 3.0 and tr.min() > -3.0
+    assert abs(float(tr.mean())) < 1.0
 
 
 class _DS:

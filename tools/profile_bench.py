@@ -191,6 +191,59 @@ def exp_H32():
           f"{_chunked_round(32, jnp.bfloat16):.3f}s/round", flush=True)
 
 
+def _bf16_master_round(chunk):
+    """chunked(chunk) with the MASTER weights in bf16 for the local loop:
+    the per-step f32->bf16 cast becomes a no-op and grads/updates run
+    bf16 end-to-end (aggregation still f32 via the einsum cast)."""
+    model = create_model("resnet18_gn", output_dim=10)
+    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    shard = client_batches(rs)
+    weights = jnp.full((N_CLIENTS,), float(SPC), jnp.float32)
+    variables = trainer.init(jax.random.PRNGKey(0), shard["x"][0, 0, :1])
+    variables = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, variables)
+    rngs = jax.random.split(jax.random.PRNGKey(1), N_CLIENTS)
+    n_chunks = N_CLIENTS // chunk
+
+    def round_fn(variables, shard, weights, rngs):
+        sh = jax.tree.map(
+            lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]), shard)
+        w = weights.reshape(n_chunks, chunk)
+        r = rngs.reshape(n_chunks, chunk, -1)
+
+        def one(v, s, cr):
+            nv, loss, _ = trainer.local_train(v, s, cr, 1)
+            return nv, loss
+
+        def chunk_body(carry, xs):
+            num, den, lsum = carry
+            cs, cw, cr = xs
+            vs, losses = jax.vmap(one, in_axes=(None, 0, 0))(variables, cs, cr)
+            num = jax.tree.map(
+                lambda acc, v: acc + jnp.einsum(
+                    "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
+            return (num, den + jnp.sum(cw),
+                    lsum + jnp.sum(losses * cw)), None
+
+        zeros = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                             variables)
+        (num, den, lsum), _ = jax.lax.scan(
+            chunk_body, (zeros, jnp.float32(0), jnp.float32(0)), (sh, w, r))
+        avg = jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype),
+                           num, variables)
+        return avg, lsum / den
+
+    fn = jax.jit(round_fn)
+    return timeit(lambda: fn(variables, shard, weights, rngs)[1])
+
+
+def exp_L8():
+    print(f"L8 chunked(8,bf16 masters): "
+          f"{_bf16_master_round(8):.3f}s/round", flush=True)
+
+
 if __name__ == "__main__":
     which = sys.argv[1:] or ["A", "B", "F16"]
     for name in which:
