@@ -74,6 +74,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("GRPC", "TCP", "NATIVE_TCP"))
     p.add_argument("--base_port", type=int, default=52000)
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
+    p.add_argument("--streaming", action="store_true",
+                   help="host-resident client stack; upload only each "
+                        "round's sampled cohort (cross-device scale)")
+    p.add_argument("--cohort_chunk", type=int, default=None,
+                   help="max client model replicas live per shard "
+                        "(default 8; tools/profile_bench.py)")
     p.add_argument("--mesh", action="store_true",
                    help="shard the cohort over all visible devices")
     p.add_argument("--multihost", action="store_true",
@@ -145,6 +151,9 @@ def build_engine(args, cfg: FedConfig, data):
     """Algorithm dispatch (the reference's fed_launch algorithm select)."""
     algo = args.algorithm
     mesh = None
+    if (args.streaming or args.cohort_chunk) and not args.mesh:
+        raise SystemExit("--streaming/--cohort_chunk require --mesh (they "
+                         "configure the mesh engine's cohort path)")
     if args.mesh:
         from fedml_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
@@ -176,7 +185,8 @@ def build_engine(args, cfg: FedConfig, data):
             cls = {"fedavg": MeshFedAvgEngine, "fedopt": MeshFedOptEngine,
                    "fedprox": MeshFedProxEngine,
                    "fedavg_robust": MeshRobustEngine}[algo]
-            return cls(trainer, data, cfg, mesh=mesh)
+            return cls(trainer, data, cfg, mesh=mesh,
+                       streaming=args.streaming, chunk=args.cohort_chunk)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
             return CentralizedTrainer(trainer, data, cfg)

@@ -60,13 +60,32 @@ SPECS = {
 _TABULAR_DIMS = {"susy": 18, "room_occupancy": 5}
 
 
-def _partition(labels, n_clients, method, alpha, seed):
+def _partition(labels, n_clients, method, alpha, seed, data_dir=""):
     if method == "homo":
         return partition_homo(len(labels), n_clients, seed)
     if method == "hetero":
         return partition_dirichlet(labels, n_clients, alpha, seed=seed)
     if method == "power_law":
         return partition_power_law(labels, n_clients, seed)
+    if method == "hetero-fix":
+        # precomputed map (reference cifar10/data_loader.py:150-156);
+        # falls back to hetero when the txt is absent
+        try:
+            m = readers.read_net_dataidx_map(
+                os.path.join(data_dir or "", "net_dataidx_map.txt"))
+        except FileNotFoundError:
+            import logging
+            logging.getLogger(__name__).warning(
+                "hetero-fix requested but %s/net_dataidx_map.txt is absent; "
+                "falling back to a Dirichlet(alpha=%s) partition — this is "
+                "NOT the precomputed reference split", data_dir, alpha)
+            return partition_dirichlet(labels, n_clients, alpha, seed=seed)
+        if sorted(m) != list(range(n_clients)):
+            raise ValueError(
+                f"net_dataidx_map.txt holds clients {sorted(m)[:5]}..."
+                f"(n={len(m)}), but client_num_in_total={n_clients}; the "
+                "sampler would train the wrong cohort")
+        return m
     raise ValueError(f"unknown partition {method!r}")
 
 
@@ -334,19 +353,25 @@ def load_data(dataset: str,
                 n, (32, 32), 3, n_classes, seed=seed)
             n_te = n // 5
             x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
-        idx_map = _partition(y_tr, C, partition_method, partition_alpha, seed)
+        idx_map = _partition(y_tr, C, partition_method, partition_alpha,
+                             seed, data_dir)
         return _make(x_tr, y_tr, xt, yt, idx_map, bs, n_classes,
                      max_batches_per_client, None, seed, synthetic=synth)
 
     if dataset == "imagenet":
         # reference ImageNet/data_loader.py:1-300 (per-client index maps over
-        # ILSVRC2012).  Synthetic stand-in uses 64×64 (memory-sane shape
-        # proxy; the loader path and partition semantics are identical).
+        # ILSVRC2012; hdf5 pack variant datasets_hdf5.py:13-40).  Synthetic
+        # stand-in uses 64×64 (memory-sane shape proxy; the loader path and
+        # partition semantics are identical).
         try:
-            x_tr, y_tr, xt, yt = readers.read_image_folder(data_dir)
+            h5p = os.path.join(data_dir or "", "imagenet.hdf5")
+            if os.path.isfile(h5p):
+                x_tr, y_tr, xt, yt = readers.read_imagenet_h5(h5p)
+            else:
+                x_tr, y_tr, xt, yt = readers.read_image_folder(data_dir)
             synth = False
             idx_map = _partition(y_tr, C, partition_method, partition_alpha,
-                                 seed)
+                                 seed, data_dir)
         except FileNotFoundError:
             synth = True
             n = sc(4000)

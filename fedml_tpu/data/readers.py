@@ -154,6 +154,69 @@ def read_landmarks_csv(data_dir: str, split_csv: str, image_dir: str = "images",
             {k: np.asarray(v) for k, v in idx_map.items()})
 
 
+def read_net_dataidx_map(path: str) -> dict[int, "np.ndarray"]:
+    """Precomputed non-IID partition map ('hetero-fix'), reference
+    cifar10/data_loader.py:32-43: a pretty-printed python-dict txt of
+    {client: [idx, ...]}."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    out, key = {}, None
+    with open(path) as f:
+        for line in f:
+            if not line.strip() or line[0] in "{}]":
+                continue
+            head = line.split(":")
+            if head[-1].strip() == "[":
+                key = int(head[0])
+                out[key] = []
+            else:
+                out[key].extend(int(t.strip().rstrip("]"))
+                                for t in line.split(",") if t.strip("] \n"))
+    return {k: np.asarray(v, np.int64) for k, v in out.items()}
+
+
+def read_data_distribution(path: str) -> dict[int, dict[int, int]]:
+    """Companion per-client class-count file (cifar10/data_loader.py:15-29)."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    out, key = {}, None
+    with open(path) as f:
+        for line in f:
+            if not line.strip() or line[0] in "{}":
+                continue
+            head, tail = line.split(":", 1)
+            if tail.strip() == "{":
+                key = int(head)
+                out[key] = {}
+            else:
+                out[key][int(head)] = int(tail.strip().rstrip(","))
+    return out
+
+
+def read_imagenet_h5(path: str):
+    """ImageNet hdf5 pack (reference ImageNet/datasets_hdf5.py:13-40):
+    datasets train_img/train_labels/val_img/val_labels.  Returns
+    (x_tr, y_tr, x_te, y_te) NHWC float32 in [0,1]."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    import h5py
+
+    def _img(ds):
+        # decide /255 from the STORED dtype (O(1)) and scale during the
+        # float32 conversion — not a full-array max() after a 4x f32 blow-up
+        arr = np.asarray(ds)
+        if np.issubdtype(arr.dtype, np.integer):
+            return arr.astype(np.float32) / 255.0
+        return arr.astype(np.float32)
+
+    with h5py.File(path, "r") as f:
+        x_tr = _img(f["train_img"])
+        y_tr = np.asarray(f["train_labels"], np.int64)
+        x_te = _img(f["val_img"])
+        y_te = np.asarray(f["val_labels"], np.int64)
+    return x_tr, y_tr, x_te, y_te
+
+
 def read_csv_tabular(path: str, label_col: int, feature_cols=None,
                      skip_header: bool = True, max_rows: Optional[int] = None):
     """Plain-CSV tabular reader (UCI SUSY / Room-Occupancy / lending-club,

@@ -87,6 +87,13 @@ def test_cli_algorithm_table_is_exhaustive():
     assert sorted(_ALGO_FLAGS) == sorted(ALGORITHMS)
 
 
+def test_cli_streaming_mesh(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--mesh", "--streaming",
+                "--cohort_chunk", "2")
+    assert s
+
+
 def test_cli_augment_flag(tmp_path):
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "cifar10",
                 "--model", "cnn", "--augment")

@@ -272,6 +272,79 @@ def test_landmarks_csv_loader(tmp_path):
     assert len(idx_map) == 2 and len(idx_map[0]) == 2     # userA has 2
 
 
+def test_net_dataidx_map_and_distribution(tmp_path):
+    # the reference's pretty-printed python-dict txt formats
+    with open(str(tmp_path / "net_dataidx_map.txt"), "w") as f:
+        f.write("{\n0: [\n1, 2, 3]\n1: [\n4, 5]\n}\n")
+    m = readers.read_net_dataidx_map(str(tmp_path / "net_dataidx_map.txt"))
+    assert m[0].tolist() == [1, 2, 3] and m[1].tolist() == [4, 5]
+    with open(str(tmp_path / "distribution.txt"), "w") as f:
+        f.write("{\n0: {\n1: 10,\n2: 20\n}\n1: {\n0: 5\n}\n}\n")
+    d = readers.read_data_distribution(str(tmp_path / "distribution.txt"))
+    assert d == {0: {1: 10, 2: 20}, 1: {0: 5}}
+
+
+def test_hetero_fix_partition_via_loader(tmp_path):
+    import pickle as pkl
+    rng = np.random.RandomState(0)
+    d = tmp_path / "cifar-10-batches-py"
+    os.makedirs(str(d))
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        blob = {b"data": rng.randint(0, 255, (10, 3072), np.uint8),
+                b"labels": rng.randint(0, 10, 10).tolist()}
+        with open(str(d / name), "wb") as f:
+            pkl.dump(blob, f)
+    with open(str(tmp_path / "net_dataidx_map.txt"), "w") as f:
+        f.write("{\n0: [\n" + ", ".join(map(str, range(30))) + "]\n"
+                "1: [\n" + ", ".join(map(str, range(30, 50))) + "]\n}\n")
+    data = load_data("cifar10", data_dir=str(tmp_path),
+                     client_num_in_total=2, batch_size=10,
+                     partition_method="hetero-fix")
+    assert not data.synthetic
+    assert data.client_num_samples.tolist() == [30.0, 20.0]
+
+
+def test_imagenet_h5_loader(tmp_path):
+    import h5py
+    rng = np.random.RandomState(0)
+    with h5py.File(str(tmp_path / "imagenet.hdf5"), "w") as f:
+        f.create_dataset("train_img",
+                         data=rng.randint(0, 255, (12, 16, 16, 3), np.uint8))
+        f.create_dataset("train_labels", data=rng.randint(0, 5, 12))
+        f.create_dataset("val_img",
+                         data=rng.randint(0, 255, (4, 16, 16, 3), np.uint8))
+        f.create_dataset("val_labels", data=rng.randint(0, 5, 4))
+    data = load_data("imagenet", data_dir=str(tmp_path),
+                     client_num_in_total=2, batch_size=4,
+                     partition_method="homo")
+    assert not data.synthetic
+    assert data.train_data_num == 12
+    assert data.client_shards["x"].shape[-3:] == (16, 16, 3)
+    assert float(data.client_shards["x"].max()) <= 1.0
+
+
+def test_mobile_device_split(tmp_path):
+    from fedml_tpu.data.mobile import split_mobile_devices
+    rng = np.random.RandomState(0)
+    ud = {f"u{i:03d}": {"x": rng.rand(3, 784).tolist(),
+                        "y": rng.randint(0, 10, 3).tolist()}
+          for i in range(6)}
+    _write_leaf(str(tmp_path / "train"), ud)
+    _write_leaf(str(tmp_path / "test"), ud)
+    out = split_mobile_devices(str(tmp_path), str(tmp_path / "mobile"),
+                               client_num_per_round=2, comm_round=3)
+    assert len(out) == 2
+    blob = json.load(open(os.path.join(out[0], "train", "train.json")))
+    assert set(blob) == {"users", "num_samples", "user_data"}
+    assert blob["num_samples"] == [3] * len(blob["users"])
+    # the device's users are exactly the deterministic sampler's picks
+    from fedml_tpu.core.sampling import ClientSampler
+    s = ClientSampler(6, 2)
+    expect = sorted({int(np.asarray(s.sample(r))[0]) for r in range(3)})
+    users_sorted = sorted(blob["users"])
+    assert users_sorted == [f"u{i:03d}" for i in expect]
+
+
 def test_tabular_csv_loader(tmp_path):
     rng = np.random.RandomState(0)
     # SUSY layout: label first, 18 features, no header
