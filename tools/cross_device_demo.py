@@ -1,0 +1,66 @@
+"""Cross-device scale demo: femnist-shaped 3,400-client federation with the
+STREAMING cohort path — the full client stack lives in host RAM; each round
+uploads only the sampled cohort (10 clients), so device HBM holds one
+cohort + one model regardless of client_num_in_total.
+
+Reference scale: benchmark/README.md:54-57 (femnist 3,400 clients,
+stackoverflow 342,477).  Round-1 VERDICT #7/next-round #5: the resident
+engine uploaded the whole stack (impossible at this scale); this
+demonstrates the fix.  Runs on CPU (default) or the real chip
+(PLATFORM=tpu env).
+
+Usage: python tools/cross_device_demo.py [n_clients] [rounds]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+if os.environ.get("PLATFORM", "cpu") != "tpu":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+if os.environ.get("PLATFORM", "cpu") != "tpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel import MeshFedAvgEngine
+from fedml_tpu.parallel.mesh import make_mesh
+from fedml_tpu.utils.config import FedConfig
+
+
+def main(n_clients: int = 3400, rounds: int = 5) -> None:
+    t0 = time.time()
+    data = load_data("femnist", client_num_in_total=n_clients, batch_size=20,
+                     synthetic_scale=float(n_clients * 20) / 80_000, seed=0)
+    host_mb = sum(np.asarray(v).nbytes
+                  for v in data.client_shards.values()) / 1e6
+    print(f"host stack: {n_clients} clients, {host_mb:.0f} MB "
+          f"(built in {time.time()-t0:.0f}s)", flush=True)
+
+    cfg = FedConfig(model="cnn", dataset="femnist",
+                    client_num_in_total=n_clients, client_num_per_round=10,
+                    comm_round=rounds, epochs=1, batch_size=20, lr=0.05,
+                    frequency_of_the_test=max(rounds - 1, 1))
+    trainer = ClientTrainer(create_model("cnn", output_dim=62), lr=cfg.lr)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(1),
+                           streaming=True)
+    v = eng.run(rounds=rounds)
+    assert eng._stack is None, "streaming engine must never build the " \
+                               "device-resident stack"
+    per_round = [m.get("round_time") for m in eng.metrics_history]
+    print(f"ran {rounds} rounds over {n_clients} clients "
+          f"(last round_time {per_round[-1]:.2f}s); device never held "
+          f"more than the 10-client cohort", flush=True)
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 3400
+    r = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    main(n, r)
