@@ -100,11 +100,14 @@ def test_cli_augment_flag(tmp_path):
     assert s
 
 
-def test_two_process_deployment(tmp_path):
-    """A REAL server+client process pair over TCP localhost (the
+@pytest.mark.parametrize("backend,port", [("TCP", 57500), ("GRPC", 57600)])
+def test_two_process_deployment(tmp_path, backend, port):
+    """A REAL server+client process pair over localhost sockets (the
     reference's run_fedavg_grpc.sh deployment; VERDICT r1 weak #5)."""
     import subprocess
     import sys
+    if backend == "GRPC":
+        pytest.importorskip("grpc")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
     common = [sys.executable, "-m", "fedml_tpu.cli",
@@ -112,7 +115,7 @@ def test_two_process_deployment(tmp_path):
               "--synthetic_scale", "0.002", "--client_num_in_total", "2",
               "--client_num_per_round", "2", "--comm_round", "2",
               "--batch_size", "4", "--world_size", "3",
-              "--comm_backend", "TCP", "--base_port", "57500",
+              "--comm_backend", backend, "--base_port", str(port),
               "--run_dir", str(tmp_path)]
     server = subprocess.Popen(common + ["--deploy", "server", "--rank", "0",
                                         "--run_name", "srv"], env=env,

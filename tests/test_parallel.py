@@ -148,6 +148,25 @@ def test_streaming_matches_resident():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_streaming_matches_resident_fedopt():
+    """The shared _train_and_update tail must apply subclass server_update
+    overrides identically on both cohort paths (FedOpt's optimizer state
+    persists across rounds)."""
+    cfg = _mnist_like_cfg(server_optimizer="adam", server_lr=0.05,
+                          comm_round=3)
+    trainer, data = _setup(cfg)
+    res = MeshFedOptEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False)
+    v0 = res.init_variables()
+    v_res = res.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    stream = MeshFedOptEngine(trainer, data, cfg, mesh=make_mesh(8),
+                              donate=False, streaming=True)
+    v_str = stream.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for a, b in zip(jax.tree.leaves(v_res), jax.tree.leaves(v_str)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_chunk_size_invariance():
     """The chunked cohort scan (perf: bounds live model replicas) must not
     change results vs one full-width chunk."""
