@@ -100,12 +100,18 @@ def main() -> None:
             variables, server_state, i, rng)
     force_completion(variables, m)
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_ROUNDS):
-        variables, server_state, rng, m = one_round(
-            variables, server_state, WARMUP_ROUNDS + i, rng)
-    last_loss = force_completion(variables, m)
-    dt = time.perf_counter() - t0
+    import contextlib
+    import os
+    from fedml_tpu.utils.profiling import trace
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    trace_cm = trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    with trace_cm:
+        t0 = time.perf_counter()
+        for i in range(TIMED_ROUNDS):
+            variables, server_state, rng, m = one_round(
+                variables, server_state, WARMUP_ROUNDS + i, rng)
+        last_loss = force_completion(variables, m)
+        dt = time.perf_counter() - t0
 
     rps = TIMED_ROUNDS / dt
     print(f"train_loss={last_loss:.4f} "

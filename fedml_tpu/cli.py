@@ -140,11 +140,15 @@ def _trainer(cfg: FedConfig, data):
         cut = 16 if cfg.dataset in ("cifar10", "cifar100", "cinic10",
                                     "fed_cifar100") else None
         aug = make_augment_fn(crop_padding=4, flip=True, cutout_length=cut)
+    # TFF metric convention: NWP/snippet accuracy ignores <pad> (= id 0 in
+    # both text.py vocab layouts)
+    ignore = 0 if cfg.dataset in ("fed_shakespeare",
+                                  "stackoverflow_nwp") else None
     return ClientTrainer(model, loss=loss, optimizer=cfg.client_optimizer,
                          lr=cfg.lr, momentum=cfg.momentum,
                          weight_decay=cfg.wd, prox_mu=cfg.prox_mu,
                          has_time_axis=has_time, train_dtype=dtype,
-                         augment=aug)
+                         augment=aug, eval_ignore_id=ignore)
 
 
 def build_engine(args, cfg: FedConfig, data):

@@ -105,6 +105,11 @@ class ClientTrainer:
       prox_mu: FedProx proximal coefficient; when > 0, local_train receives
         the round's global params and adds (mu/2)||w - w_global||^2.
       has_time_axis: labels have a trailing sequence axis (char/word LMs).
+      eval_ignore_id: label id excluded from EVAL metrics (the TFF
+        NWP/shakespeare convention: accuracy ignores <pad> positions,
+        google-research/federated stackoverflow_dataset; pad=0 in both
+        data/text.py vocab layouts).  Training loss is untouched — the
+        reference trains plain CE over all positions.
     """
 
     def __init__(self, model, loss: str = "ce", optimizer: str = "sgd",
@@ -112,7 +117,8 @@ class ClientTrainer:
                  weight_decay: float = 0.0, prox_mu: float = 0.0,
                  has_time_axis: bool = False,
                  train_dtype=jnp.float32,
-                 augment: Optional[Callable] = None):
+                 augment: Optional[Callable] = None,
+                 eval_ignore_id: Optional[int] = None):
         self.model = model
         self.loss_name = loss
         self.tx = make_optimizer(optimizer, lr, momentum, weight_decay)
@@ -122,6 +128,7 @@ class ClientTrainer:
         # training-time augmentation (rng, x) -> x, applied ONLY in the
         # train-step loss (data/augment.py); eval paths never see it
         self.augment = augment
+        self.eval_ignore_id = eval_ignore_id
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array, sample_input: jax.Array) -> Pytree:
@@ -237,6 +244,8 @@ class ClientTrainer:
         logits = self.model.apply({"params": params, **rest}, x, train=False)
         if self.has_time_axis and mask.ndim < y.ndim:
             mask = broadcast_mask(mask, y)
+        if self.eval_ignore_id is not None:
+            mask = mask * (y != self.eval_ignore_id).astype(mask.dtype)
         if self.loss_name == "ce":
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             loss_sum = jnp.sum(ce * mask)
