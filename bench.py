@@ -79,14 +79,15 @@ def main() -> None:
 
     variables = engine.init_variables()
     server_state = engine.server_init(variables)
-    stack, stack_w = engine._device_stack()
+    # full participation: the cohort IS the whole client stack — upload it
+    # once and drive the streaming round (no per-round device-side gather)
+    cohort, weights = engine.stream_cohort(0)
     rng = jax.random.PRNGKey(0)
 
-    def one_round(variables, server_state, round_idx, rng):
-        ids, wmask = engine.sample_padded(round_idx)
+    def one_round(variables, server_state, rng):
         rng, r = jax.random.split(rng)
-        variables, server_state, m = engine.round_fn(
-            variables, server_state, stack, stack_w, ids, wmask, r)
+        variables, server_state, m = engine.round_fn_streaming(
+            variables, server_state, cohort, weights, r)
         return variables, server_state, rng, m
 
     def force_completion(variables, m):
@@ -95,9 +96,9 @@ def main() -> None:
         jax.block_until_ready(variables)
         return float(m["train_loss"])
 
-    for i in range(WARMUP_ROUNDS):
+    for _ in range(WARMUP_ROUNDS):
         variables, server_state, rng, m = one_round(
-            variables, server_state, i, rng)
+            variables, server_state, rng)
     force_completion(variables, m)
 
     import contextlib
@@ -107,9 +108,9 @@ def main() -> None:
     trace_cm = trace(trace_dir) if trace_dir else contextlib.nullcontext()
     with trace_cm:
         t0 = time.perf_counter()
-        for i in range(TIMED_ROUNDS):
+        for _ in range(TIMED_ROUNDS):
             variables, server_state, rng, m = one_round(
-                variables, server_state, WARMUP_ROUNDS + i, rng)
+                variables, server_state, rng)
         last_loss = force_completion(variables, m)
         dt = time.perf_counter() - t0
 

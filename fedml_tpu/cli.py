@@ -289,6 +289,13 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
                                                  FedAvgClientManager,
                                                  FedAvgServerManager)
 
+    # rank-prefixed logs, one process per rank (reference
+    # main_fedavg.py:415-420 logger format parity)
+    for h in logging.getLogger().handlers:
+        h.setFormatter(logging.Formatter(
+            f"[rank {args.rank}] %(asctime)s %(name)s "
+            "%(levelname)s %(message)s"))
+
     data = _load(cfg)
     trainer = _trainer(cfg, data)
     size = args.world_size

@@ -159,3 +159,23 @@ class TestRobust:
         assert float(jnp.max(jnp.abs(med["w"]))) < 1.0
         tm = trimmed_mean(stacked, 1)
         assert float(jnp.max(jnp.abs(tm["w"]))) < 1.0
+
+
+def test_eval_ignore_id_masks_pad_positions():
+    """TFF convention: NWP eval accuracy ignores <pad> label positions
+    (ClientTrainer.eval_ignore_id; training loss is untouched)."""
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+
+    model = create_model("rnn", 90)
+    plain = ClientTrainer(model, has_time_axis=True)
+    ignoring = ClientTrainer(model, has_time_axis=True, eval_ignore_id=0)
+    x = jnp.ones((2, 8), jnp.int32)
+    y = jnp.concatenate([jnp.full((2, 4), 3, jnp.int64),
+                         jnp.zeros((2, 4), jnp.int64)], axis=1)  # half pad
+    batch = {"x": x, "y": y, "mask": jnp.ones((2,), jnp.float32)}
+    v = plain.init(jax.random.PRNGKey(0), x)
+    m_plain = plain.eval_step(v, batch)
+    m_ign = ignoring.eval_step(v, batch)
+    assert float(m_plain["count"]) == 16.0
+    assert float(m_ign["count"]) == 8.0          # pad positions excluded
