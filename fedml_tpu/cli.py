@@ -206,13 +206,18 @@ def build_engine(args, cfg: FedConfig, data):
         return TurboAggregateEngine(trainer, data, cfg)
 
     if algo == "hierarchical":
+        if args.streaming:
+            logging.getLogger(__name__).warning(
+                "--streaming has no hierarchical engine path; the client "
+                "stack stays device-resident")
         if mesh is not None:
             from fedml_tpu.parallel import MeshHierarchicalEngine
             from fedml_tpu.parallel.mesh import make_mesh_2d
             mesh2 = make_mesh_2d(args.group_num)
             return MeshHierarchicalEngine(
                 _trainer(cfg, data), data, cfg, mesh=mesh2,
-                group_comm_round=args.group_comm_round)
+                group_comm_round=args.group_comm_round,
+                chunk=args.cohort_chunk)
         from fedml_tpu.algorithms import HierarchicalFedAvgEngine
         return HierarchicalFedAvgEngine(
             _trainer(cfg, data), data, cfg, group_num=args.group_num,

@@ -39,6 +39,63 @@ log = logging.getLogger(__name__)
 Pytree = Any
 
 
+def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
+                           epochs, vary_axes, chunk_cap: int = 8,
+                           client_transform=None):
+    """Train a shard-local cohort as a lax.scan over chunks of at most
+    `chunk_cap` vmapped clients, accumulating Σ w·v / Σ w / Σ w·loss in the
+    carry — the HBM-bounded inner loop shared by the flat and hierarchical
+    mesh engines (measured on v5e: see MeshFedAvgEngine docstring).
+
+    `variables` must already carry the vma types of `vary_axes` (pvary'd by
+    the caller); the f32 accumulators are pvary'd here to match.  Returns
+    (num_tree_f32, den, loss_sum) — the caller applies its own psum tier(s).
+
+    A cohort whose size is not a chunk multiple is padded IN-PROGRAM with
+    zero-weight lanes (static shapes; the empty-batch guard makes them
+    numeric no-ops), so chunk stays at the cap instead of degenerating to
+    small divisors for awkward (e.g. prime) cohort sizes.
+    """
+    k_local = weights.shape[0]
+    chunk = min(chunk_cap, k_local)
+    pad = (-k_local) % chunk
+    if pad:
+        cohort = jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]), cohort)
+        weights = jnp.concatenate(
+            [weights, jnp.zeros((pad,), weights.dtype)])
+        rngs = jnp.concatenate([rngs, rngs[:pad]])   # masked lanes; any key
+    n_chunks = (k_local + pad) // chunk
+    resh = lambda a: a.reshape((n_chunks, chunk) + a.shape[1:])
+    global_params = variables["params"] if trainer.prox_mu > 0 else None
+
+    def one(shard, crng):
+        v, loss, _n = trainer.local_train(
+            variables, shard, crng, epochs, global_params=global_params)
+        return v, loss
+
+    def chunk_body(carry, xs):
+        num, den, lsum = carry
+        cs, cw, cr = xs
+        vs, losses = jax.vmap(one)(cs, cr)
+        if client_transform is not None:
+            vs = jax.vmap(client_transform,
+                          in_axes=(0, 0, None))(vs, cw, variables)
+        num = jax.tree.map(
+            lambda acc, v: acc + jnp.einsum(
+                "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
+        return (num, den + jnp.sum(cw), lsum + jnp.sum(losses * cw)), None
+
+    zeros = pvary_tree(jax.tree.map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), variables), vary_axes)
+    zf = pvary_tree(jnp.float32(0), vary_axes)
+    (num, den, lsum), _ = jax.lax.scan(
+        chunk_body, (zeros, zf, zf),
+        (jax.tree.map(resh, cohort), resh(weights), resh(rngs)))
+    return num, den, lsum
+
+
 class MeshFedAvgEngine(FedAvgEngine):
     """FedAvg with the cohort sharded over a `jax.sharding.Mesh`.
 
@@ -78,13 +135,6 @@ class MeshFedAvgEngine(FedAvgEngine):
         if streaming:
             self.round_fn = self.round_fn_streaming
 
-    def _chunk_for(self, per_shard: int) -> int:
-        """Largest divisor of per_shard not exceeding the configured cap."""
-        cap = self.chunk or 8
-        c = min(cap, per_shard)
-        while per_shard % c:
-            c -= 1
-        return c
 
     # -- hooks ---------------------------------------------------------------
     def client_transform(self, client_variables: Pytree, weight: jax.Array,
@@ -115,47 +165,17 @@ class MeshFedAvgEngine(FedAvgEngine):
 
     # -- the round program ----------------------------------------------------
     def _shard_body(self, variables, cohort, weights, client_rngs):
-        """Per-shard cohort training: lax.scan over chunks of `chunk`
-        vmapped clients, Σ w_i·v_i accumulated in the scan carry, then one
-        psum pair over the mesh — the whole FedAvg aggregation is two
-        collectives (SURVEY.md §5).  Chunking bounds live model replicas
-        (see class docstring for the measured v5e numbers)."""
+        """Per-shard cohort training (chunked_weighted_train) + one psum
+        pair over the mesh — the whole FedAvg aggregation is two
+        collectives (SURVEY.md §5)."""
         axes = self.mesh.axis_names
-        trainer, epochs = self.trainer, self.cfg.epochs
         # the global model arrives replicated; per-client training makes
         # it shard-varying, so cast up-front for the vma type system
         variables = pvary_tree(variables, axes)
-        global_params = (variables["params"]
-                         if trainer.prox_mu > 0 else None)
-        k_local = weights.shape[0]
-        chunk = self._chunk_for(k_local)
-        n_chunks = k_local // chunk
-        resh = lambda a: a.reshape((n_chunks, chunk) + a.shape[1:])
-
-        def one(shard, crng):
-            v, loss, _n = trainer.local_train(
-                variables, shard, crng, epochs, global_params=global_params)
-            return v, loss
-
-        def chunk_body(carry, xs):
-            num, den, lsum = carry
-            cs, cw, cr = xs
-            vs, losses = jax.vmap(one)(cs, cr)
-            vs = jax.vmap(self.client_transform,
-                          in_axes=(0, 0, None))(vs, cw, variables)
-            num = jax.tree.map(
-                lambda acc, v: acc + jnp.einsum(
-                    "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
-            return (num, den + jnp.sum(cw),
-                    lsum + jnp.sum(losses * cw)), None
-
-        # carry must be shard-varying like the accumulated values (vma typing)
-        zeros = pvary_tree(jax.tree.map(
-            lambda a: jnp.zeros(a.shape, jnp.float32), variables), axes)
-        zf = pvary_tree(jnp.float32(0), axes)
-        (num, den, lsum), _ = jax.lax.scan(
-            chunk_body, (zeros, zf, zf),
-            (jax.tree.map(resh, cohort), resh(weights), resh(client_rngs)))
+        num, den, lsum = chunked_weighted_train(
+            self.trainer, variables, cohort, weights, client_rngs,
+            self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk or 8,
+            client_transform=self.client_transform)
         num = jax.lax.psum(num, axes)
         den = jax.lax.psum(den, axes)
         avg = jax.tree.map(
@@ -203,11 +223,10 @@ class MeshFedAvgEngine(FedAvgEngine):
 
     def stream_cohort(self, round_idx: int):
         """Host-side cohort gather for the streaming path: sample, pad to a
-        mesh×chunk multiple, slice the HOST arrays, upload sharded."""
+        mesh multiple, slice the HOST arrays, upload sharded (chunk-multiple
+        padding happens inside chunked_weighted_train)."""
         ids = np.asarray(self.sampler.sample(round_idx))
-        mult = self.n_shards * self._chunk_for(
-            max(len(ids) // self.n_shards, 1))
-        pad = (-len(ids)) % max(mult, self.n_shards)
+        pad = (-len(ids)) % self.n_shards
         wmask = np.concatenate([np.ones(len(ids), np.float32),
                                 np.zeros(pad, np.float32)])
         ids = np.concatenate([ids, np.zeros(pad, ids.dtype)])

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.trainer import ClientTrainer
 from fedml_tpu.data.federated import FederatedData
+from fedml_tpu.parallel.engine import chunked_weighted_train
 from fedml_tpu.parallel.mesh import (CLIENT_AXIS, SILO_AXIS, make_mesh_2d,
                                      pvary_tree)
 from fedml_tpu.utils.config import FedConfig
@@ -50,7 +51,9 @@ class MeshHierarchicalEngine(FedAvgEngine):
     def __init__(self, trainer: ClientTrainer, data: FederatedData,
                  cfg: FedConfig, n_silos: int = 2,
                  group_comm_round: int = 1,
-                 mesh: Optional[Mesh] = None, donate: bool = True):
+                 mesh: Optional[Mesh] = None, donate: bool = True,
+                 chunk: Optional[int] = None):
+        self.chunk = chunk
         self.mesh = mesh if mesh is not None else make_mesh_2d(n_silos)
         self.n_silos = self.mesh.shape[SILO_AXIS]
         self.per_silo_shards = self.mesh.shape[CLIENT_AXIS]
@@ -133,23 +136,17 @@ class MeshHierarchicalEngine(FedAvgEngine):
                 crngs = jax.random.split(rng_g, idx.shape[0])
                 # per-client training varies over the client axis too
                 vars_g = pvary_tree(vars_g, CLIENT_AXIS)
-                gp = vars_g["params"] if trainer.prox_mu > 0 else None
-
-                def one(shard, crng):
-                    v, loss, _ = trainer.local_train(
-                        vars_g, shard, crng, epochs, global_params=gp)
-                    return v, loss
-
-                vs, losses = jax.vmap(one)(cohort, crngs)
-                wsum = jax.tree.map(
-                    lambda v: jnp.einsum("k,k...->...", weights,
-                                         v.astype(jnp.float32)), vs)
-                num = jax.lax.psum(wsum, CLIENT_AXIS)       # ICI tier
-                den = jax.lax.psum(jnp.sum(weights), CLIENT_AXIS)
+                # chunked inner loop (same HBM-bounding scan as the flat
+                # engine, parallel/engine.py::chunked_weighted_train)
+                num, den, lsum = chunked_weighted_train(
+                    trainer, vars_g, cohort, weights, crngs, epochs,
+                    vary_axes=(SILO_AXIS, CLIENT_AXIS),
+                    chunk_cap=self.chunk or 8)
+                num = jax.lax.psum(num, CLIENT_AXIS)        # ICI tier
+                den = jax.lax.psum(den, CLIENT_AXIS)
                 silo_vars = jax.tree.map(
                     lambda s, ref: (s / den).astype(ref.dtype), num, vars_g)
-                loss = jax.lax.psum(jnp.sum(losses * weights),
-                                    CLIENT_AXIS) / den
+                loss = jax.lax.psum(lsum, CLIENT_AXIS) / den
                 return silo_vars, (loss, den)
 
             inner_rngs = jax.random.split(rngs, G)

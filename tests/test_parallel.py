@@ -148,6 +148,24 @@ def test_streaming_matches_resident():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_prime_cohort_chunk_padding():
+    """A 13-client cohort on a 1-shard mesh forces the in-program
+    zero-weight chunk padding (13 -> 16 lanes at cap 8); results must match
+    the unchunked single-device engine exactly."""
+    cfg = _mnist_like_cfg(client_num_in_total=13, client_num_per_round=13,
+                          comm_round=2)
+    trainer, data = _setup(cfg)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(1),
+                           donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_streaming_matches_resident_fedopt():
     """The shared _train_and_update tail must apply subclass server_update
     overrides identically on both cohort paths (FedOpt's optimizer state
