@@ -132,6 +132,7 @@ class FedAvgEngine:
         server_state = self.server_init(variables)
         rng_base = jax.random.PRNGKey(cfg.seed + 1)
         rounds = rounds if rounds is not None else cfg.comm_round
+        self._rounds_limit = rounds       # lets _round_args bound prefetch
         start = 0
         if ckpt is not None and resume and ckpt.latest_round() is not None:
             start, variables, server_state = ckpt.restore(

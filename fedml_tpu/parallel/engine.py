@@ -57,7 +57,11 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
     small divisors for awkward (e.g. prime) cohort sizes.
     """
     k_local = weights.shape[0]
-    chunk = min(chunk_cap, k_local)
+    # balanced sizing: same number of scan trips as ceil(k/cap), but the
+    # lanes are spread evenly so padding (wasted full client trainings on
+    # zero-weight lanes) is minimal — k=12, cap=8 gives 2x6 not 2x8
+    n_trips = -(-k_local // min(chunk_cap, k_local))
+    chunk = -(-k_local // n_trips)
     pad = (-k_local) % chunk
     if pad:
         cohort = jax.tree.map(
@@ -222,14 +226,11 @@ class MeshFedAvgEngine(FedAvgEngine):
                                       weights, rng)
 
     def stream_cohort(self, round_idx: int):
-        """Host-side cohort gather for the streaming path: sample, pad to a
-        mesh multiple, slice the HOST arrays, upload sharded (chunk-multiple
-        padding happens inside chunked_weighted_train)."""
-        ids = np.asarray(self.sampler.sample(round_idx))
-        pad = (-len(ids)) % self.n_shards
-        wmask = np.concatenate([np.ones(len(ids), np.float32),
-                                np.zeros(pad, np.float32)])
-        ids = np.concatenate([ids, np.zeros(pad, ids.dtype)])
+        """Host-side cohort gather for the streaming path: the same padded
+        sampling as the resident path, but slicing the HOST arrays and
+        uploading only the cohort (chunk-multiple padding happens inside
+        chunked_weighted_train)."""
+        ids, wmask = self._sample_padded_np(round_idx)
         sh = client_sharding(self.mesh)
         cohort = {k: jax.device_put(np.take(np.asarray(v), ids, axis=0), sh)
                   for k, v in self.data.client_shards.items()}
@@ -239,14 +240,19 @@ class MeshFedAvgEngine(FedAvgEngine):
         return cohort, weights
 
     # -- driver loop ----------------------------------------------------------
-    def sample_padded(self, round_idx: int):
-        """Sample the round's cohort and pad ids to a mesh-size multiple with
-        zero-weight repeats (wmask=0 drops them from the psum)."""
+    def _sample_padded_np(self, round_idx: int):
+        """Sample the round's cohort and pad ids to a mesh-size multiple
+        with zero-weight repeats (wmask=0 drops them from the psum) —
+        the ONE padding policy shared by the resident and streaming paths."""
         ids = np.asarray(self.sampler.sample(round_idx))
         pad = (-len(ids)) % self.n_shards
         wmask = np.concatenate([np.ones(len(ids), np.float32),
                                 np.zeros(pad, np.float32)])
         ids = np.concatenate([ids, np.zeros(pad, ids.dtype)])
+        return ids, wmask
+
+    def sample_padded(self, round_idx: int):
+        ids, wmask = self._sample_padded_np(round_idx)
         return jnp.asarray(ids), jnp.asarray(wmask)
 
     # the base FedAvgEngine.run drives the loop through these two hooks
@@ -255,7 +261,21 @@ class MeshFedAvgEngine(FedAvgEngine):
 
     def _round_args(self, round_idx: int) -> tuple:
         if self.streaming:
-            return self.stream_cohort(round_idx)
+            # double-buffered uploads: jax.device_put is asynchronous, so
+            # kicking off round r+1's transfer now overlaps it with round
+            # r's compute (two cohorts live on device, bounded).  The base
+            # run() exposes its round budget via _rounds_limit — no gather
+            # past the final round, and the last buffer is released.
+            pre = getattr(self, "_prefetched", None)
+            args = (pre[1] if pre is not None and pre[0] == round_idx
+                    else self.stream_cohort(round_idx))
+            limit = getattr(self, "_rounds_limit", None)
+            if limit is None or round_idx + 1 < limit:
+                self._prefetched = (round_idx + 1,
+                                    self.stream_cohort(round_idx + 1))
+            else:
+                self._prefetched = None
+            return args
         stack, stack_w = self._device_stack()
         ids, wmask = self.sample_padded(round_idx)
         return (stack, stack_w, ids, wmask)
