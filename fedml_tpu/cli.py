@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 
 import numpy as np
@@ -311,6 +312,8 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
     ip_config = {r: "127.0.0.1" for r in range(size)}
     kw = dict(ip_config=ip_config, base_port=args.base_port)
 
+    from fedml_tpu.utils.context import graceful_abort
+
     if args.deploy == "server":
         init_vars = trainer.init(
             jax.random.PRNGKey(cfg.seed),
@@ -319,11 +322,12 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
                                cfg.client_num_in_total, size - 1)
         server = FedAvgServerManager(agg, cfg.comm_round, 0, size,
                                      args.comm_backend, **kw)
-        server.run_async()
-        server.send_init_msg()
-        if not server.done.wait(timeout=600):
-            server.finish()
-            raise TimeoutError("deployment server: rounds did not finish")
+        with graceful_abort(server):
+            server.run_async()
+            server.send_init_msg()
+            if not server.done.wait(timeout=600):
+                raise TimeoutError(
+                    "deployment server: rounds did not finish")
         server.finish()
         variables = jax.tree.map(jnp.asarray, agg.variables)
         eval_fn = jax.jit(trainer.evaluate)
@@ -338,8 +342,20 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
     client = FedAvgClientManager(trainer, data, cfg.epochs, args.rank, size,
                                  args.comm_backend,
                                  total_rounds=cfg.comm_round, **kw)
-    client.run()            # blocks until total_rounds uploads are done
+    with graceful_abort(client):
+        client.run()        # blocks until total_rounds uploads are done
     return 0
+
+
+def _notify_sweep(args) -> None:
+    """wandb-sweep coordination (reference fedavg/utils.py:19-27): agents
+    block on a named pipe until the run reports completion.  Called from
+    EVERY run mode's exit path."""
+    pipe = os.environ.get("FEDML_SWEEP_PIPE")
+    if pipe:
+        from fedml_tpu.utils.context import (
+            post_complete_message_to_sweep_process)
+        post_complete_message_to_sweep_process(vars(args), pipe_path=pipe)
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -360,6 +376,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.deploy:
         rc = _run_deployment(args, cfg, logger)
         logger.finish()
+        _notify_sweep(args)
         return rc
     ckpt = None
     if args.ckpt_dir:
@@ -372,6 +389,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         params = eng.fit(x, y, epochs=cfg.comm_round)
         logger.log({"train_acc": eng.score(params, x, y)})
         logger.finish()
+        _notify_sweep(args)
         return 0
 
     data = _load(cfg)
@@ -399,6 +417,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     if eng.metrics_history and not engine_logs:
         logger.log(eng.metrics_history[-1])
     logger.finish()
+    _notify_sweep(args)
     return 0
 
 

@@ -1,0 +1,72 @@
+"""utils/context.py: graceful abort + sweep-pipe glue (reference
+fedml_api/utils/context.py, fedavg/utils.py:19-27 parity)."""
+import os
+import threading
+
+import pytest
+
+from fedml_tpu.utils.context import (graceful_abort,
+                                     post_complete_message_to_sweep_process)
+
+
+class _FakeManager:
+    def __init__(self, explode=False):
+        self.finished = False
+        self.explode = explode
+
+    def finish(self):
+        if self.explode:
+            raise RuntimeError("teardown boom")
+        self.finished = True
+
+
+def test_graceful_abort_finishes_managers_and_reraises():
+    a, b = _FakeManager(), _FakeManager()
+    with pytest.raises(ValueError, match="boom"):
+        with graceful_abort(a, b):
+            raise ValueError("boom")
+    assert a.finished and b.finished
+
+
+def test_graceful_abort_teardown_error_does_not_mask():
+    bad, good = _FakeManager(explode=True), _FakeManager()
+    with pytest.raises(ValueError):          # original error survives
+        with graceful_abort(bad, good):
+            raise ValueError("original")
+    assert good.finished
+
+
+def test_graceful_abort_no_reraise():
+    m = _FakeManager()
+    with graceful_abort(m, reraise=False):
+        raise RuntimeError("swallowed")
+    assert m.finished
+
+
+def test_graceful_abort_clean_path_leaves_managers_alone():
+    m = _FakeManager()
+    with graceful_abort(m):
+        pass
+    assert not m.finished
+
+
+def test_sweep_pipe_roundtrip(tmp_path):
+    pipe = str(tmp_path / "fedml")
+    got = []
+
+    def reader():
+        with open(pipe) as f:            # blocks until writer attaches
+            got.append(f.read())
+
+    os.mkfifo(pipe)
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    post_complete_message_to_sweep_process({"run": 7}, pipe_path=pipe)
+    t.join(timeout=10)
+    assert got and "training is finished!" in got[0] and "run" in got[0]
+
+
+def test_sweep_pipe_no_reader_is_nonblocking(tmp_path):
+    # the reference blocks forever without a sweep agent; we drop + warn
+    post_complete_message_to_sweep_process(
+        "args", pipe_path=str(tmp_path / "fedml"), wait_for_reader=0.0)
