@@ -53,8 +53,10 @@ class FedAvgEngine:
         self.pallas_agg = pallas_agg
         self.sampler = ClientSampler(cfg.client_num_in_total,
                                      cfg.client_num_per_round)
+        # donate BOTH the variables and the server state (FedOpt's adam
+        # moments are 2x params — donating avoids an HBM copy per round)
         self.round_fn = jax.jit(
-            self._round, donate_argnums=(0,) if donate else ())
+            self._round, donate_argnums=(0, 1) if donate else ())
         self.eval_fn = jax.jit(self.trainer.evaluate)
         # upload eval shards once; evaluate() then runs fully device-side
         self._eval_shards = {

@@ -130,12 +130,12 @@ class MeshFedAvgEngine(FedAvgEngine):
         # stack/stack_w are explicit (pre-sharded) args, not closed-over
         # constants, so the jit never embeds the dataset in the program.
         self.round_fn = jax.jit(self._mesh_round,
-                                donate_argnums=(0,) if donate else ())
+                                donate_argnums=(0, 1) if donate else ())
         # streaming variant: the gather happened on host; cohort arrives
         # pre-sharded [K, ...] with K = padded cohort size
         self.round_fn_streaming = jax.jit(
             self._mesh_round_streaming,
-            donate_argnums=(0,) if donate else ())
+            donate_argnums=(0, 1) if donate else ())
         if streaming:
             self.round_fn = self.round_fn_streaming
 

@@ -66,7 +66,7 @@ class MeshHierarchicalEngine(FedAvgEngine):
         self._stack = None
         self._stack_w = None
         self.round_fn = jax.jit(self._global_round,
-                                donate_argnums=(0,) if donate else ())
+                                donate_argnums=(0, 1) if donate else ())
 
     # -- data layout: [S, C/S, B, bs, ...] sharded (silo, clients) ----------
     def _device_stack(self):
