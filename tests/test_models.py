@@ -31,6 +31,38 @@ def test_new_cv_models_forward(name, shape, classes):
     assert jnp.all(jnp.isfinite(logits))
 
 
+@pytest.mark.parametrize("name,shape,classes", [
+    ("lr", (2, 784), 10),
+    ("cnn", (2, 28, 28, 1), 62),
+    ("cnn_dropout", (2, 28, 28, 1), 62),
+    ("resnet18_gn", IMG32, 10),
+    ("resnet20", IMG32, 10),
+    ("resnet56", IMG32, 100),
+    ("mobilenet", IMG32, 10),
+    ("vgg11", IMG32, 10),
+    ("vgg16", IMG32, 10),
+])
+def test_full_zoo_forward(name, shape, classes):
+    """Every --model factory name produces finite logits of the right
+    shape (reference model zoo §2.6 row-by-row)."""
+    logits = _forward(create_model(name, classes), shape)
+    assert logits.shape == (shape[0], classes)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+@pytest.mark.parametrize("name,vocab,seq", [
+    ("rnn", 90, 80),
+    ("rnn_stackoverflow", 10004, 20),
+])
+def test_zoo_rnn_forward(name, vocab, seq):
+    m = create_model(name, vocab)
+    x = jnp.zeros((2, seq), jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), x, train=False)
+    out = m.apply(v, x, train=False)
+    assert out.shape == (2, seq, vocab)
+    assert jnp.all(jnp.isfinite(out))
+
+
 def test_mobilenet_v3_small_mode():
     m = create_model("mobilenet_v3", 10, mode="small")
     logits = _forward(m, IMG32)
