@@ -75,7 +75,10 @@ def main() -> None:
     # bf16 compute / f32 masters: the MXU fast path (core/trainer.py)
     trainer = ClientTrainer(model, lr=cfg.lr, train_dtype=jnp.bfloat16)
     mesh = make_mesh()
-    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh)
+    # chunk=4 + bf16 local masters: the measured v5e optimum
+    # (tools/profile_bench.py L4; PERF.md round-2 decomposition)
+    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh, chunk=4,
+                              local_dtype=jnp.bfloat16)
 
     variables = engine.init_variables()
     server_state = engine.server_init(variables)

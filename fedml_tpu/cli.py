@@ -81,6 +81,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cohort_chunk", type=int, default=None,
                    help="max client model replicas live per shard "
                         "(default 8; tools/profile_bench.py)")
+    p.add_argument("--local_dtype", type=str, default=None,
+                   choices=("float32", "bfloat16"),
+                   help="dtype of the LOCAL training masters (mesh "
+                        "engines): bfloat16 runs the per-client step "
+                        "chain bf16 end-to-end, aggregation/globals stay "
+                        "f32 (the measured v5e bench recipe, PERF.md)")
     p.add_argument("--mesh", action="store_true",
                    help="shard the cohort over all visible devices")
     p.add_argument("--multihost", action="store_true",
@@ -156,9 +162,11 @@ def build_engine(args, cfg: FedConfig, data):
     """Algorithm dispatch (the reference's fed_launch algorithm select)."""
     algo = args.algorithm
     mesh = None
-    if (args.streaming or args.cohort_chunk) and not args.mesh:
-        raise SystemExit("--streaming/--cohort_chunk require --mesh (they "
-                         "configure the mesh engine's cohort path)")
+    if (args.streaming or args.cohort_chunk or args.local_dtype) \
+            and not args.mesh:
+        raise SystemExit("--streaming/--cohort_chunk/--local_dtype require "
+                         "--mesh (they configure the mesh engine's cohort "
+                         "path)")
     if args.mesh:
         from fedml_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
@@ -180,9 +188,12 @@ def build_engine(args, cfg: FedConfig, data):
             # path does
             logging.getLogger(__name__).warning(
                 "--mesh robust engine only implements norm_clip; running "
-                "the single-device path for --defense %s", args.defense)
+                "the single-device path for --defense %s (mesh-only flags "
+                "--streaming/--cohort_chunk/--local_dtype are ignored)",
+                args.defense)
         elif mesh is not None and algo in ("fedavg", "fedopt", "fedprox",
                                            "fedavg_robust"):
+            import jax.numpy as jnp
             from fedml_tpu.parallel import (MeshFedAvgEngine,
                                             MeshFedOptEngine,
                                             MeshFedProxEngine,
@@ -191,7 +202,9 @@ def build_engine(args, cfg: FedConfig, data):
                    "fedprox": MeshFedProxEngine,
                    "fedavg_robust": MeshRobustEngine}[algo]
             return cls(trainer, data, cfg, mesh=mesh,
-                       streaming=args.streaming, chunk=args.cohort_chunk)
+                       streaming=args.streaming, chunk=args.cohort_chunk,
+                       local_dtype=jnp.bfloat16
+                       if args.local_dtype == "bfloat16" else None)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
             return CentralizedTrainer(trainer, data, cfg)
@@ -212,13 +225,16 @@ def build_engine(args, cfg: FedConfig, data):
                 "--streaming has no hierarchical engine path; the client "
                 "stack stays device-resident")
         if mesh is not None:
+            import jax.numpy as jnp
             from fedml_tpu.parallel import MeshHierarchicalEngine
             from fedml_tpu.parallel.mesh import make_mesh_2d
             mesh2 = make_mesh_2d(args.group_num)
             return MeshHierarchicalEngine(
                 _trainer(cfg, data), data, cfg, mesh=mesh2,
                 group_comm_round=args.group_comm_round,
-                chunk=args.cohort_chunk)
+                chunk=args.cohort_chunk,
+                local_dtype=jnp.bfloat16
+                if args.local_dtype == "bfloat16" else None)
         from fedml_tpu.algorithms import HierarchicalFedAvgEngine
         return HierarchicalFedAvgEngine(
             _trainer(cfg, data), data, cfg, group_num=args.group_num,
@@ -226,6 +242,10 @@ def build_engine(args, cfg: FedConfig, data):
 
     if algo == "decentralized":
         if mesh is not None:
+            if args.local_dtype:
+                logging.getLogger(__name__).warning(
+                    "--local_dtype is not implemented for the gossip "
+                    "engine; running f32 locals")
             from fedml_tpu.parallel import MeshGossipEngine
             return MeshGossipEngine(_trainer(cfg, data), data, cfg,
                                     mesh=mesh)

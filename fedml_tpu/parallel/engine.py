@@ -114,16 +114,27 @@ class MeshFedAvgEngine(FedAvgEngine):
     round's sampled cohort (breaks the HBM-resident wall for cross-device
     scale: 3,400-client femnist, 342,477-client stackoverflow —
     reference benchmark/README.md:54-57 — without holding every shard in
-    device memory)."""
+    device memory).
+
+    `local_dtype=jnp.bfloat16` runs the LOCAL training loop on bf16 master
+    weights: the round's global f32 variables are cast once per round, so
+    the per-step f32→bf16 cast inside the loss becomes a no-op and grads,
+    optimizer updates and the 13-step weight chain stay bf16 end-to-end.
+    Aggregation is unchanged — each client's final weights enter the Σ w·v
+    psum in f32, and the global model stays f32 across rounds (the server
+    average's small increments need the f32 grid; the 13 local steps at
+    lr≫ulp do not).  Measured on v5e: 2.310 → 2.080 s/round at chunk 4
+    (tools/profile_bench.py L4 vs F8)."""
 
     def __init__(self, trainer: ClientTrainer, data: FederatedData,
                  cfg: FedConfig, mesh: Optional[Mesh] = None,
                  donate: bool = True, chunk: Optional[int] = None,
-                 streaming: bool = False):
+                 streaming: bool = False, local_dtype=None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = int(np.prod(list(self.mesh.shape.values())))
         self.chunk = chunk
         self.streaming = streaming
+        self.local_dtype = local_dtype
         super().__init__(trainer, data, cfg, donate=donate)
         self._stack = None           # sharded client stack, uploaded lazily
         self._stack_weights = None
@@ -176,8 +187,13 @@ class MeshFedAvgEngine(FedAvgEngine):
         # the global model arrives replicated; per-client training makes
         # it shard-varying, so cast up-front for the vma type system
         variables = pvary_tree(variables, axes)
+        local_vars = variables
+        if self.local_dtype is not None:
+            local_vars = jax.tree.map(
+                lambda a: a.astype(self.local_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, variables)
         num, den, lsum = chunked_weighted_train(
-            self.trainer, variables, cohort, weights, client_rngs,
+            self.trainer, local_vars, cohort, weights, client_rngs,
             self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk or 8,
             client_transform=self.client_transform)
         num = jax.lax.psum(num, axes)

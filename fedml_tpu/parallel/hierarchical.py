@@ -52,8 +52,9 @@ class MeshHierarchicalEngine(FedAvgEngine):
                  cfg: FedConfig, n_silos: int = 2,
                  group_comm_round: int = 1,
                  mesh: Optional[Mesh] = None, donate: bool = True,
-                 chunk: Optional[int] = None):
+                 chunk: Optional[int] = None, local_dtype=None):
         self.chunk = chunk
+        self.local_dtype = local_dtype   # bf16 local masters (engine.py)
         self.mesh = mesh if mesh is not None else make_mesh_2d(n_silos)
         self.n_silos = self.mesh.shape[SILO_AXIS]
         self.per_silo_shards = self.mesh.shape[CLIENT_AXIS]
@@ -136,10 +137,18 @@ class MeshHierarchicalEngine(FedAvgEngine):
                 crngs = jax.random.split(rng_g, idx.shape[0])
                 # per-client training varies over the client axis too
                 vars_g = pvary_tree(vars_g, CLIENT_AXIS)
+                local_vars = vars_g
+                if self.local_dtype is not None:
+                    # bf16 local masters: silo/global masters stay f32,
+                    # only the per-client step chain runs reduced
+                    local_vars = jax.tree.map(
+                        lambda a: a.astype(self.local_dtype)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                        vars_g)
                 # chunked inner loop (same HBM-bounding scan as the flat
                 # engine, parallel/engine.py::chunked_weighted_train)
                 num, den, lsum = chunked_weighted_train(
-                    trainer, vars_g, cohort, weights, crngs, epochs,
+                    trainer, local_vars, cohort, weights, crngs, epochs,
                     vary_axes=(SILO_AXIS, CLIENT_AXIS),
                     chunk_cap=self.chunk or 8)
                 num = jax.lax.psum(num, CLIENT_AXIS)        # ICI tier

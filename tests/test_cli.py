@@ -90,7 +90,7 @@ def test_cli_algorithm_table_is_exhaustive():
 def test_cli_streaming_mesh(tmp_path):
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
                 "--model", "lr", "--mesh", "--streaming",
-                "--cohort_chunk", "2")
+                "--cohort_chunk", "2", "--local_dtype", "bfloat16")
     assert s
 
 

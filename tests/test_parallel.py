@@ -202,6 +202,27 @@ def test_chunk_size_invariance():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_local_dtype_bf16_close_to_f32():
+    """bf16 local masters (the bench's measured v5e win, PERF.md): globals
+    stay f32, results stay close to the f32 local path, and the model still
+    learns."""
+    cfg = _mnist_like_cfg(comm_round=3)
+    trainer, data = _setup(cfg)
+    ref = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False)
+    v0 = ref.init_variables()
+    v_f32 = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, local_dtype=jnp.bfloat16)
+    v_bf16 = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for a, b in zip(jax.tree.leaves(v_f32), jax.tree.leaves(v_bf16)):
+        assert a.dtype == b.dtype       # globals keep the f32 grid
+        # bf16 has ~3 decimal digits; after 3 rounds the trees must agree
+        # to bf16 resolution, not diverge
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.05, atol=0.02)
+
+
 def test_streaming_large_client_count():
     """Femnist-shaped scale proxy: many clients, tiny per-round cohort —
     the streaming path never uploads the full stack."""

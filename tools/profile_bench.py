@@ -113,9 +113,19 @@ def exp_B():
     print(f"B centralized_ceiling: {dt:.3f}s/round-equivalent", flush=True)
 
 
-def _chunked_round(chunk, data_dtype=None):
-    """Chunked cohort: scan over 128/chunk groups, weighted-sum in carry."""
-    model = create_model("resnet18_gn", output_dim=10)
+def _chunked_round(chunk, data_dtype=None, master_dtype=None,
+                   model_fn=None, unroll=1):
+    """THE chunked-round harness (every experiment row shares this exact
+    accumulation + timing protocol):
+      chunk        -- live client replicas per scan trip
+      data_dtype   -- stored dtype of the client stack (H rows)
+      master_dtype -- dtype of the LOCAL master weights (L rows; the
+                      engine's local_dtype — aggregation stays f32)
+      model_fn     -- alternative model constructor (G rows)
+      unroll       -- lax.scan unroll depth for the batch loop (U rows)
+    """
+    model = model_fn() if model_fn else create_model("resnet18_gn",
+                                                     output_dim=10)
     trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
     rs = np.random.RandomState(0)
     shard = client_batches(rs)
@@ -124,8 +134,25 @@ def _chunked_round(chunk, data_dtype=None):
                  "mask": shard["mask"]}
     weights = jnp.full((N_CLIENTS,), float(SPC), jnp.float32)
     variables = trainer.init(jax.random.PRNGKey(0), shard["x"][0, 0, :1])
+    if master_dtype is not None:
+        variables = jax.tree.map(
+            lambda a: a.astype(master_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, variables)
     rngs = jax.random.split(jax.random.PRNGKey(1), N_CLIENTS)
     n_chunks = N_CLIENTS // chunk
+
+    from fedml_tpu.core.trainer import TrainState
+
+    def local_train(v, s, r):
+        state = TrainState(variables=v, opt_state=trainer.init_opt(v), rng=r)
+
+        def body(state, batch):
+            state, loss = trainer.train_step(state, batch)
+            return state, (loss, jnp.sum(batch["mask"]))
+
+        state, (losses, counts) = jax.lax.scan(body, state, s, unroll=unroll)
+        return state.variables, jnp.sum(losses * counts) / jnp.maximum(
+            jnp.sum(counts), 1.0)
 
     def round_fn(variables, shard, weights, rngs):
         sh = jax.tree.map(
@@ -133,14 +160,11 @@ def _chunked_round(chunk, data_dtype=None):
         w = weights.reshape(n_chunks, chunk)
         r = rngs.reshape(n_chunks, chunk, -1)
 
-        def one(v, s, cr):
-            nv, loss, _ = trainer.local_train(v, s, cr, 1)
-            return nv, loss
-
         def chunk_body(carry, xs):
             num, den, lsum = carry
             cs, cw, cr = xs
-            vs, losses = jax.vmap(one, in_axes=(None, 0, 0))(variables, cs, cr)
+            vs, losses = jax.vmap(local_train,
+                                  in_axes=(None, 0, 0))(variables, cs, cr)
             num = jax.tree.map(
                 lambda acc, v: acc + jnp.einsum(
                     "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
@@ -156,8 +180,11 @@ def _chunked_round(chunk, data_dtype=None):
         return avg, lsum / den
 
     fn = jax.jit(round_fn)
-    dt = timeit(lambda: fn(variables, shard, weights, rngs)[1])
-    return dt
+    return timeit(lambda: fn(variables, shard, weights, rngs)[1])
+
+
+def _bf16_master_round(chunk):
+    return _chunked_round(chunk, master_dtype=jnp.bfloat16)
 
 
 def exp_F4():
@@ -183,65 +210,163 @@ def exp_F64():
 def exp_H16():
     """chunked(16) with the data stack stored bf16 (halves HBM reads)."""
     print(f"H16 chunked(16,bf16 data): "
-          f"{_chunked_round(16, jnp.bfloat16):.3f}s/round", flush=True)
+          f"{_chunked_round(16, data_dtype=jnp.bfloat16):.3f}s/round",
+          flush=True)
 
 
 def exp_H32():
     print(f"H32 chunked(32,bf16 data): "
-          f"{_chunked_round(32, jnp.bfloat16):.3f}s/round", flush=True)
+          f"{_chunked_round(32, data_dtype=jnp.bfloat16):.3f}s/round",
+          flush=True)
 
 
-def _bf16_master_round(chunk):
-    """chunked(chunk) with the MASTER weights in bf16 for the local loop:
-    the per-step f32->bf16 cast becomes a no-op and grads/updates run
-    bf16 end-to-end (aggregation still f32 via the einsum cast)."""
-    model = create_model("resnet18_gn", output_dim=10)
-    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
-    rs = np.random.RandomState(0)
-    shard = client_batches(rs)
-    weights = jnp.full((N_CLIENTS,), float(SPC), jnp.float32)
-    variables = trainer.init(jax.random.PRNGKey(0), shard["x"][0, 0, :1])
-    variables = jax.tree.map(
-        lambda a: a.astype(jnp.bfloat16)
-        if jnp.issubdtype(a.dtype, jnp.floating) else a, variables)
-    rngs = jax.random.split(jax.random.PRNGKey(1), N_CLIENTS)
-    n_chunks = N_CLIENTS // chunk
+def exp_L2():
+    print(f"L2 chunked(2,bf16 masters): "
+          f"{_bf16_master_round(2):.3f}s/round", flush=True)
 
-    def round_fn(variables, shard, weights, rngs):
-        sh = jax.tree.map(
-            lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]), shard)
-        w = weights.reshape(n_chunks, chunk)
-        r = rngs.reshape(n_chunks, chunk, -1)
 
-        def one(v, s, cr):
-            nv, loss, _ = trainer.local_train(v, s, cr, 1)
-            return nv, loss
-
-        def chunk_body(carry, xs):
-            num, den, lsum = carry
-            cs, cw, cr = xs
-            vs, losses = jax.vmap(one, in_axes=(None, 0, 0))(variables, cs, cr)
-            num = jax.tree.map(
-                lambda acc, v: acc + jnp.einsum(
-                    "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
-            return (num, den + jnp.sum(cw),
-                    lsum + jnp.sum(losses * cw)), None
-
-        zeros = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
-                             variables)
-        (num, den, lsum), _ = jax.lax.scan(
-            chunk_body, (zeros, jnp.float32(0), jnp.float32(0)), (sh, w, r))
-        avg = jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype),
-                           num, variables)
-        return avg, lsum / den
-
-    fn = jax.jit(round_fn)
-    return timeit(lambda: fn(variables, shard, weights, rngs)[1])
+def exp_L4():
+    print(f"L4 chunked(4,bf16 masters): "
+          f"{_bf16_master_round(4):.3f}s/round", flush=True)
 
 
 def exp_L8():
     print(f"L8 chunked(8,bf16 masters): "
           f"{_bf16_master_round(8):.3f}s/round", flush=True)
+
+
+def exp_L16():
+    print(f"L16 chunked(16,bf16 masters): "
+          f"{_bf16_master_round(16):.3f}s/round", flush=True)
+
+
+def exp_L32():
+    print(f"L32 chunked(32,bf16 masters): "
+          f"{_bf16_master_round(32):.3f}s/round", flush=True)
+
+
+def _conv_formulation(kind, k=8, b=32, h=32, w=32, cin=64, cout=64,
+                      iters=20):
+    """Per-client conv formulations: vmap-over-weights (what the engine
+    does today) vs im2col + batched matmul (explicit MXU tiling).
+    Forward + backward (the training cost), timed per iteration."""
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(k, b, h, w, cin).astype(np.float32)).astype(jnp.bfloat16)
+    wt = jnp.asarray(rs.rand(k, 3, 3, cin, cout).astype(np.float32)).astype(jnp.bfloat16)
+
+    if kind == "vmap":
+        def conv1(xi, wi):
+            return jax.lax.conv_general_dilated(
+                xi, wi, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        f = jax.vmap(conv1)
+    else:
+        def f(xs, ws):
+            # im2col: [k, b*h*w, 9*cin] patches, then one batched matmul
+            patches = jax.lax.conv_general_dilated_patches(
+                xs.reshape(k * b, h, w, cin), (3, 3), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            # conv_general_dilated_patches emits channel-major patches
+            # ([cin*9] with cin outer), so order the weights to match
+            pat = patches.reshape(k, b * h * w, cin * 9)
+            wm = ws.transpose(0, 3, 1, 2, 4).reshape(k, cin * 9, cout)
+            out = jnp.einsum("kpc,kcd->kpd", pat, wm)
+            return out.reshape(k, b, h, w, cout)
+
+    def loss(ws):
+        return jnp.sum(f(x, ws).astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.value_and_grad(loss))
+    for _ in range(3):
+        out = g(wt)
+    force(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(wt)
+    force(out[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def exp_CONV():
+    """Grouped-conv penalty microbenchmark: is im2col+batched-matmul faster
+    than the vmapped conv XLA emits for per-client weights?"""
+    for cin, cout, hw in [(64, 64, 32), (128, 128, 16), (256, 256, 8)]:
+        tv = _conv_formulation("vmap", cin=cin, cout=cout, h=hw, w=hw)
+        ti = _conv_formulation("im2col", cin=cin, cout=cout, h=hw, w=hw)
+        print(f"CONV {cin}x{cout}@{hw}: vmap {tv*1e3:.2f}ms  "
+              f"im2col {ti*1e3:.2f}ms  ratio {tv/ti:.2f}x", flush=True)
+
+
+def _barrier_gn_model():
+    """ResNet-18-GN clone whose GroupNorms see their input through an
+    optimization_barrier — prevents XLA from output-fusing the conv with
+    the GN statistics reduces (the trace shows conv+GN-stat fusions
+    dominating at low MFU; does unfusing let the conv run clean?)."""
+    from functools import partial
+    from typing import Sequence
+    import flax.linen as nn
+
+    class BGN(nn.GroupNorm):
+        @nn.compact
+        def __call__(self, x):
+            return super().__call__(jax.lax.optimization_barrier(x))
+
+    class Block(nn.Module):
+        filters: int
+        strides: int = 1
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            norm = partial(BGN, num_groups=2)
+            residual = x
+            y = nn.Conv(self.filters, (3, 3),
+                        strides=(self.strides, self.strides),
+                        padding="SAME", use_bias=False)(x)
+            y = nn.relu(norm()(y))
+            y = nn.Conv(self.filters, (3, 3), padding="SAME",
+                        use_bias=False)(y)
+            y = norm()(y)
+            if residual.shape != y.shape:
+                residual = nn.Conv(self.filters, (1, 1),
+                                   strides=(self.strides, self.strides),
+                                   use_bias=False)(x)
+                residual = norm()(residual)
+            return nn.relu(y + residual)
+
+    class Net(nn.Module):
+        num_classes: int = 10
+        stage_sizes: Sequence[int] = (2, 2, 2, 2)
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.relu(BGN(num_groups=2)(x))
+            for i, n in enumerate(self.stage_sizes):
+                for j in range(n):
+                    x = Block(64 * 2 ** i,
+                              2 if i > 0 and j == 0 else 1)(x, train)
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(self.num_classes)(x)
+
+    return Net()
+
+
+def exp_G4():
+    """chunk-4 bf16-masters round with conv/GN fusion barriers."""
+    dt = _chunked_round(4, master_dtype=jnp.bfloat16,
+                        model_fn=_barrier_gn_model)
+    print(f"G4 chunked(4,bf16 masters,GN fusion barrier): "
+          f"{dt:.3f}s/round", flush=True)
+
+
+def exp_U8():
+    print(f"U8 chunked(8,unroll=2): "
+          f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
+
+
+def exp_U8x4():
+    print(f"U8x4 chunked(8,unroll=4): "
+          f"{_chunked_round(8, unroll=4):.3f}s/round", flush=True)
 
 
 if __name__ == "__main__":
