@@ -242,10 +242,10 @@ def build_engine(args, cfg: FedConfig, data):
 
     if algo == "decentralized":
         if mesh is not None:
-            if args.local_dtype:
+            if args.local_dtype == "bfloat16":
                 logging.getLogger(__name__).warning(
-                    "--local_dtype is not implemented for the gossip "
-                    "engine; running f32 locals")
+                    "--local_dtype bfloat16 is not implemented for the "
+                    "gossip engine; running f32 locals")
             from fedml_tpu.parallel import MeshGossipEngine
             return MeshGossipEngine(_trainer(cfg, data), data, cfg,
                                     mesh=mesh)

@@ -212,13 +212,14 @@ class ClientTrainer:
 
     # -- local training: epochs x batches under lax.scan --------------------
     def local_train(self, variables: Pytree, shard, rng: jax.Array,
-                    epochs: int, global_params=None):
+                    epochs: int, global_params=None, unroll: int = 1):
         """Run E local epochs of SGD over one client's padded shard.
 
         shard: {"x": [B, bs, ...], "y": [B, bs, ...], "mask": [B, bs]}
         Returns (new_variables, mean_loss, n_samples). This is the reference's
         client hot loop (my_model_trainer_classification.py:19-53) as a single
-        scanned XLA program.
+        scanned XLA program.  `unroll` is threaded to the batch scan (a perf
+        knob probed by tools/profile_bench.py; measured neutral on v5e).
         """
         state = TrainState(variables=variables,
                            opt_state=self.init_opt(variables), rng=rng)
@@ -228,7 +229,8 @@ class ClientTrainer:
             return state, (loss, jnp.sum(batch["mask"]))
 
         def epoch_body(state, _):
-            state, (losses, counts) = jax.lax.scan(batch_body, state, shard)
+            state, (losses, counts) = jax.lax.scan(batch_body, state, shard,
+                                                   unroll=unroll)
             # sample-weighted epoch loss: padding batches contribute nothing
             return state, jnp.sum(losses * counts) / jnp.maximum(jnp.sum(counts), 1.0)
 

@@ -437,6 +437,25 @@ def load_data(dataset: str,
     if dataset.startswith("synthetic_"):
         ab = dataset.split("_")[1:]
         alpha, beta = float(ab[0]), float(ab[1])
+        # real path: the reference SHIPS these datasets as pre-generated
+        # LEAF JSONs (data/synthetic_1_1/{train/mytrain,test/mytest}.json;
+        # fedml_api/data_preprocessing/synthetic_1_1/data_loader.py:14-15).
+        # Only probed when data_dir is EXPLICIT: unlike the named-dataset
+        # loaders, synthetic_* encodes generation parameters in its name,
+        # and stray ./train ./test dirs must not shadow the generator.
+        if data_dir:
+            try:
+                u_tr, ud_tr = readers.read_leaf_dir(
+                    os.path.join(data_dir, "train"))
+                u_te, ud_te = readers.read_leaf_dir(
+                    os.path.join(data_dir, "test"))
+                x_tr, y_tr, tr_map = readers.leaf_to_arrays(u_tr[:C], ud_tr)
+                xt, yt, _ = readers.leaf_to_arrays(u_te[:C], ud_te)
+                return _make(x_tr, y_tr, xt, yt, tr_map, bs, 10,
+                             max_batches_per_client, None, seed,
+                             synthetic=False)
+            except FileNotFoundError:
+                pass
         x, y, idx_map = synthetic.synthetic_fedprox(alpha, beta, C, seed=seed)
         n = len(y)
         # 90/10 train/test split inside each client, reference-style

@@ -141,18 +141,11 @@ def _chunked_round(chunk, data_dtype=None, master_dtype=None,
     rngs = jax.random.split(jax.random.PRNGKey(1), N_CLIENTS)
     n_chunks = N_CLIENTS // chunk
 
-    from fedml_tpu.core.trainer import TrainState
-
     def local_train(v, s, r):
-        state = TrainState(variables=v, opt_state=trainer.init_opt(v), rng=r)
-
-        def body(state, batch):
-            state, loss = trainer.train_step(state, batch)
-            return state, (loss, jnp.sum(batch["mask"]))
-
-        state, (losses, counts) = jax.lax.scan(body, state, s, unroll=unroll)
-        return state.variables, jnp.sum(losses * counts) / jnp.maximum(
-            jnp.sum(counts), 1.0)
+        # the engine's ACTUAL client loop (unroll is a pass-through knob),
+        # so the harness always measures the shipped code path
+        nv, loss, _n = trainer.local_train(v, s, r, 1, unroll=unroll)
+        return nv, loss
 
     def round_fn(variables, shard, weights, rngs):
         sh = jax.tree.map(
