@@ -51,18 +51,7 @@ class FedAvgEngine:
         # tree-mean is already fused well — the kernel wins when the whole
         # stack is flattened anyway (robust pipeline) or on very many leaves
         self.pallas_agg = pallas_agg
-        # sample over the clients the DATA actually has: real-file loaders
-        # honor the file's natural client count, which can be smaller than
-        # cfg.client_num_in_total — sampling cfg's range would gather
-        # out-of-range ids (silently clamped by jnp.take) and train wrong
-        # shards under wrong weights
-        n_total = data.client_num
-        if n_total != cfg.client_num_in_total:
-            log.warning(
-                "dataset has %d clients but client_num_in_total=%d; "
-                "sampling over the dataset's %d",
-                n_total, cfg.client_num_in_total, n_total)
-        self.sampler = ClientSampler(n_total, cfg.client_num_per_round)
+        self.sampler = ClientSampler.for_data(data, cfg)
         # donate BOTH the variables and the server state (FedOpt's adam
         # moments are 2x params — donating avoids an HBM copy per round)
         self.round_fn = jax.jit(

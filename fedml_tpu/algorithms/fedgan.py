@@ -48,8 +48,7 @@ class FedGANEngine:
         self.latent_dim = latent_dim
         self.g_tx = make_optimizer("adam", cfg.lr)
         self.d_tx = make_optimizer("adam", cfg.lr)
-        self.sampler = ClientSampler(cfg.client_num_in_total,
-                                     cfg.client_num_per_round)
+        self.sampler = ClientSampler.for_data(data, cfg)
         self.round_fn = jax.jit(self._round)
         self.metrics_history: list[dict] = []
 

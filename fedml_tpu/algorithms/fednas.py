@@ -83,8 +83,7 @@ class FedNASSearchEngine:
             optax.add_decayed_weights(arch_weight_decay),
             optax.scale_by_adam(b1=0.5, b2=0.999),
             optax.scale(-arch_lr))
-        self.sampler = ClientSampler(cfg.client_num_in_total,
-                                     cfg.client_num_per_round)
+        self.sampler = ClientSampler.for_data(data, cfg)
         self.round_fn = jax.jit(
             self._round, donate_argnums=(0, 1) if donate else ())
         self.eval_fn = jax.jit(self._eval_shard_metrics)

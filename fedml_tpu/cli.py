@@ -26,7 +26,8 @@ from fedml_tpu.utils.config import FedConfig
 
 ALGORITHMS = ("fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
               "hierarchical", "decentralized", "fednas", "fedgan",
-              "fedgkt", "splitnn", "vfl", "turboaggregate", "centralized")
+              "fedgkt", "splitnn", "fedseg", "vfl", "turboaggregate",
+              "centralized")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -60,6 +61,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic_scale", type=float, default=1.0)
     p.add_argument("--train_dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
+    # fedseg utils parity: LR_Scheduler (poly/cos/step + warmup) and
+    # SegmentationLosses (focal, ignore_index) — fedseg/utils.py:71-157
+    p.add_argument("--lr_scheduler", type=str, default=None,
+                   choices=("poly", "cos", "step"),
+                   help="per-local-round LR schedule over E*B steps")
+    p.add_argument("--lr_step", type=int, default=0,
+                   help="step schedule: epochs per 0.1x decay")
+    p.add_argument("--warmup_epochs", type=int, default=0)
+    p.add_argument("--loss_type", type=str, default=None,
+                   choices=("ce", "focal"),
+                   help="override the dataset-derived loss")
+    p.add_argument("--train_ignore_id", type=int, default=None,
+                   help="label id excluded from train loss + metrics "
+                        "(segmentation void label, reference 255)")
     p.add_argument("--max_batches_per_client", type=int, default=None)
     p.add_argument("--augment", action="store_true",
                    help="crop+flip(+cutout) augmentation in the train step")
@@ -127,20 +142,34 @@ def _load(cfg: FedConfig):
                      seed=cfg.seed, synthetic_scale=cfg.synthetic_scale)
 
 
-def _trainer(cfg: FedConfig, data):
+def _trainer(cfg: FedConfig, data, model_name: Optional[str] = None,
+             force_time_axis: bool = False,
+             default_train_ignore: Optional[int] = None):
+    """Build the ClientTrainer for a run.  `model_name` overrides
+    cfg.model (fedseg forces segnet), `force_time_axis` broadcasts the
+    per-sample mask over trailing label axes (sequence time OR seg H,W),
+    `default_train_ignore` is the void label applied when the user gave
+    no --train_ignore_id (VOC 255)."""
     import jax.numpy as jnp
-    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.core.trainer import ClientTrainer, make_lr_schedule
     from fedml_tpu.models import create_model
     loss = "bce" if cfg.dataset == "stackoverflow_lr" else "ce"
+    if cfg.loss_type:
+        loss = cfg.loss_type
     # LEAF shakespeare is a scalar next-char task (model predicts the last
     # position only, reference rnn.py:30-33); the TFF variants are per-position
-    has_time = cfg.dataset in ("fed_shakespeare", "stackoverflow_nwp")
+    has_time = force_time_axis or cfg.dataset in ("fed_shakespeare",
+                                                  "stackoverflow_nwp")
     kw = ({"last_only": True}
           if cfg.model == "rnn" and cfg.dataset == "shakespeare" else {})
-    model = create_model(cfg.model, data.class_num, **kw)
+    model = create_model(model_name or cfg.model, data.class_num, **kw)
     dtype = jnp.bfloat16 if cfg.train_dtype == "bfloat16" else jnp.float32
     aug = None
     if cfg.augment:
+        if default_train_ignore is not None:
+            # segmentation: augment transforms x only, which would
+            # misalign the spatial labels
+            raise SystemExit("--augment is not supported for fedseg")
         if data.client_shards["x"].ndim != 6:   # [C, B, bs, H, W, ch] images
             raise SystemExit("--augment requires an image dataset")
         from fedml_tpu.data.augment import make_augment_fn
@@ -151,11 +180,24 @@ def _trainer(cfg: FedConfig, data):
     # both text.py vocab layouts)
     ignore = 0 if cfg.dataset in ("fed_shakespeare",
                                   "stackoverflow_nwp") else None
+    lr = cfg.lr
+    if cfg.lr_scheduler:
+        # schedule spans one local round: E epochs x B padded batches
+        # (the reference recreates its scheduler per train() call too)
+        B = data.client_shards["x"].shape[1]
+        lr = make_lr_schedule(cfg.lr_scheduler, cfg.lr,
+                              total_steps=cfg.epochs * B,
+                              iters_per_epoch=B,
+                              lr_step_epochs=cfg.lr_step,
+                              warmup_steps=cfg.warmup_epochs * B)
+    train_ignore = (default_train_ignore if cfg.train_ignore_id is None
+                    else cfg.train_ignore_id)
     return ClientTrainer(model, loss=loss, optimizer=cfg.client_optimizer,
-                         lr=cfg.lr, momentum=cfg.momentum,
+                         lr=lr, momentum=cfg.momentum,
                          weight_decay=cfg.wd, prox_mu=cfg.prox_mu,
                          has_time_axis=has_time, train_dtype=dtype,
-                         augment=aug, eval_ignore_id=ignore)
+                         augment=aug, eval_ignore_id=ignore,
+                         train_ignore_id=train_ignore)
 
 
 def build_engine(args, cfg: FedConfig, data):
@@ -267,6 +309,14 @@ def build_engine(args, cfg: FedConfig, data):
                                   layers=args.nas_layers,
                                   steps=args.nas_steps,
                                   multiplier=args.nas_multiplier)
+
+    if algo == "fedseg":
+        from fedml_tpu.algorithms.fedseg import FedSegEngine
+        # segnet model, mask broadcast over label H,W, VOC void 255
+        # (reference SegmentationLosses ignore_index, fedseg/utils.py:72)
+        trainer = _trainer(cfg, data, model_name="segnet",
+                           force_time_axis=True, default_train_ignore=255)
+        return FedSegEngine(trainer, data, cfg)
 
     if algo == "fedgan":
         from fedml_tpu.algorithms.fedgan import FedGANEngine

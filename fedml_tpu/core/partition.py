@@ -42,9 +42,18 @@ def partition_dirichlet(
     classes = np.unique(labels)
     rng = np.random.RandomState(seed)
 
-    min_size = 0
+    # Termination fix over the reference: clamp the floor to the FEASIBLE
+    # n // n_clients (the reference's ``n/C + 1`` bound cannot be met by
+    # all clients simultaneously — k·(⌊n/k⌋+1) > n — so any call with
+    # n < 10·n_clients would loop forever there), and relax it by 1 after
+    # every 200 unlucky draws so tiny-n/small-α configs still return.
+    target = max(min(min_size_floor, n // n_clients), 0)
+    attempts = 0
     idx_batch: list[list[int]] = []
-    while min_size < min(min_size_floor, n // n_clients + 1):
+    while True:   # at least one draw, even when target == 0 (n < n_clients)
+        attempts += 1
+        if attempts % 200 == 0 and target > 0:
+            target -= 1
         idx_batch = [[] for _ in range(n_clients)]
         for k in classes:
             idx_k = np.where(labels == k)[0]
@@ -58,6 +67,8 @@ def partition_dirichlet(
             cuts = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
             idx_batch = [b + part.tolist() for b, part in zip(idx_batch, np.split(idx_k, cuts))]
         min_size = min(len(b) for b in idx_batch)
+        if min_size >= target:
+            break
 
     out = {}
     for i in range(n_clients):

@@ -22,6 +22,23 @@ class ClientSampler:
         self.client_num_in_total = client_num_in_total
         self.client_num_per_round = client_num_per_round
 
+    @classmethod
+    def for_data(cls, data, cfg) -> "ClientSampler":
+        """Sampler over the clients the DATA actually has: real-file
+        loaders honor the file's natural client count, which can differ
+        from cfg.client_num_in_total — sampling cfg's range would gather
+        out-of-range ids (silently clamped by jnp.take) and train wrong
+        shards under wrong weights.  Every engine must construct its
+        sampler through this."""
+        n_total = data.client_num
+        if n_total != cfg.client_num_in_total:
+            import logging
+            logging.getLogger(__name__).warning(
+                "dataset has %d clients but client_num_in_total=%d; "
+                "sampling over the dataset's %d",
+                n_total, cfg.client_num_in_total, n_total)
+        return cls(n_total, cfg.client_num_per_round)
+
     def sample(self, round_idx: int) -> np.ndarray:
         if self.client_num_in_total == self.client_num_per_round:
             return np.arange(self.client_num_in_total, dtype=np.int64)

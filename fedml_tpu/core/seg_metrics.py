@@ -18,9 +18,13 @@ import numpy as np
 
 def confusion_matrix(pred: jnp.ndarray, label: jnp.ndarray,
                      mask: jnp.ndarray, num_classes: int) -> jnp.ndarray:
-    """[C, C] counts; rows = true class, cols = predicted. Mask-aware."""
-    valid = mask.reshape(-1) > 0
-    idx = label.reshape(-1) * num_classes + pred.reshape(-1)
+    """[C, C] counts; rows = true class, cols = predicted. Mask-aware.
+    Out-of-range labels (VOC void 255) are excluded, exactly the reference
+    Evaluator's ``(gt >= 0) & (gt < num_class)`` mask (fedseg utils.py
+    Evaluator._generate_matrix)."""
+    lab = label.reshape(-1)
+    valid = (mask.reshape(-1) > 0) & (lab >= 0) & (lab < num_classes)
+    idx = lab * num_classes + pred.reshape(-1)
     idx = jnp.where(valid, idx, num_classes * num_classes)   # spill bucket
     counts = jnp.zeros(num_classes * num_classes + 1, jnp.float32)
     counts = counts.at[idx].add(1.0)

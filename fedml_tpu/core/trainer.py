@@ -44,10 +44,43 @@ def _split_variables(variables):
     return params, rest
 
 
-def make_optimizer(name: str, lr: float, momentum: float = 0.0,
+def make_lr_schedule(mode: str, base_lr: float, total_steps: int,
+                     iters_per_epoch: int = 1, lr_step_epochs: int = 0,
+                     warmup_steps: int = 0):
+    """The reference's LR_Scheduler (fedseg/utils.py:114-157) as an optax
+    schedule over the LOCAL step count T (the reference recreates its
+    scheduler per train() call, so per-round restart is parity):
+
+      poly: lr·(1−T/N)^0.9 · cos: 0.5·lr·(1+cos(πT/N)) ·
+      step: lr·0.1^(epoch//lr_step) · linear warmup for T < warmup_steps.
+    """
+    if mode not in ("poly", "cos", "step"):
+        raise ValueError(f"unknown lr schedule {mode!r}")
+    if mode == "step" and not lr_step_epochs:
+        raise ValueError("step schedule needs lr_step_epochs")
+    N = max(total_steps, 1)
+
+    def schedule(count):
+        T = jnp.minimum(count, N).astype(jnp.float32)
+        if mode == "poly":
+            lr = base_lr * (1.0 - T / N) ** 0.9
+        elif mode == "cos":
+            lr = 0.5 * base_lr * (1.0 + jnp.cos(jnp.pi * T / N))
+        else:
+            epoch = count // iters_per_epoch
+            lr = base_lr * 0.1 ** (epoch // lr_step_epochs)
+        if warmup_steps > 0:
+            lr = jnp.where(T < warmup_steps, lr * T / warmup_steps, lr)
+        return lr
+
+    return schedule
+
+
+def make_optimizer(name: str, lr, momentum: float = 0.0,
                    weight_decay: float = 0.0) -> optax.GradientTransformation:
     """Client optimizer factory (reference exposes sgd/adam via --client_optimizer,
-    my_model_trainer_classification.py:25-35)."""
+    my_model_trainer_classification.py:25-35).  `lr` may be a float or an
+    optax schedule (make_lr_schedule)."""
     if name == "adamw":   # adamw owns its decay — do not chain it twice
         return optax.adamw(lr, weight_decay=weight_decay)
     txs = []
@@ -86,6 +119,24 @@ def masked_bce(logits, targets, mask):
     return jnp.sum(bce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
+def focal_from_ce(ce, gamma: float = 2.0, alpha: float = 0.5):
+    """α·(1−pt)^γ·CE with pt = exp(−CE), elementwise."""
+    return alpha * (1.0 - jnp.exp(-ce)) ** gamma * ce
+
+
+def masked_focal_loss(logits, labels, mask, gamma: float = 2.0,
+                      alpha: float = 0.5):
+    """Per-element focal loss (fedseg SegmentationLosses.FocalLoss,
+    utils.py:97-111, defaults γ=2 α=0.5).  The reference applies the focal
+    transform to the already-averaged CE (a scalar); per-element is the
+    published formulation and strictly more useful — documented
+    deviation."""
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    focal = focal_from_ce(ce, gamma, alpha)
+    mask = mask.astype(focal.dtype)
+    return jnp.sum(focal * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def masked_accuracy_sums(logits, labels, mask):
     """Returns (n_correct, n_valid) so accuracies aggregate exactly across
     clients/batches (the reference sums correct/total the same way,
@@ -110,17 +161,27 @@ class ClientTrainer:
         google-research/federated stackoverflow_dataset; pad=0 in both
         data/text.py vocab layouts).  Training loss is untouched — the
         reference trains plain CE over all positions.
+      train_ignore_id: label id excluded from the TRAINING loss too
+        (segmentation void label, reference SegmentationLosses
+        ignore_index=255, fedseg/utils.py:72).
+      lr: float, or an optax schedule from make_lr_schedule (the
+        reference's poly/cos/step LR_Scheduler; restarts per local round
+        because opt state is re-initialized per local_train — parity).
+      loss: "ce" | "bce" | "focal" (focal: fedseg utils.py:97, γ=2 α=0.5).
     """
 
     def __init__(self, model, loss: str = "ce", optimizer: str = "sgd",
-                 lr: float = 0.03, momentum: float = 0.0,
+                 lr=0.03, momentum: float = 0.0,
                  weight_decay: float = 0.0, prox_mu: float = 0.0,
                  has_time_axis: bool = False,
                  train_dtype=jnp.float32,
                  augment: Optional[Callable] = None,
-                 eval_ignore_id: Optional[int] = None):
+                 eval_ignore_id: Optional[int] = None,
+                 train_ignore_id: Optional[int] = None):
         self.model = model
         self.loss_name = loss
+        if loss not in ("ce", "bce", "focal"):
+            raise ValueError(f"unknown loss {loss!r}")
         self.tx = make_optimizer(optimizer, lr, momentum, weight_decay)
         self.prox_mu = prox_mu
         self.has_time_axis = has_time_axis
@@ -129,6 +190,7 @@ class ClientTrainer:
         # train-step loss (data/augment.py); eval paths never see it
         self.augment = augment
         self.eval_ignore_id = eval_ignore_id
+        self.train_ignore_id = train_ignore_id
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array, sample_input: jax.Array) -> Pytree:
@@ -174,12 +236,19 @@ class ClientTrainer:
             new_rest = self._cast_floats(new_rest, jnp.float32)
         if self.has_time_axis and mask.ndim < y.ndim:
             mask = broadcast_mask(mask, y)
+        if self.train_ignore_id is not None:
+            valid = y != self.train_ignore_id
+            mask = mask * valid.astype(mask.dtype)
+            # void ids may be out of the class range (255): remap to 0 so
+            # the gather inside CE stays in-bounds (0*NaN would poison the
+            # masked sum otherwise)
+            y = jnp.where(valid, y, 0)
         if self.loss_name == "ce":
             loss = masked_cross_entropy(logits, y, mask)
         elif self.loss_name == "bce":
             loss = masked_bce(logits, y, mask)
         else:
-            raise ValueError(self.loss_name)
+            loss = masked_focal_loss(logits, y, mask)
         if self.prox_mu > 0.0 and global_params is not None:
             sq = jax.tree.map(lambda a, b: jnp.sum(jnp.square(a - b)),
                               params, global_params)
@@ -248,8 +317,15 @@ class ClientTrainer:
             mask = broadcast_mask(mask, y)
         if self.eval_ignore_id is not None:
             mask = mask * (y != self.eval_ignore_id).astype(mask.dtype)
-        if self.loss_name == "ce":
+        if self.train_ignore_id is not None:   # void label: never scored
+            valid = y != self.train_ignore_id
+            mask = mask * valid.astype(mask.dtype)
+            y = jnp.where(valid, y, 0)         # keep the CE gather in-bounds
+        if self.loss_name in ("ce", "focal"):
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            if self.loss_name == "focal":
+                # eval with the train criterion, like the reference
+                ce = focal_from_ce(ce)
             loss_sum = jnp.sum(ce * mask)
             correct, count = masked_accuracy_sums(logits, y, mask)
         else:

@@ -53,6 +53,8 @@ SPECS = {
     "gld160k": DatasetSpec(1262, 2028, 32),
     "susy": DatasetSpec(30, 2, 32),
     "room_occupancy": DatasetSpec(30, 2, 32),
+    # segmentation (fedseg; 21 = VOC classes incl. background, void=255)
+    "pascal_voc": DatasetSpec(4, 21, 8),
 }
 
 # feature dims for the tabular/streaming UCI tasks (reference
@@ -432,6 +434,32 @@ def load_data(dataset: str,
         x_tr, xt = (x_tr - mu) / sd, (xt - mu) / sd
         idx_map = _partition(y_tr, C, "homo", partition_alpha, seed)
         return _make(x_tr, y_tr, xt, yt, idx_map, bs, 2,
+                     max_batches_per_client, None, seed, synthetic=synth)
+
+    if dataset == "pascal_voc":
+        # fedseg's segmentation data: VOC-layout folders when present,
+        # synthetic threshold-mask task otherwise.  Labels are [H, W] int
+        # maps with void=255 (the trainer's train_ignore_id).  The
+        # fallback triggers ONLY on a missing SegmentationClass dir; a
+        # present-but-broken dataset (e.g. a label png without its jpg)
+        # raises instead of silently training on synthetic data.
+        if os.path.isdir(os.path.join(data_dir or "", "SegmentationClass")):
+            x, y = readers.read_voc_pairs(data_dir)
+            synth = False
+        else:
+            x, y = synthetic.synthetic_segmentation(
+                sc(512), (32, 32), spec.class_num, seed=seed)
+            synth = True
+        n_te = max(C, len(y) // 8)
+        x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
+        # partition on the images' DOMINANT class (LDA needs one label
+        # per sample; reference fedseg partitions image lists the same way)
+        dom = np.array([np.bincount(
+            m[m != 255].ravel(), minlength=spec.class_num).argmax()
+            if (m != 255).any() else 0 for m in y_tr])
+        idx_map = _partition(dom, C, partition_method, partition_alpha,
+                             seed, data_dir)
+        return _make(x_tr, y_tr, xt, yt, idx_map, bs, spec.class_num,
                      max_batches_per_client, None, seed, synthetic=synth)
 
     if dataset.startswith("synthetic_"):

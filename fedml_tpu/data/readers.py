@@ -120,6 +120,37 @@ def read_image_folder(data_dir: str, splits=("train", "test"),
     return tuple(out)
 
 
+def read_voc_pairs(data_dir: str, hw: int = 32,
+                   max_images: Optional[int] = None):
+    """Pascal-VOC-layout segmentation pairs: JPEGImages/<id>.jpg +
+    SegmentationClass/<id>.png (palette PNG whose pixel VALUES are class
+    ids, 255 = void).  Returns (x [N,hw,hw,3] f32, y [N,hw,hw] i64) with
+    nearest-neighbor label resize (never interpolate class ids)."""
+    img_dir = os.path.join(data_dir, "JPEGImages")
+    lbl_dir = os.path.join(data_dir, "SegmentationClass")
+    if not os.path.isdir(lbl_dir):
+        raise FileNotFoundError(lbl_dir)
+    from PIL import Image
+    ids = sorted(os.path.splitext(f)[0] for f in os.listdir(lbl_dir)
+                 if f.endswith(".png"))
+    if not ids:
+        raise FileNotFoundError(f"no label pngs in {lbl_dir}")
+    if max_images:
+        ids = ids[:max_images]
+    xs, ys = [], []
+    for i in ids:
+        jpg = os.path.join(img_dir, i + ".jpg")
+        if not os.path.isfile(jpg):
+            jpg = os.path.join(img_dir, i + ".png")   # tolerate png images
+        with Image.open(jpg) as im:
+            im = im.convert("RGB").resize((hw, hw), Image.BILINEAR)
+            xs.append(np.asarray(im, np.float32) / 255.0)
+        with Image.open(os.path.join(lbl_dir, i + ".png")) as lm:
+            lm = lm.resize((hw, hw), Image.NEAREST)
+            ys.append(np.asarray(lm, np.int64))
+    return np.stack(xs), np.stack(ys)
+
+
 def read_landmarks_csv(data_dir: str, split_csv: str, image_dir: str = "images",
                        hw: int = 64):
     """Google Landmarks federated CSV split (reference

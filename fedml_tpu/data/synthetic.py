@@ -53,6 +53,23 @@ def synthetic_classification_images(n: int, hw: tuple[int, int], channels: int,
     return x.astype(np.float32), y
 
 
+def synthetic_segmentation(n: int, hw: tuple[int, int], n_classes: int,
+                           seed: int = 0, void_frac: float = 0.02,
+                           void_id: int = 255):
+    """Learnable synthetic segmentation task (pascal_voc stand-in): each
+    pixel's class is a deterministic function of local color thresholds,
+    with a sprinkle of void (ignore-index 255) pixels like real VOC
+    boundary bands."""
+    rng = np.random.RandomState(seed)
+    h, w = hw
+    x = rng.rand(n, h, w, 3).astype(np.float32)
+    # class = number of channels above 0.5, capped — smooth, learnable
+    y = np.minimum((x > 0.5).sum(axis=-1), n_classes - 1).astype(np.int64)
+    void = rng.rand(n, h, w) < void_frac
+    y[void] = void_id
+    return x, y
+
+
 def synthetic_sequences(n: int, seq_len: int, vocab: int, seed: int = 0):
     """Markov-chain token sequences for LM tasks (shakespeare/stackoverflow
     stand-in): x = seq[:-1], y = seq[1:]."""

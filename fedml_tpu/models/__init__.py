@@ -51,4 +51,7 @@ def create_model(model_name: str, output_dim: int, input_dim: int | None = None,
         return VGG11(num_classes=output_dim, **kw)
     if name in ("vgg16",):
         return VGG16(num_classes=output_dim, **kw)
+    if name == "segnet":
+        from fedml_tpu.models.segnet import SegEncoderDecoder
+        return SegEncoderDecoder(num_classes=output_dim, **kw)
     raise ValueError(f"unknown model {model_name!r}")

@@ -31,6 +31,14 @@ class FedConfig:
     lr: float = 0.03
     momentum: float = 0.0
     wd: float = 0.0
+    # per-local-round LR schedule (reference fedseg LR_Scheduler parity):
+    # None | "poly" | "cos" | "step"; step decays 0.1x every lr_step epochs
+    lr_scheduler: Optional[str] = None
+    lr_step: int = 0
+    warmup_epochs: int = 0
+    # loss override (None = dataset-derived) and segmentation void label
+    loss_type: Optional[str] = None
+    train_ignore_id: Optional[int] = None
     # server optimizer (FedOpt)
     server_optimizer: str = "sgd"
     server_lr: float = 1.0

@@ -68,6 +68,8 @@ _ALGO_FLAGS = {
     "fedgan": ["--dataset", "mnist"],
     "fedgkt": ["--dataset", "cifar10"],
     "splitnn": ["--dataset", "mnist"],
+    "fedseg": ["--dataset", "pascal_voc", "--loss_type", "focal",
+               "--lr_scheduler", "poly"],
     "turboaggregate": ["--dataset", "mnist", "--model", "lr"],
     "centralized": ["--dataset", "mnist", "--model", "lr"],
     "vfl": ["--dataset", "lending_club"],

@@ -437,3 +437,49 @@ def test_tabular_csv_loader(tmp_path):
     # standardized with train stats
     assert abs(float(data.client_shards["x"][data.client_shards["mask"] > 0]
                      .mean())) < 1.0
+
+
+def test_voc_segmentation_reader(tmp_path):
+    """Pascal-VOC folder layout: JPEGImages/*.jpg + SegmentationClass/*.png
+    palette labels (255 = void), nearest-resized."""
+    from PIL import Image
+    os.makedirs(str(tmp_path / "JPEGImages"))
+    os.makedirs(str(tmp_path / "SegmentationClass"))
+    rng = np.random.RandomState(0)
+    for i in range(3):
+        Image.fromarray(rng.randint(0, 255, (48, 64, 3), np.uint8)).save(
+            str(tmp_path / "JPEGImages" / f"img{i}.jpg"))
+        lab = rng.randint(0, 21, (48, 64)).astype(np.uint8)
+        lab[:2] = 255                                  # void boundary band
+        Image.fromarray(lab, mode="L").save(
+            str(tmp_path / "SegmentationClass" / f"img{i}.png"))
+    x, y = readers.read_voc_pairs(str(tmp_path), hw=32)
+    assert x.shape == (3, 32, 32, 3) and 0.0 <= x.min() and x.max() <= 1.0
+    assert y.shape == (3, 32, 32) and y.dtype == np.int64
+    assert (y == 255).any()                            # void preserved
+    assert set(np.unique(y)) <= set(range(21)) | {255} # NEAREST: no blends
+
+
+def test_pascal_voc_loader_real_and_synthetic(tmp_path):
+    from PIL import Image
+    # synthetic fallback
+    d = load_data("pascal_voc", client_num_in_total=4, batch_size=4,
+                  synthetic_scale=0.1)
+    assert d.synthetic and d.class_num == 21
+    assert d.client_shards["y"].ndim == 5              # [C, B, bs, H, W]
+    assert (d.client_shards["y"] == 255).any()         # void in the task
+    # real path
+    os.makedirs(str(tmp_path / "JPEGImages"))
+    os.makedirs(str(tmp_path / "SegmentationClass"))
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        Image.fromarray(rng.randint(0, 255, (32, 32, 3), np.uint8)).save(
+            str(tmp_path / "JPEGImages" / f"i{i}.jpg"))
+        Image.fromarray(rng.randint(0, 21, (32, 32)).astype(np.uint8),
+                        mode="L").save(
+            str(tmp_path / "SegmentationClass" / f"i{i}.png"))
+    d = load_data("pascal_voc", data_dir=str(tmp_path),
+                  client_num_in_total=2, batch_size=2,
+                  partition_method="homo")
+    assert not d.synthetic
+    assert d.client_shards["x"].shape[0] == 2
