@@ -20,6 +20,15 @@ from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.pytree import tree_sub
 
 
+def fednova_tau(shard, epochs):
+    """tau_i = local optimization steps that saw real data: non-empty
+    batches x epochs (the reference's step counter,
+    fednova.py local_normalizing_vec)."""
+    nonempty = jnp.sum((jnp.sum(shard["mask"], axis=1) > 0)
+                       .astype(jnp.float32))
+    return nonempty * epochs
+
+
 class FedNovaEngine(FedAvgEngine):
     def _round(self, variables, server_state, cohort, rng):
         K = cohort["mask"].shape[0]
@@ -29,11 +38,7 @@ class FedNovaEngine(FedAvgEngine):
         def one_client(shard, crng):
             new_vars, loss, n = self.trainer.local_train(
                 variables, shard, crng, self.cfg.epochs)
-            # tau_i = local optimization steps that saw real data
-            nonempty = jnp.sum((jnp.sum(shard["mask"], axis=1) > 0)
-                               .astype(jnp.float32))
-            tau = nonempty * self.cfg.epochs
-            return new_vars, loss, n, tau
+            return new_vars, loss, n, fednova_tau(shard, self.cfg.epochs)
 
         stacked_vars, losses, ns, taus = jax.vmap(one_client)(cohort, client_rngs)
         p = ns / jnp.sum(ns)
@@ -50,8 +55,11 @@ class FedNovaEngine(FedAvgEngine):
 
         new_params = jax.tree.map(nova_avg, variables["params"],
                                   stacked_vars["params"])
-        new_vars = {k: jax.tree.map(lambda s: jnp.mean(s, axis=0), v)
-                    for k, v in stacked_vars.items() if k != "params"}
+        # stats collections: SAMPLE-weighted mean (zero-weight padded
+        # lanes contribute nothing — a plain mean would count them)
+        new_vars = {k: jax.tree.map(
+            lambda s: jnp.einsum("k,k...->...", p.astype(s.dtype), s), v)
+            for k, v in stacked_vars.items() if k != "params"}
         new_vars["params"] = new_params
         train_loss = jnp.sum(losses * ns) / jnp.sum(ns)
         return new_vars, server_state, {"train_loss": train_loss}

@@ -214,8 +214,8 @@ def build_engine(args, cfg: FedConfig, data):
         mesh = make_mesh()
 
     if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
-                                         "fedavg_robust", "hierarchical",
-                                         "decentralized"):
+                                         "fednova", "fedavg_robust",
+                                         "hierarchical", "decentralized"):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
@@ -234,14 +234,16 @@ def build_engine(args, cfg: FedConfig, data):
                 "--streaming/--cohort_chunk/--local_dtype are ignored)",
                 args.defense)
         elif mesh is not None and algo in ("fedavg", "fedopt", "fedprox",
-                                           "fedavg_robust"):
+                                           "fednova", "fedavg_robust"):
             import jax.numpy as jnp
             from fedml_tpu.parallel import (MeshFedAvgEngine,
+                                            MeshFedNovaEngine,
                                             MeshFedOptEngine,
                                             MeshFedProxEngine,
                                             MeshRobustEngine)
             cls = {"fedavg": MeshFedAvgEngine, "fedopt": MeshFedOptEngine,
                    "fedprox": MeshFedProxEngine,
+                   "fednova": MeshFedNovaEngine,
                    "fedavg_robust": MeshRobustEngine}[algo]
             return cls(trainer, data, cfg, mesh=mesh,
                        streaming=args.streaming, chunk=args.cohort_chunk,

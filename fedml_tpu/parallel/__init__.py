@@ -18,8 +18,9 @@ decentralized gossip is `lax.ppermute` neighbor exchange over a mesh ring.
 """
 from fedml_tpu.parallel.mesh import (make_mesh, client_sharding,
                                      replicated_sharding, shard_cohort)
-from fedml_tpu.parallel.engine import (MeshFedAvgEngine, MeshFedOptEngine,
-                                       MeshFedProxEngine, MeshRobustEngine)
+from fedml_tpu.parallel.engine import (MeshFedAvgEngine, MeshFedNovaEngine,
+                                       MeshFedOptEngine, MeshFedProxEngine,
+                                       MeshRobustEngine)
 from fedml_tpu.parallel.hierarchical import MeshHierarchicalEngine
 from fedml_tpu.parallel.gossip import MeshGossipEngine
 from fedml_tpu.parallel.multihost import (init_multihost, make_global_mesh,

@@ -39,6 +39,40 @@ log = logging.getLogger(__name__)
 Pytree = Any
 
 
+def cast_local(tree, dtype):
+    """Cast the float leaves of a variables tree to the LOCAL training
+    dtype (bf16 local masters — see MeshFedAvgEngine docstring); None is
+    the identity."""
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
+def pad_and_chunk(cohort, weights, rngs, chunk_cap: int):
+    """Balanced chunk sizing shared by every chunked cohort loop: same
+    number of scan trips as ceil(k/cap) but lanes spread evenly (k=12,
+    cap=8 gives 2x6 not 2x8); non-multiple cohorts are padded in-program
+    with zero-weight lanes (static shapes; the empty-batch guard makes
+    them numeric no-ops).  Returns (cohort, weights, rngs) reshaped to
+    [n_chunks, chunk, ...]."""
+    k_local = weights.shape[0]
+    n_trips = -(-k_local // min(chunk_cap, k_local))
+    chunk = -(-k_local // n_trips)
+    pad = (-k_local) % chunk
+    if pad:
+        cohort = jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]), cohort)
+        weights = jnp.concatenate(
+            [weights, jnp.zeros((pad,), weights.dtype)])
+        rngs = jnp.concatenate([rngs, rngs[:pad]])   # masked lanes; any key
+    n_chunks = (k_local + pad) // chunk
+    resh = lambda a: a.reshape((n_chunks, chunk) + a.shape[1:])
+    return jax.tree.map(resh, cohort), resh(weights), resh(rngs)
+
+
 def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
                            epochs, vary_axes, chunk_cap: int = 8,
                            client_transform=None):
@@ -52,26 +86,11 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
     (num_tree_f32, den, loss_sum) — the caller applies its own psum tier(s).
 
     A cohort whose size is not a chunk multiple is padded IN-PROGRAM with
-    zero-weight lanes (static shapes; the empty-batch guard makes them
-    numeric no-ops), so chunk stays at the cap instead of degenerating to
-    small divisors for awkward (e.g. prime) cohort sizes.
+    zero-weight lanes (pad_and_chunk), so chunk stays at the cap instead
+    of degenerating to small divisors for awkward (e.g. prime) cohort
+    sizes.
     """
-    k_local = weights.shape[0]
-    # balanced sizing: same number of scan trips as ceil(k/cap), but the
-    # lanes are spread evenly so padding (wasted full client trainings on
-    # zero-weight lanes) is minimal — k=12, cap=8 gives 2x6 not 2x8
-    n_trips = -(-k_local // min(chunk_cap, k_local))
-    chunk = -(-k_local // n_trips)
-    pad = (-k_local) % chunk
-    if pad:
-        cohort = jax.tree.map(
-            lambda a: jnp.concatenate(
-                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]), cohort)
-        weights = jnp.concatenate(
-            [weights, jnp.zeros((pad,), weights.dtype)])
-        rngs = jnp.concatenate([rngs, rngs[:pad]])   # masked lanes; any key
-    n_chunks = (k_local + pad) // chunk
-    resh = lambda a: a.reshape((n_chunks, chunk) + a.shape[1:])
+    cohort, weights, rngs = pad_and_chunk(cohort, weights, rngs, chunk_cap)
     global_params = variables["params"] if trainer.prox_mu > 0 else None
 
     def one(shard, crng):
@@ -95,8 +114,7 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
         lambda a: jnp.zeros(a.shape, jnp.float32), variables), vary_axes)
     zf = pvary_tree(jnp.float32(0), vary_axes)
     (num, den, lsum), _ = jax.lax.scan(
-        chunk_body, (zeros, zf, zf),
-        (jax.tree.map(resh, cohort), resh(weights), resh(rngs)))
+        chunk_body, (zeros, zf, zf), (cohort, weights, rngs))
     return num, den, lsum
 
 
@@ -187,11 +205,7 @@ class MeshFedAvgEngine(FedAvgEngine):
         # the global model arrives replicated; per-client training makes
         # it shard-varying, so cast up-front for the vma type system
         variables = pvary_tree(variables, axes)
-        local_vars = variables
-        if self.local_dtype is not None:
-            local_vars = jax.tree.map(
-                lambda a: a.astype(self.local_dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, variables)
+        local_vars = cast_local(variables, self.local_dtype)
         num, den, lsum = chunked_weighted_train(
             self.trainer, local_vars, cohort, weights, client_rngs,
             self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk or 8,
@@ -335,6 +349,77 @@ class MeshFedOptEngine(MeshFedAvgEngine):
         new_vars = dict(avg_variables)   # stats collections take the average
         new_vars["params"] = new_params
         return new_vars, server_state
+
+
+class MeshFedNovaEngine(MeshFedAvgEngine):
+    """FedNova on the mesh — normalized averaging (algorithms/fednova.py,
+    reference fednova.py:50-200): d = Σᵢ pᵢ(g−wᵢ)/τᵢ, w_new = g − τ_eff·d
+    with τ_eff = Σᵢ pᵢτᵢ.  All three reductions are linear, so the whole
+    aggregation stays two psum tiers like FedAvg; the only extra device
+    state is one weighted τ accumulator in the chunk-scan carry."""
+
+    def _shard_body(self, variables, cohort, weights, client_rngs):
+        axes = self.mesh.axis_names
+        rep_vars = variables              # replicated: the output's basis
+        variables = pvary_tree(variables, axes)
+        local_vars = cast_local(variables, self.local_dtype)
+        epochs = self.cfg.epochs
+        trainer = self.trainer
+        ch_cohort, ch_w, ch_r = pad_and_chunk(
+            cohort, weights, client_rngs, self.chunk or 8)
+
+        from fedml_tpu.algorithms.fednova import fednova_tau
+
+        def one(shard, crng):
+            v, loss, _n = trainer.local_train(local_vars, shard, crng,
+                                              epochs)
+            return v, loss, fednova_tau(shard, epochs)
+
+        def split(v):
+            return v["params"], {k: x for k, x in v.items() if k != "params"}
+
+        g_params, _ = split(local_vars)
+
+        def chunk_body(carry, xs):
+            dsum, rest_num, den, tsum, lsum = carry
+            cs, cw, cr = xs
+            vs, losses, taus = jax.vmap(one)(cs, cr)
+            v_params, v_rest = split(vs)
+            # params: Σ w·(g − v)/τ  (zero-weight pad lanes contribute 0)
+            coef = cw / jnp.maximum(taus, 1.0)
+            dsum = jax.tree.map(
+                lambda acc, g, v: acc + jnp.einsum(
+                    "k,k...->...", coef,
+                    g[None].astype(jnp.float32) - v.astype(jnp.float32)),
+                dsum, g_params, v_params)
+            # stats collections: plain weighted mean, like FedAvg
+            rest_num = jax.tree.map(
+                lambda acc, v: acc + jnp.einsum(
+                    "k,k...->...", cw, v.astype(jnp.float32)),
+                rest_num, v_rest)
+            return (dsum, rest_num, den + jnp.sum(cw),
+                    tsum + jnp.sum(cw * taus),
+                    lsum + jnp.sum(losses * cw)), None
+
+        zp, zr = split(jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), variables))
+        zp, zr = pvary_tree(zp, axes), pvary_tree(zr, axes)
+        zf = pvary_tree(jnp.float32(0), axes)
+        (dsum, rest_num, den, tsum, lsum), _ = jax.lax.scan(
+            chunk_body, (zp, zr, zf, zf, zf), (ch_cohort, ch_w, ch_r))
+        dsum = jax.lax.psum(dsum, axes)
+        rest_num = jax.lax.psum(rest_num, axes)
+        den = jax.lax.psum(den, axes)
+        tau_eff = jax.lax.psum(tsum, axes) / den
+        gp, grest = split(rep_vars)
+        new_params = jax.tree.map(
+            lambda g, d: (g.astype(jnp.float32)
+                          - tau_eff * d / den).astype(g.dtype), gp, dsum)
+        new = {"params": new_params,
+               **jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype),
+                              rest_num, grest)}
+        loss = jax.lax.psum(lsum, axes) / den
+        return new, loss
 
 
 class MeshRobustEngine(MeshFedAvgEngine):

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.trainer import ClientTrainer
 from fedml_tpu.data.federated import FederatedData
-from fedml_tpu.parallel.engine import chunked_weighted_train
+from fedml_tpu.parallel.engine import cast_local, chunked_weighted_train
 from fedml_tpu.parallel.mesh import (CLIENT_AXIS, SILO_AXIS, make_mesh_2d,
                                      pvary_tree)
 from fedml_tpu.utils.config import FedConfig
@@ -137,14 +137,9 @@ class MeshHierarchicalEngine(FedAvgEngine):
                 crngs = jax.random.split(rng_g, idx.shape[0])
                 # per-client training varies over the client axis too
                 vars_g = pvary_tree(vars_g, CLIENT_AXIS)
-                local_vars = vars_g
-                if self.local_dtype is not None:
-                    # bf16 local masters: silo/global masters stay f32,
-                    # only the per-client step chain runs reduced
-                    local_vars = jax.tree.map(
-                        lambda a: a.astype(self.local_dtype)
-                        if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                        vars_g)
+                # bf16 local masters: silo/global masters stay f32, only
+                # the per-client step chain runs reduced (engine.py)
+                local_vars = cast_local(vars_g, self.local_dtype)
                 # chunked inner loop (same HBM-bounding scan as the flat
                 # engine, parallel/engine.py::chunked_weighted_train)
                 num, den, lsum = chunked_weighted_train(

@@ -96,6 +96,12 @@ def test_cli_streaming_mesh(tmp_path):
     assert s
 
 
+def test_cli_fednova_mesh(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "fednova", "--dataset", "mnist",
+                "--model", "lr", "--mesh")
+    assert s
+
+
 def test_cli_augment_flag(tmp_path):
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "cifar10",
                 "--model", "cnn", "--augment")

@@ -202,6 +202,92 @@ def test_chunk_size_invariance():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_mesh_fednova_matches_single_device():
+    """MeshFedNovaEngine's psum'd normalized averaging must reproduce the
+    single-device FedNovaEngine (same d = Σ p(g−w)/τ, w_new = g − τ_eff·d)."""
+    from fedml_tpu.algorithms import FedNovaEngine
+    from fedml_tpu.parallel import MeshFedNovaEngine
+    cfg = _mnist_like_cfg(comm_round=3, epochs=2)
+    trainer, data = _setup(cfg)
+    ref = FedNovaEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    eng = MeshFedNovaEngine(trainer, data, cfg, mesh=make_mesh(8),
+                            donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_fednova_partial_participation():
+    """Ragged cohorts: padded zero-weight lanes contribute nothing to d,
+    τ_eff or the loss."""
+    from fedml_tpu.algorithms import FedNovaEngine
+    from fedml_tpu.parallel import MeshFedNovaEngine
+    cfg = _mnist_like_cfg(client_num_per_round=10, comm_round=2)
+    trainer, data = _setup(cfg)
+    ref = FedNovaEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedNovaEngine(trainer, data, cfg, mesh=make_mesh(8),
+                            donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_fednova_matches_single_device_with_stats():
+    """Same oracle but with a BatchNorm model: the stats collections take
+    the SAMPLE-weighted mean on both paths (a plain mean would also count
+    zero-weight padded lanes)."""
+    import flax.linen as nn
+    from fedml_tpu.algorithms import FedNovaEngine
+    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.parallel import MeshFedNovaEngine
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(4, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x).mean(axis=(1, 2))
+            return nn.Dense(4)(x)
+
+    rs = np.random.RandomState(0)
+    C, hw = 6, 8
+    sizes = [8, 12, 4, 8, 8, 12]          # heterogeneous client sizes
+    n = sum(sizes)
+    x = rs.rand(n, hw, hw, 3).astype(np.float32)
+    y = rs.randint(0, 4, n).astype(np.int64)
+    off, idx = 0, {}
+    for i, s in enumerate(sizes):
+        idx[i] = np.arange(off, off + s); off += s
+    data = FederatedData(
+        train_data_num=n, test_data_num=n,
+        train_global=build_eval_shard(x, y, 4),
+        test_global=build_eval_shard(x, y, 4),
+        client_shards=build_client_shards(x, y, idx, 4),
+        client_num_samples=np.asarray(sizes, np.float32),
+        test_client_shards=None, class_num=4, synthetic=True)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=5,
+                    comm_round=2, epochs=1, batch_size=4, lr=0.05,
+                    frequency_of_the_test=100)
+    trainer = ClientTrainer(TinyBN(), lr=cfg.lr)
+    ref = FedNovaEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedNovaEngine(trainer, data, cfg, mesh=make_mesh(8),
+                            donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    assert "batch_stats" in v_ref
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_local_dtype_bf16_close_to_f32():
     """bf16 local masters (the bench's measured v5e win, PERF.md): globals
     stay f32, results stay close to the f32 local path, and the model still
