@@ -51,6 +51,7 @@ class FedAvgEngine:
         # tree-mean is already fused well — the kernel wins when the whole
         # stack is flattened anyway (robust pipeline) or on very many leaves
         self.pallas_agg = pallas_agg
+        self.donate = donate
         self.sampler = ClientSampler.for_data(data, cfg)
         # donate BOTH the variables and the server state (FedOpt's adam
         # moments are 2x params — donating avoids an HBM copy per round)

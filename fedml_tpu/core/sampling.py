@@ -53,7 +53,11 @@ class ClientSampler:
         """Traceable variant for fully-jitted round loops: derives a fold-in
         key from the round index and takes the first k of a permutation.
         (Not bit-identical to numpy — use `sample` when oracle comparability
-        with the reference matters.)"""
+        with the reference matters.)  Full participation returns arange,
+        mirroring `sample` — so client→rng-lane pairing matches the Python
+        loop exactly in that regime (the run_scanned equivalence)."""
+        if self.client_num_per_round >= self.client_num_in_total:
+            return jnp.arange(self.client_num_in_total, dtype=jnp.int32)
         key = jax.random.fold_in(jax.random.PRNGKey(0), round_idx)
         perm = jax.random.permutation(key, self.client_num_in_total)
         return perm[: self.client_num_per_round]

@@ -102,6 +102,12 @@ def test_cli_fednova_mesh(tmp_path):
     assert s
 
 
+def test_cli_scan_block(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--mesh", "--scan_block", "2")
+    assert "test_acc" in s
+
+
 def test_cli_augment_flag(tmp_path):
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "cifar10",
                 "--model", "cnn", "--augment")
