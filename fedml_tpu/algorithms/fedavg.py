@@ -63,6 +63,7 @@ class FedAvgEngine:
             "train": jax.tree.map(jnp.asarray, data.train_global),
             "test": jax.tree.map(jnp.asarray, data.test_global),
         }
+        self._local_eval_fn = None    # built lazily by evaluate_local
         self.metrics_history: list[dict] = []
 
     # ---- server state (FedOpt's persistent optimizer etc.) ----------------
@@ -172,4 +173,30 @@ class FedAvgEngine:
             cnt = float(sums["count"])
             out[f"{split}_acc"] = float(sums["correct"]) / max(cnt, 1.0)
             out[f"{split}_loss"] = float(sums["loss_sum"]) / max(cnt, 1.0)
+        if self.data.test_client_shards is not None:
+            out.update(self.evaluate_local(variables))
         return out
+
+    def evaluate_local(self, variables: Pytree) -> dict:
+        """Eval on every client's OWN test shard — the reference's
+        _local_test_on_all_clients (fedavg_api.py:117-213): per-client
+        correct/total sums aggregated into one weighted accuracy.  With
+        cfg.ci the eval truncates to the first client (the reference's
+        --ci 1 CPU-CI mode, fedavg_api.py:157-162)."""
+        if self.data.test_client_shards is None:
+            raise ValueError("this dataset has no per-client test shards")
+        if self._local_eval_fn is None:
+            self._local_eval_fn = jax.jit(jax.vmap(
+                self.trainer.evaluate, in_axes=(None, 0)))
+            # upload once (ci-truncated if set), like _eval_shards
+            shards = self.data.test_client_shards
+            if self.cfg.ci:
+                shards = jax.tree.map(lambda a: a[:1], shards)
+            self._local_eval_shards = jax.tree.map(jnp.asarray, shards)
+        sums = self._local_eval_fn(variables, self._local_eval_shards)
+        cnt = float(jnp.sum(sums["count"]))
+        return {
+            "local_test_acc": float(jnp.sum(sums["correct"])) / max(cnt, 1.0),
+            "local_test_loss":
+                float(jnp.sum(sums["loss_sum"])) / max(cnt, 1.0),
+        }

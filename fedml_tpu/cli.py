@@ -477,7 +477,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     engine_logs = "logger" in run_params
 
     def _run():
-        if args.scan_block:
+        if args.scan_block is not None:
+            if args.scan_block < 1:
+                raise SystemExit("--scan_block must be >= 1")
             if (not hasattr(eng, "run_scanned")
                     or getattr(eng, "streaming", False)):
                 raise SystemExit(

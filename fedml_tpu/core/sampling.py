@@ -40,7 +40,10 @@ class ClientSampler:
         return cls(n_total, cfg.client_num_per_round)
 
     def sample(self, round_idx: int) -> np.ndarray:
-        if self.client_num_in_total == self.client_num_per_round:
+        # >= (not ==): per_round beyond the population is full
+        # participation too, and must agree with sample_jax's branch so
+        # cohort ordering (and thus rng-lane pairing) matches
+        if self.client_num_per_round >= self.client_num_in_total:
             return np.arange(self.client_num_in_total, dtype=np.int64)
         num = min(self.client_num_per_round, self.client_num_in_total)
         np.random.seed(round_idx)  # deterministic, matches reference

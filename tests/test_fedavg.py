@@ -146,3 +146,51 @@ class TestFedAvgLearning:
             runs.append(e.run())
         for a, b in zip(jax.tree.leaves(runs[0]), jax.tree.leaves(runs[1])):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_local_eval_on_per_client_test_shards():
+    """The reference's _local_test_on_all_clients (fedavg_api.py:117-213):
+    weighted accuracy over every client's OWN test shard, with --ci
+    truncating to one client."""
+    import numpy as np
+    from fedml_tpu.algorithms import FedAvgEngine
+    from fedml_tpu.core import ClientTrainer
+    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.models import create_model
+    from fedml_tpu.utils.config import FedConfig
+
+    rs = np.random.RandomState(0)
+    C, per = 3, 8
+    n = C * per
+    x = rs.rand(n, 6).astype(np.float32)
+    y = (x.sum(-1) > 3).astype(np.int64)
+    idx = {i: np.arange(i * per, (i + 1) * per) for i in range(C)}
+    shards = build_client_shards(x, y, idx, 4)
+    data = FederatedData(
+        train_data_num=n, test_data_num=n,
+        train_global=build_eval_shard(x, y, 4),
+        test_global=build_eval_shard(x, y, 4),
+        client_shards=shards,
+        client_num_samples=np.full(C, per, np.float32),
+        test_client_shards=shards,           # same data as local test sets
+        class_num=2, synthetic=True)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=C,
+                    comm_round=1, batch_size=4, lr=0.1,
+                    frequency_of_the_test=100)
+    eng = FedAvgEngine(ClientTrainer(create_model("lr", 2), lr=0.1),
+                       data, cfg, donate=False)
+    v = eng.init_variables()
+    m = eng.evaluate(v)
+    # local test == global test here (identical underlying samples)
+    assert abs(m["local_test_acc"] - m["test_acc"]) < 1e-6
+    assert "local_test_loss" in m
+    # --ci truncates to client 0 only
+    cfg_ci = FedConfig(**{**cfg.__dict__, "ci": True})
+    eng_ci = FedAvgEngine(ClientTrainer(create_model("lr", 2), lr=0.1),
+                          data, cfg_ci, donate=False)
+    m_ci = eng_ci.evaluate_local(v)
+    one = jax.tree.map(lambda a: a[:1], shards)
+    sums = jax.vmap(eng_ci.trainer.evaluate, in_axes=(None, 0))(v, one)
+    expect = float(jnp.sum(sums["correct"])) / float(jnp.sum(sums["count"]))
+    assert abs(m_ci["local_test_acc"] - expect) < 1e-6
