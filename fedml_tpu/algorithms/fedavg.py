@@ -64,6 +64,7 @@ class FedAvgEngine:
             "test": jax.tree.map(jnp.asarray, data.test_global),
         }
         self._local_eval_fn = None    # built lazily by evaluate_local
+        self._local_eval_shards = {}
         self.metrics_history: list[dict] = []
 
     # ---- server state (FedOpt's persistent optimizer etc.) ----------------
@@ -173,30 +174,50 @@ class FedAvgEngine:
             cnt = float(sums["count"])
             out[f"{split}_acc"] = float(sums["correct"]) / max(cnt, 1.0)
             out[f"{split}_loss"] = float(sums["loss_sum"]) / max(cnt, 1.0)
-        if self.data.test_client_shards is not None:
+        if (self.data.test_client_shards is not None
+                and not getattr(self, "streaming", False)):
+            # streaming exists because the per-client stack does NOT fit
+            # in HBM — never auto-materialize it for eval there
             out.update(self.evaluate_local(variables))
         return out
 
-    def evaluate_local(self, variables: Pytree) -> dict:
-        """Eval on every client's OWN test shard — the reference's
+    def evaluate_local(self, variables: Pytree, split: str = "test") -> dict:
+        """Eval on every client's OWN shard — the reference's
         _local_test_on_all_clients (fedavg_api.py:117-213): per-client
-        correct/total sums aggregated into one weighted accuracy.  With
-        cfg.ci the eval truncates to the first client (the reference's
-        --ci 1 CPU-CI mode, fedavg_api.py:157-162)."""
-        if self.data.test_client_shards is None:
+        correct/total sums aggregated into one weighted accuracy, for the
+        clients' test shards (split="test", needs the dataset's natural
+        per-client test split) or train shards (split="train", always
+        available — the reference's local Train/Acc).  With cfg.ci the
+        eval truncates to the first client (the reference's --ci 1 CPU-CI
+        mode, fedavg_api.py:157-162)."""
+        if split == "test" and self.data.test_client_shards is None:
             raise ValueError("this dataset has no per-client test shards")
+        if getattr(self, "streaming", False):
+            raise ValueError("streaming engines keep the client stack on "
+                             "host; evaluate_local would materialize it "
+                             "in HBM")
         if self._local_eval_fn is None:
             self._local_eval_fn = jax.jit(jax.vmap(
                 self.trainer.evaluate, in_axes=(None, 0)))
-            # upload once (ci-truncated if set), like _eval_shards
-            shards = self.data.test_client_shards
-            if self.cfg.ci:
-                shards = jax.tree.map(lambda a: a[:1], shards)
-            self._local_eval_shards = jax.tree.map(jnp.asarray, shards)
-        sums = self._local_eval_fn(variables, self._local_eval_shards)
+        if split not in self._local_eval_shards:
+            if split == "train" and not self.cfg.ci:
+                # the train stack is already device-cached for cohorts —
+                # reuse it, don't hold a second HBM copy
+                self._local_eval_shards[split] = self.data.device_shards()[0]
+            else:
+                # upload once (ci-truncated if set), like _eval_shards
+                shards = (self.data.test_client_shards if split == "test"
+                          else self.data.client_shards)
+                if self.cfg.ci:
+                    shards = jax.tree.map(lambda a: a[:1], shards)
+                self._local_eval_shards[split] = jax.tree.map(jnp.asarray,
+                                                              shards)
+        sums = self._local_eval_fn(variables,
+                                   self._local_eval_shards[split])
         cnt = float(jnp.sum(sums["count"]))
         return {
-            "local_test_acc": float(jnp.sum(sums["correct"])) / max(cnt, 1.0),
-            "local_test_loss":
+            f"local_{split}_acc":
+                float(jnp.sum(sums["correct"])) / max(cnt, 1.0),
+            f"local_{split}_loss":
                 float(jnp.sum(sums["loss_sum"])) / max(cnt, 1.0),
         }

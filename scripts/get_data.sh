@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Dataset fetcher — the reference ships one download_*.sh per dataset
+# (reference data/<name>/download_*.sh, invoked by CI-install.sh:46-87);
+# here one script with a per-dataset function.  Usage:
+#
+#   scripts/get_data.sh <dataset> [target_dir]
+#
+# Each function leaves the on-disk layout that fedml_tpu's readers expect
+# (fedml_tpu/data/readers.py; pass the target dir as --data_dir).  This
+# image has no network egress — run this wherever you stage data.
+set -euo pipefail
+
+DATASET="${1:?usage: get_data.sh <dataset> [target_dir]}"
+TARGET="${2:-./data/$DATASET}"
+mkdir -p "$TARGET"
+cd "$TARGET"
+
+fetch() { wget -q --show-progress "$@"; }
+
+cifar10() {     # pickles: cifar-10-batches-py/ (readers.read_cifar_pickles)
+  fetch https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz
+  tar xzf cifar-10-python.tar.gz && rm cifar-10-python.tar.gz
+}
+
+cifar100() {    # pickles: cifar-100-python/ with train/test blobs
+  fetch https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz
+  tar xzf cifar-100-python.tar.gz && rm cifar-100-python.tar.gz
+}
+
+cinic10() {     # image folders: train/ test/ (valid/ unused)
+  fetch https://datashare.ed.ac.uk/bitstream/handle/10283/3192/CINIC-10.tar.gz
+  tar xzf CINIC-10.tar.gz && rm CINIC-10.tar.gz
+}
+
+mnist() {       # LEAF JSON: train/all_data*.json test/all_data*.json.
+  # The reference pulls a pre-partitioned 1000-client split from a Google
+  # Drive mirror (data/MNIST/download_and_unzip.sh); regenerate the same
+  # split with the LEAF toolchain when the mirror is gone:
+  #   git clone https://github.com/TalwalkarLab/leaf && cd leaf/data/femnist
+  #   ./preprocess.sh -s niid --sf 1.0 -k 0 -t sample
+  echo "MNIST (LEAF): use the reference's Drive mirror or the LEAF repo" >&2
+  echo "  https://github.com/TalwalkarLab/leaf" >&2
+}
+
+femnist() {     # TFF h5: fed_emnist_train.h5 fed_emnist_test.h5
+  fetch https://storage.googleapis.com/tff-datasets-public/fed_emnist.tar.bz2
+  tar xjf fed_emnist.tar.bz2 && rm fed_emnist.tar.bz2
+}
+
+fed_cifar100() { # TFF h5: fed_cifar100_train.h5 fed_cifar100_test.h5
+  fetch https://storage.googleapis.com/tff-datasets-public/fed_cifar100.tar.bz2
+  tar xjf fed_cifar100.tar.bz2 && rm fed_cifar100.tar.bz2
+}
+
+shakespeare() { # LEAF JSON via the LEAF toolchain (char-level, 90-vocab)
+  echo "shakespeare (LEAF): clone https://github.com/TalwalkarLab/leaf," >&2
+  echo "  leaf/data/shakespeare: ./preprocess.sh -s niid --sf 1.0 -t sample" >&2
+}
+
+fed_shakespeare() { # TFF h5: shakespeare_train.h5 shakespeare_test.h5
+  fetch https://storage.googleapis.com/tff-datasets-public/shakespeare.tar.bz2
+  tar xjf shakespeare.tar.bz2 && rm shakespeare.tar.bz2
+}
+
+stackoverflow() { # TFF h5 + vocab sidecars (nwp and lr share the h5)
+  fetch https://storage.googleapis.com/tff-datasets-public/stackoverflow.tar.bz2
+  fetch https://storage.googleapis.com/tff-datasets-public/stackoverflow.word_count.tar.bz2
+  fetch https://storage.googleapis.com/tff-datasets-public/stackoverflow.tag_count.tar.bz2
+  for f in *.tar.bz2; do tar xjf "$f" && rm "$f"; done
+}
+
+susy() {        # UCI csv (decentralized online learning)
+  fetch https://archive.ics.uci.edu/ml/machine-learning-databases/00279/SUSY.csv.gz
+  gunzip SUSY.csv.gz
+}
+
+room_occupancy() {
+  fetch https://archive.ics.uci.edu/ml/machine-learning-databases/00357/occupancy_data.zip
+  unzip -o occupancy_data.zip && rm occupancy_data.zip
+}
+
+gld23k() {      # Google Landmarks federated split (CSV + images)
+  echo "landmarks: follow https://github.com/google-research/google-research/tree/master/federated_vision_datasets" >&2
+}
+
+pascal_voc() {  # VOCdevkit JPEGImages/ + SegmentationClass/
+  fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2012/VOCtrainval_11-May-2012.tar
+  tar xf VOCtrainval_11-May-2012.tar && rm VOCtrainval_11-May-2012.tar
+  mv VOCdevkit/VOC2012/JPEGImages VOCdevkit/VOC2012/SegmentationClass .
+}
+
+synthetic() {   # synthetic(a,b) ships IN the reference repo as LEAF JSONs;
+                # fedml_tpu also regenerates it from the published process
+  echo "synthetic_(a)_(b): generated on the fly (fedml_tpu/data/synthetic.py);" >&2
+  echo "  --data_dir only needed to reuse the reference's shipped JSONs" >&2
+}
+
+case "$DATASET" in
+  cifar10|cifar100|cinic10|mnist|femnist|fed_cifar100|shakespeare|\
+  fed_shakespeare|stackoverflow|susy|room_occupancy|gld23k|pascal_voc|\
+  synthetic) "$DATASET" ;;
+  *) echo "unknown dataset: $DATASET" >&2; exit 1 ;;
+esac
+echo "done -> $TARGET"

@@ -194,3 +194,29 @@ def test_local_eval_on_per_client_test_shards():
     sums = jax.vmap(eng_ci.trainer.evaluate, in_axes=(None, 0))(v, one)
     expect = float(jnp.sum(sums["correct"])) / float(jnp.sum(sums["count"]))
     assert abs(m_ci["local_test_acc"] - expect) < 1e-6
+
+
+def test_local_train_eval_always_available():
+    """split='train' evaluates on the clients' own TRAIN shards (the
+    reference's local Train/Acc) and needs no natural test split."""
+    import numpy as np
+    from fedml_tpu.algorithms import FedAvgEngine
+    from fedml_tpu.core import ClientTrainer
+    from fedml_tpu.data.loaders import load_data
+    from fedml_tpu.models import create_model
+    from fedml_tpu.utils.config import FedConfig
+
+    data = load_data("mnist", client_num_in_total=4, batch_size=4,
+                     synthetic_scale=0.001, seed=0)
+    assert data.test_client_shards is None       # synthetic: no test split
+    cfg = FedConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=1, batch_size=4, lr=0.1,
+                    frequency_of_the_test=100)
+    eng = FedAvgEngine(ClientTrainer(create_model("lr", 10), lr=0.1),
+                       data, cfg, donate=False)
+    v = eng.init_variables()
+    m = eng.evaluate_local(v, split="train")
+    assert 0.0 <= m["local_train_acc"] <= 1.0
+    assert np.isfinite(m["local_train_loss"])
+    with __import__("pytest").raises(ValueError):
+        eng.evaluate_local(v, split="test")
