@@ -190,6 +190,9 @@ class FedAvgEngine:
         available — the reference's local Train/Acc).  With cfg.ci the
         eval truncates to the first client (the reference's --ci 1 CPU-CI
         mode, fedavg_api.py:157-162)."""
+        if split not in ("train", "test"):
+            raise ValueError(f"split must be 'train' or 'test', got "
+                             f"{split!r}")
         if split == "test" and self.data.test_client_shards is None:
             raise ValueError("this dataset has no per-client test shards")
         if getattr(self, "streaming", False):
@@ -201,9 +204,15 @@ class FedAvgEngine:
                 self.trainer.evaluate, in_axes=(None, 0)))
         if split not in self._local_eval_shards:
             if split == "train" and not self.cfg.ci:
-                # the train stack is already device-cached for cohorts —
-                # reuse it, don't hold a second HBM copy
-                self._local_eval_shards[split] = self.data.device_shards()[0]
+                # a train stack is already device-resident for cohorts —
+                # reuse it rather than holding a second HBM copy: the mesh
+                # engine's padded sharded stack (zero-weight pad lanes
+                # have mask 0, so they add nothing to the sums), else the
+                # plain engine's device_shards cache
+                resident = getattr(self, "_stack", None)
+                self._local_eval_shards[split] = (
+                    resident if resident is not None
+                    else self.data.device_shards()[0])
             else:
                 # upload once (ci-truncated if set), like _eval_shards
                 shards = (self.data.test_client_shards if split == "test"

@@ -34,12 +34,13 @@ cinic10() {     # image folders: train/ test/ (valid/ unused)
 
 mnist() {       # LEAF JSON: train/all_data*.json test/all_data*.json.
   # The reference pulls a pre-partitioned 1000-client split from a Google
-  # Drive mirror (data/MNIST/download_and_unzip.sh); regenerate the same
-  # split with the LEAF toolchain when the mirror is gone:
-  #   git clone https://github.com/TalwalkarLab/leaf && cd leaf/data/femnist
-  #   ./preprocess.sh -s niid --sf 1.0 -k 0 -t sample
-  echo "MNIST (LEAF): use the reference's Drive mirror or the LEAF repo" >&2
-  echo "  https://github.com/TalwalkarLab/leaf" >&2
+  # Drive mirror (data/MNIST/download_and_unzip.sh).  If the mirror is
+  # gone, rebuild an equivalent split from raw MNIST: partition with
+  # fedml_tpu.core.partition.partition_power_law into 1000 clients and
+  # dump {"users", "user_data": {uid: {"x", "y"}}} train/test JSONs
+  # (readers.read_leaf_dir's format).
+  echo "MNIST (LEAF): use the reference's Drive mirror, or rebuild from" >&2
+  echo "  raw MNIST with fedml_tpu.core.partition (see comments)" >&2
 }
 
 femnist() {     # TFF h5: fed_emnist_train.h5 fed_emnist_test.h5

@@ -114,7 +114,19 @@ def test_cli_augment_flag(tmp_path):
     assert s
 
 
-@pytest.mark.parametrize("backend,port", [("TCP", 57500), ("GRPC", 57600)])
+def _native_available():
+    from fedml_tpu.native import load_library
+    try:
+        return load_library() is not None
+    except Exception:
+        return False
+
+
+@pytest.mark.parametrize(
+    "backend,port",
+    [("TCP", 57500), ("GRPC", 57600),
+     pytest.param("NATIVE_TCP", 57700, marks=pytest.mark.skipif(
+         not _native_available(), reason="native transport not buildable"))])
 def test_two_process_deployment(tmp_path, backend, port):
     """A REAL server+client process pair over localhost sockets (the
     reference's run_fedavg_grpc.sh deployment; VERDICT r1 weak #5)."""

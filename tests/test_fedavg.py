@@ -152,13 +152,7 @@ def test_local_eval_on_per_client_test_shards():
     """The reference's _local_test_on_all_clients (fedavg_api.py:117-213):
     weighted accuracy over every client's OWN test shard, with --ci
     truncating to one client."""
-    import numpy as np
-    from fedml_tpu.algorithms import FedAvgEngine
-    from fedml_tpu.core import ClientTrainer
-    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
-                                          build_eval_shard)
     from fedml_tpu.models import create_model
-    from fedml_tpu.utils.config import FedConfig
 
     rs = np.random.RandomState(0)
     C, per = 3, 8
@@ -199,12 +193,7 @@ def test_local_eval_on_per_client_test_shards():
 def test_local_train_eval_always_available():
     """split='train' evaluates on the clients' own TRAIN shards (the
     reference's local Train/Acc) and needs no natural test split."""
-    import numpy as np
-    from fedml_tpu.algorithms import FedAvgEngine
-    from fedml_tpu.core import ClientTrainer
-    from fedml_tpu.data.loaders import load_data
     from fedml_tpu.models import create_model
-    from fedml_tpu.utils.config import FedConfig
 
     data = load_data("mnist", client_num_in_total=4, batch_size=4,
                      synthetic_scale=0.001, seed=0)
@@ -218,5 +207,7 @@ def test_local_train_eval_always_available():
     m = eng.evaluate_local(v, split="train")
     assert 0.0 <= m["local_train_acc"] <= 1.0
     assert np.isfinite(m["local_train_loss"])
-    with __import__("pytest").raises(ValueError):
+    with pytest.raises(ValueError):
         eng.evaluate_local(v, split="test")
+    with pytest.raises(ValueError):
+        eng.evaluate_local(v, split="validation")
