@@ -115,7 +115,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--group_num", type=int, default=2,
                    help="hierarchical: silo count")
     p.add_argument("--group_comm_round", type=int, default=2)
-    p.add_argument("--defense", type=str, default="norm_clip")
+    p.add_argument("--defense", type=str, default="norm_clip",
+                   choices=("norm_clip", "krum", "median", "trimmed_mean"))
+    p.add_argument("--n_byzantine", type=int, default=0,
+                   help="assumed Byzantine count (krum neighbor count, "
+                        "trimmed-mean trim width)")
     p.add_argument("--topology", type=str, default="ring",
                    help="decentralized: ring|ws (Watts-Strogatz)")
     p.add_argument("--unrolled", action="store_true",
@@ -227,19 +231,8 @@ def build_engine(args, cfg: FedConfig, data):
     if algo in ("fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
                 "turboaggregate", "centralized"):
         trainer = _trainer(cfg, data)
-        if (mesh is not None and algo == "fedavg_robust"
-                and args.defense != "norm_clip"):
-            # MeshRobustEngine implements norm_clip only; silently swapping
-            # the requested krum/median/trimmed_mean for clipping would be
-            # a different threat model — fall back like the no-mesh-engine
-            # path does
-            logging.getLogger(__name__).warning(
-                "--mesh robust engine only implements norm_clip; running "
-                "the single-device path for --defense %s (mesh-only flags "
-                "--streaming/--cohort_chunk/--local_dtype are ignored)",
-                args.defense)
-        elif mesh is not None and algo in ("fedavg", "fedopt", "fedprox",
-                                           "fednova", "fedavg_robust"):
+        if mesh is not None and algo in ("fedavg", "fedopt", "fedprox",
+                                         "fednova", "fedavg_robust"):
             import jax.numpy as jnp
             from fedml_tpu.parallel import (MeshFedAvgEngine,
                                             MeshFedNovaEngine,
@@ -250,10 +243,16 @@ def build_engine(args, cfg: FedConfig, data):
                    "fedprox": MeshFedProxEngine,
                    "fednova": MeshFedNovaEngine,
                    "fedavg_robust": MeshRobustEngine}[algo]
+            kw = {}
+            if algo == "fedavg_robust":
+                # all four defenses run on the mesh now (order-statistic
+                # ones via the replicated cohort matrix, MeshRobustEngine)
+                kw = dict(defense=args.defense,
+                          n_byzantine=args.n_byzantine)
             return cls(trainer, data, cfg, mesh=mesh,
                        streaming=args.streaming, chunk=args.cohort_chunk,
                        local_dtype=jnp.bfloat16
-                       if args.local_dtype == "bfloat16" else None)
+                       if args.local_dtype == "bfloat16" else None, **kw)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
             return CentralizedTrainer(trainer, data, cfg)
@@ -264,7 +263,8 @@ def build_engine(args, cfg: FedConfig, data):
             return cls(trainer, data, cfg)
         if algo == "fedavg_robust":
             return A.FedAvgRobustEngine(trainer, data, cfg,
-                                        defense=args.defense)
+                                        defense=args.defense,
+                                        n_byzantine=args.n_byzantine)
         from fedml_tpu.algorithms.turboaggregate import TurboAggregateEngine
         return TurboAggregateEngine(trainer, data, cfg)
 

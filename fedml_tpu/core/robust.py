@@ -39,12 +39,10 @@ def add_weak_dp_noise(params: Pytree, rng: jax.Array, stddev: float) -> Pytree:
     return jax.tree.unflatten(treedef, noised)
 
 
-def krum_select(stacked_params: Pytree, n_byzantine: int) -> jax.Array:
-    """Krum: index of the client whose update has the smallest sum of squared
-    distances to its n-f-2 nearest neighbors.  (An addition beyond the
-    reference's clip+noise, standard in the robust-FL literature.)"""
-    flat = jnp.concatenate(
-        [x.reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked_params)], axis=1)
+def krum_select_flat(flat: jax.Array, n_byzantine: int) -> jax.Array:
+    """Krum on a [K, P] client-update matrix: index of the client whose
+    update has the smallest sum of squared distances to its n-f-2 nearest
+    neighbors."""
     # gram-matrix form: O(K·P + K²) memory, and the K×P matmul runs on the
     # MXU — never materialize the [K,K,P] broadcast.
     sq = jnp.sum(flat * flat, axis=1)
@@ -55,6 +53,14 @@ def krum_select(stacked_params: Pytree, n_byzantine: int) -> jax.Array:
     nearest = jnp.sort(d2, axis=1)[:, :k]
     scores = jnp.sum(nearest, axis=1)
     return jnp.argmin(scores)
+
+
+def krum_select(stacked_params: Pytree, n_byzantine: int) -> jax.Array:
+    """Krum over a stacked pytree.  (An addition beyond the reference's
+    clip+noise, standard in the robust-FL literature.)"""
+    flat = jnp.concatenate(
+        [x.reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked_params)], axis=1)
+    return krum_select_flat(flat, n_byzantine)
 
 
 def coordinate_median(stacked_params: Pytree) -> Pytree:

@@ -76,7 +76,8 @@ def pad_and_chunk(cohort, weights, rngs, chunk_cap: int):
 
 def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
                            epochs, vary_axes, chunk_cap: int = 8,
-                           client_transform=None):
+                           client_transform=None,
+                           emit_flat_params: bool = False):
     """Train a shard-local cohort as a lax.scan over chunks of at most
     `chunk_cap` vmapped clients, accumulating Σ w·v / Σ w / Σ w·loss in the
     carry — the HBM-bounded inner loop shared by the flat and hierarchical
@@ -86,11 +87,17 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
     the caller); the f32 accumulators are pvary'd here to match.  Returns
     (num_tree_f32, den, loss_sum) — the caller applies its own psum tier(s).
 
+    With `emit_flat_params` the scan ALSO emits each client's trained
+    params flattened to an f32 row (ops/aggregate tile padding), returned
+    as a fourth value [n_chunks, chunk, P] — the order-statistic robust
+    defenses consume this (any chunk-pad lanes sit at the flattened tail).
+
     A cohort whose size is not a chunk multiple is padded IN-PROGRAM with
     zero-weight lanes (pad_and_chunk), so chunk stays at the cap instead
     of degenerating to small divisors for awkward (e.g. prime) cohort
     sizes.
     """
+    from fedml_tpu.ops.aggregate import flatten_stacked_tree
     cohort, weights, rngs = pad_and_chunk(cohort, weights, rngs, chunk_cap)
     global_params = variables["params"] if trainer.prox_mu > 0 else None
 
@@ -109,13 +116,17 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
         num = jax.tree.map(
             lambda acc, v: acc + jnp.einsum(
                 "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
-        return (num, den + jnp.sum(cw), lsum + jnp.sum(losses * cw)), None
+        ys = (flatten_stacked_tree(vs["params"])[0]
+              if emit_flat_params else None)
+        return (num, den + jnp.sum(cw), lsum + jnp.sum(losses * cw)), ys
 
     zeros = pvary_tree(jax.tree.map(
         lambda a: jnp.zeros(a.shape, jnp.float32), variables), vary_axes)
     zf = pvary_tree(jnp.float32(0), vary_axes)
-    (num, den, lsum), _ = jax.lax.scan(
+    (num, den, lsum), flats = jax.lax.scan(
         chunk_body, (zeros, zf, zf), (cohort, weights, rngs))
+    if emit_flat_params:
+        return num, den, lsum, flats
     return num, den, lsum
 
 
@@ -506,11 +517,42 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
 
 
 class MeshRobustEngine(MeshFedAvgEngine):
-    """Byzantine-robust FedAvg on the mesh: per-client norm clipping inside
-    the shard (before the psum) + weak-DP Gaussian noise after
-    (robust_aggregation.py:38-55, FedAvgRobustAggregator.py:176-206)."""
+    """Byzantine-robust FedAvg on the mesh.
+
+    defense="norm_clip" (the reference's clip+weak-DP,
+    robust_aggregation.py:38-55, FedAvgRobustAggregator.py:176-206) stays
+    collective-only: per-client clipping inside the shard, then the psum.
+
+    defense in {"krum", "median", "trimmed_mean"} needs ORDER STATISTICS
+    over the whole cohort's parameter vectors, which a weighted psum
+    cannot express: each shard flattens its clients' trained params to a
+    [k_local, P] f32 matrix (P padded to the ops/aggregate tile),
+    all_gathers it over ICI into the replicated [K, P] cohort matrix, and
+    applies the defense there (krum = one MXU gram matrix, median/trimmed
+    = a sort along the client axis).  Memory bound: K·P·4 bytes per
+    device — fine for the LR/CNN models these defenses are used with,
+    deliberately NOT the path for 128×ResNet cohorts.  Cohort size must
+    divide evenly over the mesh (zero-weight pad lanes have no principled
+    place in a median), enforced at construction."""
+
+    def __init__(self, trainer, data, cfg, defense: str = "norm_clip",
+                 n_byzantine: int = 0, **kw):
+        if defense not in ("norm_clip", "krum", "median", "trimmed_mean"):
+            raise ValueError(f"unknown defense {defense!r}")
+        self.defense = defense
+        self.n_byzantine = n_byzantine
+        super().__init__(trainer, data, cfg, **kw)
+        if defense != "norm_clip":
+            K = min(cfg.client_num_per_round, data.client_num)
+            if K % self.n_shards:
+                raise ValueError(
+                    f"defense {defense!r} needs the cohort ({K}) to divide "
+                    f"evenly over the mesh ({self.n_shards} shards): order "
+                    "statistics cannot ignore padded lanes")
 
     def client_transform(self, client_variables, weight, global_variables):
+        if self.defense != "norm_clip":
+            return client_variables
         out = dict(client_variables)
         out["params"] = robust_ops.norm_diff_clip(
             client_variables["params"], global_variables["params"],
@@ -518,9 +560,64 @@ class MeshRobustEngine(MeshFedAvgEngine):
         return out
 
     def server_update(self, avg_variables, global_variables, server_state, rng):
-        if self.cfg.stddev > 0:
+        if self.defense == "norm_clip" and self.cfg.stddev > 0:
             out = dict(avg_variables)
             out["params"] = robust_ops.add_weak_dp_noise(
                 avg_variables["params"], rng, self.cfg.stddev)
             return out, server_state
         return avg_variables, server_state
+
+    def _shard_body(self, variables, cohort, weights, client_rngs):
+        if self.defense == "norm_clip":
+            return super()._shard_body(variables, cohort, weights,
+                                       client_rngs)
+        from fedml_tpu.ops.aggregate import (flatten_stacked_tree,
+                                             unflatten_to_tree)
+        axes = self.mesh.axis_names
+        rep_vars = variables
+        variables = pvary_tree(variables, axes)
+        local_vars = cast_local(variables, self.local_dtype)
+        k_local = weights.shape[0]
+        # the shared chunked loop, additionally emitting each client's
+        # flattened trained params (prox term etc. included — one code
+        # path with the norm_clip/FedAvg engines)
+        num, den, lsum, flats = chunked_weighted_train(
+            self.trainer, local_vars, cohort, weights, client_rngs,
+            self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk or 8,
+            emit_flat_params=True)
+        rest_num = {k: v for k, v in num.items() if k != "params"}
+        # [n_chunks, chunk, P] -> this shard's clients; drop the in-chunk
+        # pad lanes (they sit at the STATIC tail of the local stack)
+        flats = flats.reshape(-1, flats.shape[-1])[:k_local]
+        # replicated [K, P] cohort matrix: scatter this shard's rows into
+        # zeros and psum — one collective, and unlike all_gather the
+        # result is TYPED replicated (which the out_specs check needs)
+        off = jnp.int32(0)
+        for ax in axes:
+            off = off * self.mesh.shape[ax] + jax.lax.axis_index(ax)
+        full = jnp.zeros((k_local * self.n_shards, flats.shape[-1]),
+                         flats.dtype)
+        full = jax.lax.dynamic_update_slice(
+            full, flats, (off * k_local, jnp.int32(0)))
+        flats = jax.lax.psum(full, axes)
+        if self.defense == "krum":
+            i = robust_ops.krum_select_flat(flats, self.n_byzantine)
+            new_flat = flats[i]
+        elif self.defense == "median":
+            new_flat = jnp.median(flats, axis=0)
+        else:                                 # trimmed_mean
+            n = flats.shape[0]
+            k = min(max(self.n_byzantine, 1), (n - 1) // 2)
+            s = jnp.sort(flats, axis=0)
+            new_flat = jnp.mean(s[k:n - k], axis=0)
+        _, spec = flatten_stacked_tree(
+            jax.tree.map(lambda a: a[None], rep_vars["params"]))
+        new_params = unflatten_to_tree(new_flat, spec)
+        rest_num = jax.lax.psum(rest_num, axes)
+        den = jax.lax.psum(den, axes)
+        grest = {k: v for k, v in rep_vars.items() if k != "params"}
+        new = {"params": new_params,
+               **jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype),
+                              rest_num, grest)}
+        loss = jax.lax.psum(lsum, axes) / den
+        return new, loss

@@ -309,6 +309,52 @@ def test_local_dtype_bf16_close_to_f32():
                                    rtol=0.05, atol=0.02)
 
 
+@pytest.mark.parametrize("defense", ["median", "krum", "trimmed_mean"])
+def test_mesh_orderstat_defense_matches_single_device(defense):
+    """krum/median/trimmed-mean on the mesh (flatten + all_gather + order
+    statistic) must reproduce the single-device FedAvgRobustEngine."""
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustEngine
+    cfg = _mnist_like_cfg(comm_round=2)
+    trainer, data = _setup(cfg)
+    ref = FedAvgRobustEngine(trainer, data, cfg, defense=defense,
+                             n_byzantine=1, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshRobustEngine(trainer, data, cfg, defense=defense,
+                           n_byzantine=1, mesh=make_mesh(8), donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_orderstat_defense_honors_prox_term():
+    """The order-stat shard body shares the FedAvg chunked loop, so a
+    prox_mu trainer applies the proximal term identically to the
+    single-device robust engine."""
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustEngine
+    cfg = _mnist_like_cfg(comm_round=2)
+    trainer, data = _setup(cfg, prox_mu=0.5)
+    ref = FedAvgRobustEngine(trainer, data, cfg, defense="median",
+                             donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshRobustEngine(trainer, data, cfg, defense="median",
+                           mesh=make_mesh(8), donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_orderstat_defense_rejects_ragged_cohort():
+    cfg = _mnist_like_cfg(client_num_per_round=10)   # 10 % 8 != 0
+    trainer, data = _setup(cfg)
+    with pytest.raises(ValueError, match="divide evenly"):
+        MeshRobustEngine(trainer, data, cfg, defense="median",
+                         mesh=make_mesh(8))
+
+
 def test_run_scanned_matches_loop_full_participation():
     """Whole-block lax.scan over rounds == the Python round loop under
     full participation (identical fold_in round rngs, no sampling
