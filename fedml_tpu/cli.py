@@ -293,8 +293,11 @@ def build_engine(args, cfg: FedConfig, data):
         if mesh is not None:
             if args.local_dtype == "bfloat16":
                 logging.getLogger(__name__).warning(
-                    "--local_dtype bfloat16 is not implemented for the "
-                    "gossip engine; running f32 locals")
+                    "--local_dtype bfloat16 does not apply to gossip: "
+                    "worker models PERSIST across rounds (no f32 global "
+                    "to re-cast from each round), so bf16 masters would "
+                    "accumulate rounding round over round; use "
+                    "--train_dtype bfloat16 for bf16 compute instead")
             from fedml_tpu.parallel import MeshGossipEngine
             return MeshGossipEngine(_trainer(cfg, data), data, cfg,
                                     mesh=mesh)
