@@ -121,7 +121,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="assumed Byzantine count (krum neighbor count, "
                         "trimmed-mean trim width)")
     p.add_argument("--topology", type=str, default="ring",
-                   help="decentralized: ring|ws (Watts-Strogatz)")
+                   choices=("ring", "ws", "asymmetric"),
+                   help="decentralized graph: ring = symmetric ring "
+                        "(add Watts-Strogatz extra links by raising "
+                        "--neighbor_num above 2); ws = deprecated alias "
+                        "for ring; asymmetric = directed with randomly "
+                        "deleted links (reference "
+                        "asymmetric_topology_manager.py)")
+    p.add_argument("--neighbor_num", type=int, default=2,
+                   help="ring topology: neighbors per worker; >2 adds "
+                        "Watts-Strogatz style extra links "
+                        "(symmetric_topology_manager.py:21-52)")
     p.add_argument("--unrolled", action="store_true",
                    help="fednas: 2nd-order architect")
     p.add_argument("--gdas", action="store_true",
@@ -305,9 +315,14 @@ def build_engine(args, cfg: FedConfig, data):
         from fedml_tpu.core.topology import (AsymmetricTopologyManager,
                                              SymmetricTopologyManager)
         C = cfg.client_num_in_total
-        topo = (SymmetricTopologyManager(C, neighbor_num=2)
-                if args.topology == "ring"
-                else AsymmetricTopologyManager(C))
+        if args.topology == "ws":
+            logging.getLogger(__name__).warning(
+                "--topology ws is a deprecated alias for ring (use "
+                "--neighbor_num > 2 for Watts-Strogatz extra links)")
+        topo = (AsymmetricTopologyManager(C)
+                if args.topology == "asymmetric"
+                else SymmetricTopologyManager(
+                    C, neighbor_num=args.neighbor_num))
         topo.generate_topology()
         return DecentralizedGossipEngine(_trainer(cfg, data), data, cfg,
                                          topology=topo)
