@@ -27,12 +27,13 @@ from fedml_tpu.core.seg_metrics import (EvaluationMetricsKeeper,
 log = logging.getLogger(__name__)
 
 
-class FedSegEngine(FedAvgEngine):
-    """FedAvg with segmentation eval. The trainer must be built with
-    has_time_axis=True so the per-sample mask broadcasts over H,W."""
+class SegEvalMixin:
+    """Segmentation eval (confusion-matrix IoU/accuracy + metrics keeper)
+    shared by the single-device and mesh FedSeg engines.  Replaces the
+    classification `evaluate` of whichever FedAvg engine it is mixed
+    over."""
 
-    def __init__(self, trainer, data, cfg, **kw):
-        super().__init__(trainer, data, cfg, **kw)
+    def _init_seg_eval(self):
         self.metrics_keeper = EvaluationMetricsKeeper()
         self._cm_fn = jax.jit(self._shard_confusion)
 
@@ -60,3 +61,28 @@ class FedSegEngine(FedAvgEngine):
             out[f"{split}_FWIoU"] = frequency_weighted_iou(cm)
         self.metrics_keeper.update(len(self.metrics_history), out)
         return out
+
+
+class FedSegEngine(SegEvalMixin, FedAvgEngine):
+    """FedAvg with segmentation eval. The trainer must be built with
+    has_time_axis=True so the per-sample mask broadcasts over H,W."""
+
+    def __init__(self, trainer, data, cfg, **kw):
+        super().__init__(trainer, data, cfg, **kw)
+        self._init_seg_eval()
+
+
+def make_mesh_fedseg_engine(trainer, data, cfg, mesh=None, **kw):
+    """Mesh-sharded FedSeg: the training round IS MeshFedAvgEngine's (the
+    fedseg aggregation is unchanged FedAvg, FedSegAggregator.py:1-240);
+    only eval differs, supplied by SegEvalMixin.  Built via a factory to
+    keep parallel/ out of this module's import graph for single-device
+    users."""
+    from fedml_tpu.parallel import MeshFedAvgEngine
+
+    class MeshFedSegEngine(SegEvalMixin, MeshFedAvgEngine):
+        def __init__(self, trainer, data, cfg, **kw2):
+            super().__init__(trainer, data, cfg, **kw2)
+            self._init_seg_eval()
+
+    return MeshFedSegEngine(trainer, data, cfg, mesh=mesh, **kw)

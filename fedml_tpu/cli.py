@@ -219,6 +219,14 @@ def _trainer(cfg: FedConfig, data, model_name: Optional[str] = None,
                          train_ignore_id=train_ignore)
 
 
+def _local_dtype(args):
+    """--local_dtype flag -> jnp dtype (None = f32 locals)."""
+    if args.local_dtype == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    return None
+
+
 def build_engine(args, cfg: FedConfig, data):
     """Algorithm dispatch (the reference's fed_launch algorithm select)."""
     algo = args.algorithm
@@ -234,7 +242,8 @@ def build_engine(args, cfg: FedConfig, data):
 
     if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
                                          "fednova", "fedavg_robust",
-                                         "hierarchical", "decentralized"):
+                                         "hierarchical", "decentralized",
+                                         "fedseg"):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
@@ -261,8 +270,7 @@ def build_engine(args, cfg: FedConfig, data):
                           n_byzantine=args.n_byzantine)
             return cls(trainer, data, cfg, mesh=mesh,
                        streaming=args.streaming, chunk=args.cohort_chunk,
-                       local_dtype=jnp.bfloat16
-                       if args.local_dtype == "bfloat16" else None, **kw)
+                       local_dtype=_local_dtype(args), **kw)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
             return CentralizedTrainer(trainer, data, cfg)
@@ -284,16 +292,13 @@ def build_engine(args, cfg: FedConfig, data):
                 "--streaming has no hierarchical engine path; the client "
                 "stack stays device-resident")
         if mesh is not None:
-            import jax.numpy as jnp
             from fedml_tpu.parallel import MeshHierarchicalEngine
             from fedml_tpu.parallel.mesh import make_mesh_2d
             mesh2 = make_mesh_2d(args.group_num)
             return MeshHierarchicalEngine(
                 _trainer(cfg, data), data, cfg, mesh=mesh2,
                 group_comm_round=args.group_comm_round,
-                chunk=args.cohort_chunk,
-                local_dtype=jnp.bfloat16
-                if args.local_dtype == "bfloat16" else None)
+                chunk=args.cohort_chunk, local_dtype=_local_dtype(args))
         from fedml_tpu.algorithms import HierarchicalFedAvgEngine
         return HierarchicalFedAvgEngine(
             _trainer(cfg, data), data, cfg, group_num=args.group_num,
@@ -336,11 +341,16 @@ def build_engine(args, cfg: FedConfig, data):
                                   multiplier=args.nas_multiplier)
 
     if algo == "fedseg":
-        from fedml_tpu.algorithms.fedseg import FedSegEngine
+        from fedml_tpu.algorithms.fedseg import (FedSegEngine,
+                                                 make_mesh_fedseg_engine)
         # segnet model, mask broadcast over label H,W, VOC void 255
         # (reference SegmentationLosses ignore_index, fedseg/utils.py:72)
         trainer = _trainer(cfg, data, model_name="segnet",
                            force_time_axis=True, default_train_ignore=255)
+        if mesh is not None:
+            return make_mesh_fedseg_engine(
+                trainer, data, cfg, mesh=mesh, streaming=args.streaming,
+                chunk=args.cohort_chunk, local_dtype=_local_dtype(args))
         return FedSegEngine(trainer, data, cfg)
 
     if algo == "fedgan":

@@ -290,8 +290,17 @@ class ClientTrainer:
         scanned XLA program.  `unroll` is threaded to the batch scan (a perf
         knob probed by tools/profile_bench.py; measured neutral on v5e).
         """
-        state = TrainState(variables=variables,
-                           opt_state=self.init_opt(variables), rng=rng)
+        opt_state = self.init_opt(variables)
+        # vma alignment for shard_map: the empty-batch guard's tree_select
+        # makes opt_state *varying* after the first step (has_data depends
+        # on the shard), while a fresh init is replicated-typed — the scan
+        # carry types would mismatch for any STATEFUL optimizer (momentum,
+        # adam, schedule counts).  select(always_true_but_data-dependent,
+        # x, x) is a value no-op that varies the initial state identically.
+        pred = jnp.sum(shard["mask"]) >= 0
+        opt_state = tree_select(pred, opt_state, opt_state)
+        state = TrainState(variables=variables, opt_state=opt_state,
+                           rng=rng)
 
         def batch_body(state, batch):
             state, loss = self.train_step(state, batch, global_params)

@@ -188,3 +188,45 @@ def test_fedseg_metrics(tiny):
     assert 0.0 <= m["test_mIoU"] <= 1.0
     assert 0.0 <= m["test_acc"] <= 1.0
     assert eng.metrics_keeper.best["test_acc"] >= m["test_acc"] - 1e-9
+
+
+def test_mesh_fedseg_matches_single_device():
+    """Mesh FedSeg == single-device FedSeg (training is plain FedAvg; the
+    seg-eval mixin rides MeshFedAvgEngine unchanged)."""
+    from fedml_tpu.algorithms.fedseg import (FedSegEngine,
+                                             make_mesh_fedseg_engine)
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.models.segnet import SegEncoderDecoder
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    rs = np.random.RandomState(0)
+    C, n_per, hw, ncls = 8, 8, 16, 3
+    n = C * n_per
+    x = rs.rand(n, hw, hw, 3).astype(np.float32)
+    y = (x[..., 0] > 0.5).astype(np.int64) + (x[..., 1] > 0.5).astype(np.int64)
+    idx = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(C)}
+    data = FederatedData(
+        train_data_num=n, test_data_num=n,
+        train_global=build_eval_shard(x, y, 8),
+        test_global=build_eval_shard(x, y, 8),
+        client_shards=build_client_shards(x, y, idx, 8),
+        client_num_samples=np.full(C, n_per, np.float32),
+        test_client_shards=None, class_num=ncls, synthetic=True)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=C,
+                    comm_round=2, epochs=1, batch_size=8, lr=0.05,
+                    frequency_of_the_test=100)
+    trainer = ClientTrainer(SegEncoderDecoder(num_classes=ncls, width=8),
+                            lr=cfg.lr, has_time_axis=True)
+    ref = FedSegEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = make_mesh_fedseg_engine(trainer, data, cfg, mesh=make_mesh(8),
+                                  donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    m = eng.evaluate(v_mesh)
+    assert 0.0 <= m["test_mIoU"] <= 1.0

@@ -288,6 +288,28 @@ def test_mesh_fednova_matches_single_device_with_stats():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("opt,kw", [("adam", {}), ("sgd", {"momentum": 0.9})])
+def test_mesh_stateful_client_optimizer(opt, kw):
+    """Regression: STATEFUL client optimizers (adam moments, momentum
+    trace, schedule counts) under the mesh chunked loop used to hit a
+    scan-carry vma mismatch — the empty-batch guard varies opt_state
+    after step 1 while the fresh init was replicated-typed."""
+    cfg = _mnist_like_cfg(comm_round=2, client_num_per_round=10)
+    data = load_data("mnist", client_num_in_total=16, batch_size=16,
+                     synthetic_scale=0.02, seed=0)
+    trainer = ClientTrainer(create_model("lr", data.class_num), lr=0.05,
+                            optimizer=opt, **kw)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False)
+    v_mesh = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
 def test_local_dtype_bf16_close_to_f32():
     """bf16 local masters (the bench's measured v5e win, PERF.md): globals
     stay f32, results stay close to the f32 local path, and the model still
