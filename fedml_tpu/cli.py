@@ -243,7 +243,7 @@ def build_engine(args, cfg: FedConfig, data):
     if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
                                          "fednova", "fedavg_robust",
                                          "hierarchical", "decentralized",
-                                         "fedseg"):
+                                         "fedseg", "fedgan"):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
@@ -354,9 +354,19 @@ def build_engine(args, cfg: FedConfig, data):
         return FedSegEngine(trainer, data, cfg)
 
     if algo == "fedgan":
-        from fedml_tpu.algorithms.fedgan import FedGANEngine
+        from fedml_tpu.algorithms.fedgan import (FedGANEngine,
+                                                 make_mesh_fedgan_engine)
         from fedml_tpu.models.gan import Discriminator, Generator
         out_dim = int(np.prod(data.client_shards["x"].shape[3:]))
+        if mesh is not None:
+            if args.streaming or args.local_dtype:
+                logging.getLogger(__name__).warning(
+                    "fedgan mesh engine supports --cohort_chunk only; "
+                    "--streaming/--local_dtype are ignored")
+            return make_mesh_fedgan_engine(
+                Generator(latent_dim=64, out_dim=out_dim), Discriminator(),
+                data, cfg, latent_dim=64, mesh=mesh,
+                chunk=args.cohort_chunk)
         return FedGANEngine(Generator(latent_dim=64, out_dim=out_dim),
                             Discriminator(), data, cfg, latent_dim=64)
 

@@ -116,3 +116,18 @@ def tree_select(pred, new: Pytree, old: Pytree) -> Pytree:
     data gradient — so freeze params and optimizer state when the batch
     holds no real samples (the reference iterates only real batches)."""
     return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new, old)
+
+
+def tree_vary_noop(tree: Pytree, shard) -> Pytree:
+    """Value-preserving select that makes `tree` carry the shard data's
+    shard_map variance type.
+
+    Why: under shard_map, the empty-batch guard's tree_select varies any
+    STATEFUL optimizer state after the first step (has_data depends on
+    the shard), while a freshly tx.init'd state is replicated-typed — a
+    lax.scan carry-type mismatch.  select(pred, x, x) with a pred that is
+    data-dependent but always true fixes the type without changing a bit.
+    The invariant lives here so every local-training loop uses the same
+    trick."""
+    pred = jnp.sum(shard["mask"]) >= 0        # always true, shard-typed
+    return tree_select(pred, tree, tree)

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from fedml_tpu.core.pytree import tree_select
+from fedml_tpu.core.pytree import tree_select, tree_vary_noop
 
 Pytree = Any
 
@@ -290,17 +290,13 @@ class ClientTrainer:
         scanned XLA program.  `unroll` is threaded to the batch scan (a perf
         knob probed by tools/profile_bench.py; measured neutral on v5e).
         """
-        opt_state = self.init_opt(variables)
-        # vma alignment for shard_map: the empty-batch guard's tree_select
-        # makes opt_state *varying* after the first step (has_data depends
-        # on the shard), while a fresh init is replicated-typed — the scan
-        # carry types would mismatch for any STATEFUL optimizer (momentum,
-        # adam, schedule counts).  select(always_true_but_data-dependent,
-        # x, x) is a value no-op that varies the initial state identically.
-        pred = jnp.sum(shard["mask"]) >= 0
-        opt_state = tree_select(pred, opt_state, opt_state)
-        state = TrainState(variables=variables, opt_state=opt_state,
-                           rng=rng)
+        # tree_vary_noop: align the fresh (replicated-typed) optimizer
+        # state with the varying type it takes after step 1 under
+        # shard_map (core/pytree.py)
+        state = TrainState(
+            variables=variables,
+            opt_state=tree_vary_noop(self.init_opt(variables), shard),
+            rng=rng)
 
         def batch_body(state, batch):
             state, loss = self.train_step(state, batch, global_params)

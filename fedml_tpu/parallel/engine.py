@@ -51,6 +51,19 @@ def cast_local(tree, dtype):
         if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
 
+def pad_ids(ids: np.ndarray, n_shards: int):
+    """THE cohort-padding policy (host side): pad sampled client ids to a
+    mesh-size multiple with zero-weight repeats of client 0 — wmask=0
+    drops them from every weighted reduction.  Shared by all mesh
+    engines."""
+    ids = np.asarray(ids)
+    pad = (-len(ids)) % n_shards
+    wmask = np.concatenate([np.ones(len(ids), np.float32),
+                            np.zeros(pad, np.float32)])
+    ids = np.concatenate([ids, np.zeros(pad, ids.dtype)])
+    return ids, wmask
+
+
 def pad_and_chunk(cohort, weights, rngs, chunk_cap: int):
     """Balanced chunk sizing shared by every chunked cohort loop: same
     number of scan trips as ceil(k/cap) but lanes spread evenly (k=12,
@@ -365,15 +378,10 @@ class MeshFedAvgEngine(FedAvgEngine):
 
     # -- driver loop ----------------------------------------------------------
     def _sample_padded_np(self, round_idx: int):
-        """Sample the round's cohort and pad ids to a mesh-size multiple
-        with zero-weight repeats (wmask=0 drops them from the psum) —
-        the ONE padding policy shared by the resident and streaming paths."""
-        ids = np.asarray(self.sampler.sample(round_idx))
-        pad = (-len(ids)) % self.n_shards
-        wmask = np.concatenate([np.ones(len(ids), np.float32),
-                                np.zeros(pad, np.float32)])
-        ids = np.concatenate([ids, np.zeros(pad, ids.dtype)])
-        return ids, wmask
+        """Sample the round's cohort and pad to a mesh-size multiple
+        (pad_ids — the one padding policy shared by the resident,
+        streaming, and GAN mesh paths)."""
+        return pad_ids(self.sampler.sample(round_idx), self.n_shards)
 
     def sample_padded(self, round_idx: int):
         ids, wmask = self._sample_padded_np(round_idx)
