@@ -21,26 +21,66 @@ from fedml_tpu.utils.config import FedConfig
 
 
 class CentralizedTrainer:
+    """`mesh` turns on classic data parallelism — the reference's DDP
+    (centralized_trainer.py:7,39, main.py:301-377) as a batch-sharded
+    mesh axis: every batch's sample dim is sharded over the devices
+    (padded with zero-mask samples to a device multiple), params stay
+    replicated, and XLA inserts the gradient psums."""
+
     def __init__(self, trainer: ClientTrainer, data: FederatedData,
-                 cfg: FedConfig):
+                 cfg: FedConfig, mesh=None):
         self.trainer = trainer
         self.data = data
         self.cfg = cfg
+        self.mesh = mesh
+        self._data_sharding = None
+        self._padded = False
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self.n_shards = mesh.size
+            # [B, bs, ...]: shard the SAMPLE axis (classic DP)
+            self._data_sharding = NamedSharding(mesh, P(None,
+                                                        mesh.axis_names[0]))
         self.epoch_fn = jax.jit(
             lambda v, shard, rng: trainer.local_train(v, shard, rng, 1))
         self.eval_fn = jax.jit(trainer.evaluate)
         self.metrics_history: list[dict] = []
         self._shard_cache: dict = {}
 
+    def _upload(self, shard):
+        if self._data_sharding is None:
+            return jax.tree.map(jnp.asarray, shard)
+        import numpy as np
+        bs = shard["mask"].shape[1]
+        pad = (-bs) % self.n_shards
+        if pad:
+            self._padded = True
+            shard = {k: np.concatenate(
+                [np.asarray(v),
+                 np.zeros(v.shape[:1] + (pad,) + v.shape[2:],
+                          np.asarray(v).dtype)], axis=1)
+                for k, v in shard.items()}
+        return jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a), self._data_sharding),
+            shard)
+
     def run(self, epochs: Optional[int] = None, variables=None):
         cfg = self.cfg
         rng = jax.random.PRNGKey(cfg.seed)
         if "train" not in self._shard_cache:   # upload once, reuse
-            self._shard_cache["train"] = jax.tree.map(
-                jnp.asarray, self.data.train_global)
+            self._shard_cache["train"] = self._upload(self.data.train_global)
         shard = self._shard_cache["train"]
         if variables is None:
             variables = self.trainer.init(rng, shard["x"][0])
+        if self._padded and any(k != "params" for k in variables):
+            # BatchNorm batch statistics average over ALL samples of a
+            # batch (the mask only guards the loss), so zero-mask padding
+            # would bias them — refuse instead of silently diverging from
+            # the unsharded oracle
+            raise ValueError(
+                "mesh data-parallel centralized training with a "
+                "stats-carrying model (BatchNorm) needs batch_size "
+                f"divisible by the {self.n_shards} devices (got padding)")
         epochs = epochs if epochs is not None else cfg.comm_round
         for ep in range(epochs):
             rng, r = jax.random.split(rng)
@@ -57,7 +97,7 @@ class CentralizedTrainer:
             if split not in self._shard_cache:   # upload once, reuse
                 src = (self.data.train_global if split == "train"
                        else self.data.test_global)
-                self._shard_cache[split] = jax.tree.map(jnp.asarray, src)
+                self._shard_cache[split] = self._upload(src)
             sums = self.eval_fn(variables, self._shard_cache[split])
             cnt = max(float(sums["count"]), 1.0)
             out[f"{split}_acc"] = float(sums["correct"]) / cnt

@@ -243,7 +243,8 @@ def build_engine(args, cfg: FedConfig, data):
     if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
                                          "fednova", "fedavg_robust",
                                          "hierarchical", "decentralized",
-                                         "fedseg", "fedgan"):
+                                         "fedseg", "fedgan",
+                                         "centralized"):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
@@ -273,7 +274,13 @@ def build_engine(args, cfg: FedConfig, data):
                        local_dtype=_local_dtype(args), **kw)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
-            return CentralizedTrainer(trainer, data, cfg)
+            if mesh is not None and (args.streaming or args.cohort_chunk
+                                     or args.local_dtype):
+                logging.getLogger(__name__).warning(
+                    "centralized mesh DP ignores --streaming/"
+                    "--cohort_chunk/--local_dtype")
+            # mesh = the reference's DDP: batch axis sharded over devices
+            return CentralizedTrainer(trainer, data, cfg, mesh=mesh)
         from fedml_tpu import algorithms as A
         cls = {"fedavg": A.FedAvgEngine, "fedopt": A.FedOptEngine,
                "fedprox": A.FedProxEngine, "fednova": A.FedNovaEngine}.get(algo)
