@@ -47,14 +47,17 @@ class CentralizedTrainer:
         self.metrics_history: list[dict] = []
         self._shard_cache: dict = {}
 
-    def _upload(self, shard):
+    def _upload(self, shard, is_train: bool = False):
         if self._data_sharding is None:
             return jax.tree.map(jnp.asarray, shard)
         import numpy as np
         bs = shard["mask"].shape[1]
         pad = (-bs) % self.n_shards
         if pad:
-            self._padded = True
+            if is_train:
+                # only TRAIN padding biases BatchNorm stats; padded eval
+                # shards are harmless (mask guards every eval metric)
+                self._padded = True
             shard = {k: np.concatenate(
                 [np.asarray(v),
                  np.zeros(v.shape[:1] + (pad,) + v.shape[2:],
@@ -68,7 +71,8 @@ class CentralizedTrainer:
         cfg = self.cfg
         rng = jax.random.PRNGKey(cfg.seed)
         if "train" not in self._shard_cache:   # upload once, reuse
-            self._shard_cache["train"] = self._upload(self.data.train_global)
+            self._shard_cache["train"] = self._upload(self.data.train_global,
+                                                      is_train=True)
         shard = self._shard_cache["train"]
         if variables is None:
             variables = self.trainer.init(rng, shard["x"][0])

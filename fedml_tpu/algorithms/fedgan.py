@@ -191,10 +191,8 @@ def make_mesh_fedgan_engine(generator, discriminator, data, cfg,
                     # engine-level pad lanes are masked by wmask; a lane's
                     # own weight is its sample count like the vmap engine
                     w = ns * cw
-                    num = jax.tree.map(
-                        lambda acc, v: acc + jnp.einsum(
-                            "k,k...->...", w, v.astype(jnp.float32)),
-                        num, ps)
+                    from fedml_tpu.parallel.engine import weighted_acc
+                    num = jax.tree.map(weighted_acc(w), num, ps)
                     return (num, den + jnp.sum(w),
                             dls + jnp.sum(dl * cw), gls + jnp.sum(gl * cw),
                             cnt + jnp.sum(cw)), None

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from fedml_tpu.core.pytree import tree_select, tree_weighted_mean
+from fedml_tpu.core.pytree import (tree_select, tree_vary_noop,
+                                   tree_weighted_mean)
 from fedml_tpu.core.sampling import ClientSampler
 from fedml_tpu.core.trainer import masked_cross_entropy
 from fedml_tpu.data.federated import FederatedData
@@ -145,9 +146,11 @@ class FedNASSearchEngine:
         else:            # single-batch client: degenerate single-level mode
             train_shard = val_shard = shard
         n_samples = jnp.sum(shard["mask"])   # full-shard sample weight
+        # tree_vary_noop: shard_map vma alignment for the stateful w/arch
+        # optimizer states (core/pytree.py)
+        w_opt = tree_vary_noop(self.w_tx.init(params), shard)
+        a_opt = tree_vary_noop(self.a_tx.init(alphas), shard)
         shard = train_shard
-        w_opt = self.w_tx.init(params)
-        a_opt = self.a_tx.init(alphas)
 
         def batch_body(carry, batches):
             params, alphas, w_opt, a_opt, rng = carry
@@ -224,6 +227,13 @@ class FedNASSearchEngine:
         return {f"test_{k}": float(v) for k, v in m.items()}
 
     # -- driver --------------------------------------------------------------
+    def _round_args(self, round_idx: int) -> tuple:
+        """Round-input hook (the FedAvgEngine pattern); the mesh variant
+        overrides this with the padded-cohort policy."""
+        ids = self.sampler.sample(round_idx)
+        cohort, _ = self.data.cohort(ids)
+        return (cohort,)
+
     def run(self, rounds: Optional[int] = None):
         cfg = self.cfg
         params, alphas = self.init_state()
@@ -231,11 +241,9 @@ class FedNASSearchEngine:
         rng_base = jax.random.PRNGKey(cfg.seed + 11)
         for round_idx in range(rounds):
             t0 = time.time()
-            ids = self.sampler.sample(round_idx)
-            cohort, _ = self.data.cohort(ids)
             params, alphas, m = self.round_fn(
-                params, alphas, cohort, jax.random.fold_in(rng_base,
-                                                           round_idx))
+                params, alphas, *self._round_args(round_idx),
+                jax.random.fold_in(rng_base, round_idx))
             if (round_idx % cfg.frequency_of_the_test == 0
                     or round_idx == rounds - 1):
                 stats = self.evaluate(params, alphas)
@@ -249,6 +257,94 @@ class FedNASSearchEngine:
     def genotype(self, alphas) -> Any:
         return derive_genotype(alphas, steps=self.steps,
                                multiplier=self.multiplier)
+
+
+def make_mesh_fednas_engine(data: FederatedData, cfg: FedConfig,
+                            mesh=None, chunk: Optional[int] = None,
+                            **nas_kw):
+    """Mesh-sharded FedNAS search: the cohort's bilevel local searches
+    shard over the client mesh, and BOTH aggregation trees (w and alpha,
+    FedNASAggregator.py:71-113) ride weighted psums through the same
+    chunked scan pattern as the FedAvg engines.  The heaviest algorithm
+    in the zoo (second-order architect per batch) — exactly where mesh
+    scaling pays."""
+    from jax.sharding import PartitionSpec as P
+
+    from fedml_tpu.parallel.engine import pad_and_chunk, pad_ids
+    from fedml_tpu.parallel.mesh import make_mesh, pvary_tree
+
+    class MeshFedNASSearchEngine(FedNASSearchEngine):
+        def __init__(self, data, cfg, mesh=None, chunk=None, **kw):
+            self.mesh = mesh if mesh is not None else make_mesh()
+            self.n_shards = self.mesh.size
+            self.chunk = chunk
+            super().__init__(data, cfg, **kw)
+            self.round_fn = jax.jit(
+                self._mesh_round,
+                donate_argnums=(0, 1) if kw.get("donate", True) else ())
+
+        def _round_args(self, round_idx: int) -> tuple:
+            ids, wmask = pad_ids(self.sampler.sample(round_idx),
+                                 self.n_shards)
+            cohort, _ = self.data.cohort(ids)
+            return (cohort, jnp.asarray(wmask))
+
+        def _mesh_round(self, params, alphas, cohort, wmask, rng):
+            mesh, axes = self.mesh, self.mesh.axis_names
+            csh = P(axes)
+            K = cohort["mask"].shape[0]
+            rngs = jax.random.split(rng, K)
+            epochs = self.cfg.epochs
+
+            def body(params, alphas, cohort, wmask, rngs):
+                pv = pvary_tree(params, axes)
+                av = pvary_tree(alphas, axes)
+                ch_c, ch_w, ch_r = pad_and_chunk(cohort, wmask, rngs,
+                                                 self.chunk or 4)
+
+                def chunk_body(carry, xs):
+                    pnum, anum, den, lsum = carry
+                    cs, cw, cr = xs
+                    ps, als, losses, ns = jax.vmap(
+                        lambda s, r: self._local_search(pv, av, s, epochs,
+                                                        r))(cs, cr)
+                    w = ns * cw          # zero-weight pad lanes drop out
+                    from fedml_tpu.parallel.engine import weighted_acc
+                    acc = weighted_acc(w)
+                    return (jax.tree.map(acc, pnum, ps),
+                            jax.tree.map(acc, anum, als),
+                            den + jnp.sum(w),
+                            lsum + jnp.sum(losses * w)), None
+
+                zp = pvary_tree(jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), params),
+                    axes)
+                za = pvary_tree(jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), alphas),
+                    axes)
+                zf = pvary_tree(jnp.float32(0), axes)
+                (pnum, anum, den, lsum), _ = jax.lax.scan(
+                    chunk_body, (zp, za, zf, zf), (ch_c, ch_w, ch_r))
+                pnum = jax.lax.psum(pnum, axes)
+                anum = jax.lax.psum(anum, axes)
+                den = jnp.maximum(jax.lax.psum(den, axes), 1.0)
+                new_p = jax.tree.map(
+                    lambda s, ref: (s / den).astype(ref.dtype), pnum,
+                    params)
+                new_a = jax.tree.map(
+                    lambda s, ref: (s / den).astype(ref.dtype), anum,
+                    alphas)
+                loss = jax.lax.psum(lsum, axes) / den
+                return new_p, new_a, loss
+
+            new_p, new_a, loss = jax.shard_map(
+                body, mesh=mesh, in_specs=(P(), P(), csh, csh, csh),
+                out_specs=(P(), P(), P()))(params, alphas, cohort, wmask,
+                                           rngs)
+            return new_p, new_a, {"train_loss": loss}
+
+    return MeshFedNASSearchEngine(data, cfg, mesh=mesh, chunk=chunk,
+                                  **nas_kw)
 
 
 def make_train_engine(genotype, data: FederatedData, cfg: FedConfig,

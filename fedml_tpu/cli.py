@@ -244,7 +244,7 @@ def build_engine(args, cfg: FedConfig, data):
                                          "fednova", "fedavg_robust",
                                          "hierarchical", "decentralized",
                                          "fedseg", "fedgan",
-                                         "centralized"):
+                                         "centralized", "fednas"):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
@@ -340,12 +340,21 @@ def build_engine(args, cfg: FedConfig, data):
                                          topology=topo)
 
     if algo == "fednas":
+        nas_kw = dict(unrolled=args.unrolled, gdas=args.gdas,
+                      C=args.nas_channels, layers=args.nas_layers,
+                      steps=args.nas_steps,
+                      multiplier=args.nas_multiplier)
+        if mesh is not None:
+            if args.streaming or args.local_dtype:
+                logging.getLogger(__name__).warning(
+                    "fednas mesh engine supports --cohort_chunk only; "
+                    "--streaming/--local_dtype are ignored")
+            from fedml_tpu.algorithms.fednas import make_mesh_fednas_engine
+            return make_mesh_fednas_engine(data, cfg, mesh=mesh,
+                                           chunk=args.cohort_chunk,
+                                           **nas_kw)
         from fedml_tpu.algorithms import FedNASSearchEngine
-        return FedNASSearchEngine(data, cfg, unrolled=args.unrolled,
-                                  gdas=args.gdas, C=args.nas_channels,
-                                  layers=args.nas_layers,
-                                  steps=args.nas_steps,
-                                  multiplier=args.nas_multiplier)
+        return FedNASSearchEngine(data, cfg, **nas_kw)
 
     if algo == "fedseg":
         from fedml_tpu.algorithms.fedseg import (FedSegEngine,

@@ -51,6 +51,14 @@ def cast_local(tree, dtype):
         if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
 
+def weighted_acc(w):
+    """Accumulator step for the chunked loops: acc + Σₖ wₖ·vₖ in f32.
+    One definition so every engine's accumulation (FedAvg/Nova/robust/
+    GAN/NAS) shares the exact cast-and-einsum policy."""
+    return lambda acc, v: acc + jnp.einsum(
+        "k,k...->...", w, v.astype(jnp.float32))
+
+
 def pad_ids(ids: np.ndarray, n_shards: int):
     """THE cohort-padding policy (host side): pad sampled client ids to a
     mesh-size multiple with zero-weight repeats of client 0 — wmask=0
@@ -126,9 +134,7 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
         if client_transform is not None:
             vs = jax.vmap(client_transform,
                           in_axes=(0, 0, None))(vs, cw, variables)
-        num = jax.tree.map(
-            lambda acc, v: acc + jnp.einsum(
-                "k,k...->...", cw, v.astype(jnp.float32)), num, vs)
+        num = jax.tree.map(weighted_acc(cw), num, vs)
         ys = (flatten_stacked_tree(vs["params"])[0]
               if emit_flat_params else None)
         return (num, den + jnp.sum(cw), lsum + jnp.sum(losses * cw)), ys
@@ -490,15 +496,12 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
             # params: Σ w·(g − v)/τ  (zero-weight pad lanes contribute 0)
             coef = cw / jnp.maximum(taus, 1.0)
             dsum = jax.tree.map(
-                lambda acc, g, v: acc + jnp.einsum(
-                    "k,k...->...", coef,
-                    g[None].astype(jnp.float32) - v.astype(jnp.float32)),
+                lambda acc, g, v: weighted_acc(coef)(
+                    acc, g[None].astype(jnp.float32)
+                    - v.astype(jnp.float32)),
                 dsum, g_params, v_params)
             # stats collections: plain weighted mean, like FedAvg
-            rest_num = jax.tree.map(
-                lambda acc, v: acc + jnp.einsum(
-                    "k,k...->...", cw, v.astype(jnp.float32)),
-                rest_num, v_rest)
+            rest_num = jax.tree.map(weighted_acc(cw), rest_num, v_rest)
             return (dsum, rest_num, den + jnp.sum(cw),
                     tsum + jnp.sum(cw * taus),
                     lsum + jnp.sum(losses * cw)), None

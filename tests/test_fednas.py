@@ -144,3 +144,37 @@ def test_gdas_single_path_search():
     assert eng.metrics_history and "test_acc" in eng.metrics_history[-1]
     assert not np.allclose(np.asarray(alphas["reduce"]),
                            np.asarray(a0["reduce"]))
+
+
+def test_mesh_fednas_matches_single_device():
+    """Mesh FedNAS search (sharded bilevel searches, psum'd w+alpha
+    averages) == the vmap engine."""
+    from fedml_tpu.algorithms.fednas import (FedNASSearchEngine,
+                                             make_mesh_fednas_engine)
+    from fedml_tpu.data.loaders import load_data
+    from fedml_tpu.parallel.mesh import make_mesh
+    from fedml_tpu.utils.config import FedConfig
+
+    data = load_data("cifar10", client_num_in_total=8, batch_size=4,
+                     synthetic_scale=0.002, seed=0)
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=8,
+                    comm_round=1, epochs=1, batch_size=4, lr=0.05,
+                    frequency_of_the_test=100)
+    kw = dict(C=4, layers=2, steps=2, multiplier=2)
+    ref = FedNASSearchEngine(data, cfg, donate=False, **kw)
+    p0, a0 = ref.init_state()
+    rng = jax.random.PRNGKey(3)
+    p1, a1, m1 = ref.round_fn(jax.tree.map(jnp.copy, p0),
+                              jax.tree.map(jnp.copy, a0),
+                              *ref._round_args(0), rng)
+    eng = make_mesh_fednas_engine(data, cfg, mesh=make_mesh(8),
+                                  donate=False, **kw)
+    p2, a2, m2 = eng.round_fn(jax.tree.map(jnp.copy, p0),
+                              jax.tree.map(jnp.copy, a0),
+                              *eng._round_args(0), rng)
+    for a, b in zip(jax.tree.leaves((p1, a1)), jax.tree.leaves((p2, a2))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+    assert abs(float(m1["train_loss"]) - float(m2["train_loss"])) < 1e-3
+    # derived genotypes agree
+    assert ref.genotype(a1) == eng.genotype(a2)
