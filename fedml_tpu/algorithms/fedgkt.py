@@ -22,7 +22,6 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from fedml_tpu.core.pytree import tree_select
@@ -50,7 +49,9 @@ class FedGKTEngine:
 
     def __init__(self, client_model, server_model, data: FederatedData,
                  cfg: FedConfig, temperature: float = 3.0,
-                 server_epochs: int = 1):
+                 server_epochs: int = 1, server_optimizer: Optional[str] = None,
+                 server_lr: Optional[float] = None,
+                 server_momentum: float = 0.9, server_wd: float = 1e-4):
         self.client_model = client_model
         self.server_model = server_model
         self.data = data
@@ -59,9 +60,18 @@ class FedGKTEngine:
         self.server_epochs = server_epochs
         self.client_tx = make_optimizer(cfg.client_optimizer, cfg.lr,
                                         cfg.momentum, cfg.wd)
-        self.server_tx = make_optimizer(cfg.server_optimizer, cfg.server_lr,
-                                        cfg.server_momentum)
-        self._client_phase_j = jax.jit(self._client_phase)
+        # the GKT server optimizer TRAINS the big model at the CLIENT lr
+        # with momentum 0.9 + wd 1e-4 (GKTServerTrainer.py:39-44) — it is
+        # NOT FedOpt's pseudo-gradient server_lr=1.0 convention, which
+        # diverges the distillation instantly on real-size models
+        self.server_tx = make_optimizer(
+            server_optimizer or cfg.client_optimizer,
+            cfg.lr if server_lr is None else server_lr,
+            server_momentum, weight_decay=server_wd)
+        # ALL clients' local phases as one vmapped program (the reference
+        # trains clients in separate processes; a python loop over jit
+        # calls would serialize C dispatches per round)
+        self._client_phase_v = jax.jit(jax.vmap(self._client_phase))
         self._server_phase_j = jax.jit(self._server_phase)
         self._eval = jax.jit(self._eval_sums)
         self.metrics_history: list[dict] = []
@@ -156,43 +166,34 @@ class FedGKTEngine:
         cfg = self.cfg
         cp0, sp = self.init_params()
         C = self.data.client_num
-        client_params = [cp0] * C
+        # [C, ...] stacked per-client models: every client's local phase
+        # runs in ONE vmapped program per round
+        cp_stack = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (C,) + a.shape), cp0)
         server_opt = self.server_tx.init(sp)
         shards, _ = self.data.device_shards()
-        sample_logits = None
+        B, bs = shards["mask"].shape[1:3]
+        sample_logits = jnp.zeros((C, B, bs, self.data.class_num))
         rounds = rounds if rounds is not None else cfg.comm_round
         for round_idx in range(rounds):
             t0 = time.time()
-            feats_l, logits_l, losses = [], [], []
-            for cid in range(C):
-                shard = jax.tree.map(lambda a, c=cid: a[c], shards)
-                if sample_logits is None:
-                    B, bs = shard["mask"].shape
-                    n_cls = self.data.class_num
-                    slog = jnp.zeros((B, bs, n_cls))
-                else:
-                    slog = sample_logits[cid]
-                cp, feats, logits, loss = self._client_phase_j(
-                    client_params[cid], shard, slog)
-                client_params[cid] = cp
-                feats_l.append(feats)
-                logits_l.append(logits)
-                losses.append(float(loss))
-            feats = jnp.stack(feats_l)
-            logits = jnp.stack(logits_l)
-            ys = shards["y"]
-            masks = shards["mask"]
+            cp_stack, feats, logits, losses = self._client_phase_v(
+                cp_stack, shards, sample_logits)
             sp, server_opt, sample_logits, s_loss = self._server_phase_j(
-                sp, server_opt, feats, logits, ys, masks)
+                sp, server_opt, feats, logits, shards["y"],
+                shards["mask"])
             if (round_idx % cfg.frequency_of_the_test == 0
                     or round_idx == rounds - 1):
-                stats = self.evaluate(client_params[0], sp)
+                stats = self.evaluate(
+                    jax.tree.map(lambda a: a[0], cp_stack), sp)
                 stats.update(round=round_idx,
-                             client_loss=float(np.mean(losses)),
+                             client_loss=float(jnp.mean(losses)),
                              server_loss=float(s_loss),
                              round_time=time.time() - t0)
                 self.metrics_history.append(stats)
                 log.info("gkt round %d: %s", round_idx, stats)
+        client_params = [jax.tree.map(lambda a, c=cid: a[c], cp_stack)
+                         for cid in range(C)]
         return client_params, sp
 
     def _eval_sums(self, cp, sp, shard):

@@ -390,9 +390,20 @@ def build_engine(args, cfg: FedConfig, data):
         from fedml_tpu.algorithms.fedgkt import FedGKTEngine
         from fedml_tpu.models.resnet_gkt import (ResNetClientGKT,
                                                  ResNetServerGKT)
+        # GKT's server optimizer TRAINS the big model (client-lr default,
+        # GKTServerTrainer.py:39-44) — the FedOpt flag defaults
+        # (sgd @ server_lr=1.0) are a different convention, so only
+        # explicitly non-default --server_* values are forwarded
+        kw = {}
+        if args.server_optimizer != "sgd":
+            kw["server_optimizer"] = args.server_optimizer
+        if args.server_lr != 1.0:
+            kw["server_lr"] = args.server_lr
+        if args.server_momentum != 0.0:
+            kw["server_momentum"] = args.server_momentum
         return FedGKTEngine(ResNetClientGKT(num_classes=data.class_num),
                             ResNetServerGKT(num_classes=data.class_num),
-                            data, cfg)
+                            data, cfg, **kw)
 
     if algo == "splitnn":
         from fedml_tpu.algorithms.split_nn import SplitNNEngine
