@@ -24,6 +24,22 @@ def make_engine(cls=FedAvgEngine, **cfg_kw):
                donate=False)
 
 
+def assert_bitwise_resume(make, tmp_path, name):
+    """Shared resume oracle: 4 straight rounds == 2 rounds + checkpoint +
+    resumed 4 rounds, bitwise; asserts the checkpoint actually landed
+    (a silent save failure would otherwise re-run from scratch and pass
+    vacuously — FedAvgEngine.run falls back when no checkpoint exists)."""
+    v_straight = make().run(rounds=4)
+    ck = FedCheckpointManager(str(tmp_path / name))
+    make().run(rounds=2, ckpt=ck, ckpt_every=1)
+    assert ck.latest_round() == 1
+    v_resumed = make().run(rounds=4, ckpt=ck, resume=True)
+    for a, b in zip(jax.tree.leaves(v_straight), jax.tree.leaves(v_resumed)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=0)
+    ck.close()
+
+
 def test_checkpoint_resume_bitwise(tmp_path):
     """Run 4 rounds straight vs 2 rounds + checkpoint + resume: identical
     final variables (fold_in rngs + per-round sampler reseed)."""
@@ -56,14 +72,7 @@ def test_checkpoint_resume_mesh_streaming(tmp_path):
             ClientTrainer(create_model("lr", 10), lr=0.1), data, cfg,
             mesh=make_mesh(4), donate=False, streaming=True)
 
-    v_straight = mesh_engine().run(rounds=4)
-    ck = FedCheckpointManager(str(tmp_path / "ckm"))
-    mesh_engine().run(rounds=2, ckpt=ck, ckpt_every=1)
-    v_resumed = mesh_engine().run(rounds=4, ckpt=ck, resume=True)
-    for a, b in zip(jax.tree.leaves(v_straight), jax.tree.leaves(v_resumed)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=0, atol=0)
-    ck.close()
+    assert_bitwise_resume(mesh_engine, tmp_path, "ckm")
 
 
 def test_checkpoint_nontrivial_server_state(tmp_path):
@@ -108,3 +117,30 @@ def test_step_timer():
         pass
     assert t.counts["train"] == 2
     assert "train_mean_s" in t.report()
+
+
+def test_checkpoint_resume_full_feature_stack(tmp_path):
+    """Resume bitwise-identically through the FULL mesh feature stack at
+    once: streaming cohorts x bf16 local masters x chunked scan x adam
+    client optimizer x poly LR schedule — interactions none of the
+    single-feature resume tests exercise together."""
+    import jax.numpy as jnp
+    from fedml_tpu.core.trainer import make_lr_schedule
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    def engine():
+        cfg = FedConfig(client_num_in_total=6, client_num_per_round=4,
+                        comm_round=4, epochs=1, batch_size=4, lr=0.05,
+                        client_optimizer="adam", frequency_of_the_test=1)
+        data = tiny_data(n_clients=6, bs=4, hw=8)
+        B = data.client_shards["x"].shape[1]
+        sched = make_lr_schedule("poly", cfg.lr, total_steps=B,
+                                 iters_per_epoch=B)
+        tr = ClientTrainer(create_model("lr", 10), lr=sched,
+                           optimizer="adam")
+        return MeshFedAvgEngine(tr, data, cfg, mesh=make_mesh(4),
+                                donate=False, streaming=True, chunk=1,
+                                local_dtype=jnp.bfloat16)
+
+    assert_bitwise_resume(engine, tmp_path, "ckf")
