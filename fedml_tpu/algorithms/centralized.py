@@ -101,7 +101,11 @@ class CentralizedTrainer:
             if split not in self._shard_cache:   # upload once, reuse
                 src = (self.data.train_global if split == "train"
                        else self.data.test_global)
-                self._shard_cache[split] = self._upload(src)
+                # is_train for the train split even on an eval-first call
+                # path: run() reuses this cached shard for training, so
+                # the BatchNorm zero-pad guard must see its padding
+                self._shard_cache[split] = self._upload(
+                    src, is_train=(split == "train"))
             sums = self.eval_fn(variables, self._shard_cache[split])
             cnt = max(float(sums["count"]), 1.0)
             out[f"{split}_acc"] = float(sums["correct"]) / cnt

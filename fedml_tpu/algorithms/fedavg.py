@@ -174,12 +174,22 @@ class FedAvgEngine:
             cnt = float(sums["count"])
             out[f"{split}_acc"] = float(sums["correct"]) / max(cnt, 1.0)
             out[f"{split}_loss"] = float(sums["loss_sum"]) / max(cnt, 1.0)
-        if (self.data.test_client_shards is not None
+        if (self.cfg.local_test_eval
+                and self.data.test_client_shards is not None
                 and not getattr(self, "streaming", False)):
             # streaming exists because the per-client stack does NOT fit
-            # in HBM — never auto-materialize it for eval there
+            # in HBM — never auto-materialize it for eval there.
+            # --no_local_test_eval opts out of the cost entirely; mesh
+            # engines shard the uploaded test stack (_upload_eval_stack)
             out.update(self.evaluate_local(variables))
         return out
+
+    def _upload_eval_stack(self, shards):
+        """Device placement for the [C,...] per-client eval stack (mesh
+        engines override to shard the client axis — evaluate_local must
+        not concentrate a stack on one device that training had to
+        shard to fit)."""
+        return jax.tree.map(jnp.asarray, shards)
 
     def evaluate_local(self, variables: Pytree, split: str = "test") -> dict:
         """Eval on every client's OWN shard — the reference's
@@ -219,8 +229,8 @@ class FedAvgEngine:
                           else self.data.client_shards)
                 if self.cfg.ci:
                     shards = jax.tree.map(lambda a: a[:1], shards)
-                self._local_eval_shards[split] = jax.tree.map(jnp.asarray,
-                                                              shards)
+                self._local_eval_shards[split] = \
+                    self._upload_eval_stack(shards)
         sums = self._local_eval_fn(variables,
                                    self._local_eval_shards[split])
         cnt = float(jnp.sum(sums["count"]))

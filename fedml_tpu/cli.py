@@ -49,13 +49,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=0.03)
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--wd", type=float, default=0.0)
-    p.add_argument("--server_optimizer", type=str, default="sgd")
-    p.add_argument("--server_lr", type=float, default=1.0)
-    p.add_argument("--server_momentum", type=float, default=0.0)
+    # None = "not set on the command line": FedConfig supplies the FedOpt
+    # defaults (sgd @ 1.0 / 0.0) while fedgkt can tell an explicit
+    # --server_momentum 0.0 apart from the flag being absent
+    p.add_argument("--server_optimizer", type=str, default=None)
+    p.add_argument("--server_lr", type=float, default=None)
+    p.add_argument("--server_momentum", type=float, default=None)
     p.add_argument("--prox_mu", type=float, default=0.0)
     p.add_argument("--norm_bound", type=float, default=5.0)
     p.add_argument("--stddev", type=float, default=0.0)
     p.add_argument("--frequency_of_the_test", type=int, default=5)
+    p.add_argument("--no_local_test_eval", dest="local_test_eval",
+                   action="store_false",
+                   help="skip the per-client test eval inside evaluate() "
+                        "(reference _local_test_on_all_clients parity is "
+                        "ON by default)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ci", type=int, default=0)
     p.add_argument("--synthetic_scale", type=float, default=1.0)
@@ -202,7 +210,11 @@ def _trainer(cfg: FedConfig, data, model_name: Optional[str] = None,
     lr = cfg.lr
     if cfg.lr_scheduler:
         # schedule spans one local round: E epochs x B padded batches
-        # (the reference recreates its scheduler per train() call too)
+        # (the reference recreates its scheduler per train() call too).
+        # Padding steps advance the schedule count (trainer.train_step /
+        # tree_merge_counts) so ragged clients traverse the same full
+        # decay; the reference instead decays over each client's real
+        # batch count — deviation documented in PARITY.md
         B = data.client_shards["x"].shape[1]
         lr = make_lr_schedule(cfg.lr_scheduler, cfg.lr,
                               total_steps=cfg.epochs * B,
@@ -393,13 +405,14 @@ def build_engine(args, cfg: FedConfig, data):
         # GKT's server optimizer TRAINS the big model (client-lr default,
         # GKTServerTrainer.py:39-44) — the FedOpt flag defaults
         # (sgd @ server_lr=1.0) are a different convention, so only
-        # explicitly non-default --server_* values are forwarded
+        # --server_* flags the user actually passed (parser default None)
+        # are forwarded; an explicit 0.0/1.0/"sgd" now sticks
         kw = {}
-        if args.server_optimizer != "sgd":
+        if args.server_optimizer is not None:
             kw["server_optimizer"] = args.server_optimizer
-        if args.server_lr != 1.0:
+        if args.server_lr is not None:
             kw["server_lr"] = args.server_lr
-        if args.server_momentum != 0.0:
+        if args.server_momentum is not None:
             kw["server_momentum"] = args.server_momentum
         return FedGKTEngine(ResNetClientGKT(num_classes=data.class_num),
                             ResNetServerGKT(num_classes=data.class_num),

@@ -118,6 +118,33 @@ def tree_select(pred, new: Pytree, old: Pytree) -> Pytree:
     return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new, old)
 
 
+def tree_merge_counts(kept: Pytree, advanced: Pytree) -> Pytree:
+    """Return `kept` with every optax step-count field (NamedTuple field
+    named ``count``) taken from `advanced`.
+
+    The empty-batch guard freezes optimizer state via tree_select, which
+    also freezes the schedule step count — so padded-lane clients would
+    stall on the LR schedule while real steps elapse.  The schedule count
+    measures elapsed local steps, not applied updates: merging the
+    advanced count back makes every client in a ragged cohort walk the
+    same LR trajectory over the padded E x B loop (the CLI sizes
+    total_steps to the padded batch count).  Momentum / moment buffers
+    stay frozen."""
+    if hasattr(kept, "_fields"):          # optax states are NamedTuples
+        return type(kept)(**{
+            f: (getattr(advanced, f) if f == "count"
+                else tree_merge_counts(getattr(kept, f),
+                                       getattr(advanced, f)))
+            for f in kept._fields})
+    if isinstance(kept, (list, tuple)):
+        return type(kept)(tree_merge_counts(k, a)
+                          for k, a in zip(kept, advanced))
+    if isinstance(kept, dict):
+        return {k: tree_merge_counts(v, advanced[k])
+                for k, v in kept.items()}
+    return kept
+
+
 def tree_vary_noop(tree: Pytree, shard) -> Pytree:
     """Value-preserving select that makes `tree` carry the shard data's
     shard_map variance type.

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from fedml_tpu.core.pytree import tree_select, tree_vary_noop
+from fedml_tpu.core.pytree import (tree_merge_counts, tree_select,
+                                   tree_vary_noop)
 
 Pytree = Any
 
@@ -183,6 +184,7 @@ class ClientTrainer:
         if loss not in ("ce", "bce", "focal"):
             raise ValueError(f"unknown loss {loss!r}")
         self.tx = make_optimizer(optimizer, lr, momentum, weight_decay)
+        self.has_schedule = callable(lr)
         self.prox_mu = prox_mu
         self.has_time_axis = has_time_axis
         self.train_dtype = train_dtype
@@ -274,9 +276,14 @@ class ClientTrainer:
         new_params = optax.apply_updates(
             params, jax.tree.map(lambda u: u * g.astype(u.dtype), updates))
         keep = functools.partial(tree_select, has_data)
+        kept_opt = keep(opt_state, state.opt_state)
+        if self.has_schedule:
+            # padded batches still advance the schedule's step count so
+            # ragged clients share one LR trajectory (tree_merge_counts)
+            kept_opt = tree_merge_counts(kept_opt, opt_state)
         return TrainState(
             variables={"params": new_params, **keep(new_rest, rest)},
-            opt_state=keep(opt_state, state.opt_state),
+            opt_state=kept_opt,
             rng=rng), jnp.where(has_data, loss, 0.0)
 
     # -- local training: epochs x batches under lax.scan --------------------

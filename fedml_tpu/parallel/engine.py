@@ -227,6 +227,18 @@ class MeshFedAvgEngine(FedAvgEngine):
             self._stack_weights = jax.device_put(weights.astype(np.float32), sh)
         return self._stack, self._stack_weights
 
+    def _upload_eval_stack(self, shards):
+        """Per-client eval stacks ride the mesh too: pad the client axis
+        to a mesh multiple (mask-0 lanes add nothing to the eval sums)
+        and shard it — the train stack needed sharding to fit, so the
+        test stack gets the same treatment (ADVICE r2)."""
+        from fedml_tpu.parallel.mesh import pad_cohort
+        C = jax.tree.leaves(shards)[0].shape[0]
+        shards, _ = pad_cohort(dict(shards),
+                               np.zeros(C, np.float32), self.n_shards)
+        sh = client_sharding(self.mesh)
+        return {k: jax.device_put(v, sh) for k, v in shards.items()}
+
     # -- the round program ----------------------------------------------------
     def _shard_body(self, variables, cohort, weights, client_rngs):
         """Per-shard cohort training (chunked_weighted_train) + one psum

@@ -50,6 +50,9 @@ class FedConfig:
     stddev: float = 0.0
     # eval cadence
     frequency_of_the_test: int = 5
+    # auto per-client test eval during evaluate() (the reference's
+    # _local_test_on_all_clients); opt out to skip its upload + cost
+    local_test_eval: bool = True
     # compute precision: "float32" | "bfloat16" (bf16 = the MXU fast path;
     # masters/aggregation stay f32)
     train_dtype: str = "float32"
@@ -64,5 +67,11 @@ class FedConfig:
 
     @classmethod
     def from_args(cls, args) -> "FedConfig":
+        """None-valued namespace entries fall back to the dataclass
+        default — the CLI uses default=None as an "unset" sentinel for
+        flags (server_*) whose effective default depends on the
+        algorithm; a command line cannot express an explicit None."""
         known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in vars(args).items() if k in known})
+        defaults = {f.name: f.default for f in dataclasses.fields(cls)}
+        return cls(**{k: (defaults[k] if v is None else v)
+                      for k, v in vars(args).items() if k in known})
