@@ -19,6 +19,15 @@ import numpy as np
 
 from fedml_tpu.data.federated import FederatedData
 
+# the "How To Backdoor FL" green-car CIFAR-10 train indices (reference
+# data_loader.py:158-161 / 563-566 — published constants of the attack):
+# 27 in-train pool images + 3 held out as the fallback test pool
+GREEN_CAR_TRAIN_IDX = [
+    874, 49163, 34287, 21422, 48003, 47001, 48030, 22984, 37533, 41336,
+    3678, 37365, 19165, 34385, 41861, 39824, 561, 49588, 4528, 3378,
+    38658, 38735, 19500, 9744, 47026, 1605, 389]
+GREEN_CAR_TEST_IDX = [32941, 36005, 40138]
+
 
 def pixel_trigger(x: np.ndarray, strength: float = 3.0) -> np.ndarray:
     """Stamp a high-contrast 3×3 checkerboard in the bottom-right corner.
@@ -77,6 +86,14 @@ def load_edge_case_pool(data_dir: Optional[str], poison_type: str,
                  (pickled uint8 [N,32,32,3] CIFAR-shaped airline images)
       ardis:     ARDIS/ardis_train_dataset.pt / ardis_test_dataset.pt
                  (torch-saved MNIST-shaped digit images)
+      greencar:  the pool's TRAIN images are 27 fixed green-car images
+                 drawn from CIFAR-10's own train set by index
+                 (data_loader.py:563-565 sampled_indices_train; the "How
+                 To Backdoor FL" set) read from
+                 data_dir/cifar-10-batches-py; the TEST pool is the
+                 shipped greencar_cifar10/green_car_transformed_test.pkl
+                 (already normalized, :585-587), falling back to the 3
+                 held-out train indices (:566).
     Fallback (zero-egress image): a tight off-distribution Gaussian cluster
     with the same shapes — edge-case semantics (plausible, consistent,
     unseen) without the real pixels.
@@ -85,10 +102,29 @@ def load_edge_case_pool(data_dir: Optional[str], poison_type: str,
     input scale."""
     import os
     import pickle
-    if poison_type not in ("southwest", "ardis"):
+    if poison_type in ("greencar-neo", "howto"):   # reference aliases
+        poison_type = "greencar"
+    if poison_type not in ("southwest", "ardis", "greencar"):
         raise ValueError(f"unknown edge-case poison {poison_type!r}")
     try:
-        if poison_type == "southwest":
+        if poison_type == "greencar":
+            from fedml_tpu.data.loaders import CIFAR10_MEAN, CIFAR10_STD
+            from fedml_tpu.data.readers import read_cifar_pickles
+            x_all, _, _, _ = read_cifar_pickles(
+                os.path.join(data_dir or "", "cifar-10-batches-py"))
+            mean = np.asarray(CIFAR10_MEAN, np.float32)
+            std = np.asarray(CIFAR10_STD, np.float32)
+            x_tr = (x_all[GREEN_CAR_TRAIN_IDX] - mean) / std
+            te_pkl = os.path.join(data_dir or "", "greencar_cifar10",
+                                  "green_car_transformed_test.pkl")
+            if os.path.isfile(te_pkl):
+                with open(te_pkl, "rb") as f:
+                    x_te = np.asarray(pickle.load(f), np.float32)
+                if x_te.ndim == 4 and x_te.shape[1] == 3:   # NCHW pack
+                    x_te = x_te.transpose(0, 2, 3, 1)
+            else:
+                x_te = (x_all[GREEN_CAR_TEST_IDX] - mean) / std
+        elif poison_type == "southwest":
             from fedml_tpu.data.loaders import CIFAR10_MEAN, CIFAR10_STD
             base = os.path.join(data_dir or "", "southwest_cifar10")
             with open(os.path.join(base, "southwest_images_new_train.pkl"),

@@ -59,6 +59,44 @@ def test_real_ardis_torch_pack(tmp_path):
     assert tr.shape == (12, 28, 28, 1) and te.shape == (4, 28, 28, 1)
 
 
+def test_greencar_pool_from_cifar_train_set(tmp_path):
+    """greencar draws its TRAIN pool from CIFAR-10's own train images at
+    the published howto indices (reference data_loader.py:563-566) and
+    prefers the shipped transformed test pack when present."""
+    from fedml_tpu.data.poison import GREEN_CAR_TRAIN_IDX
+    d = tmp_path / "cifar-10-batches-py"
+    os.makedirs(str(d))
+    rng = np.random.RandomState(0)
+    # five 10k-image batches so the fixed indices (< 50000) resolve
+    for i in range(1, 6):
+        with open(str(d / f"data_batch_{i}"), "wb") as f:
+            pickle.dump({b"data": rng.randint(0, 255, (10000, 3072),
+                                              np.uint8),
+                         b"labels": rng.randint(0, 10, 10000).tolist()}, f)
+    with open(str(d / "test_batch"), "wb") as f:
+        pickle.dump({b"data": rng.randint(0, 255, (100, 3072), np.uint8),
+                     b"labels": rng.randint(0, 10, 100).tolist()}, f)
+    tr, te = load_edge_case_pool(str(tmp_path), "greencar")
+    assert tr.shape == (len(GREEN_CAR_TRAIN_IDX), 32, 32, 3)
+    assert te.shape == (3, 32, 32, 3)       # held-out train indices
+    assert abs(float(tr.mean())) < 1.5      # CIFAR-normalized
+    # shipped transformed test pack takes precedence (NCHW pack layout)
+    g = tmp_path / "greencar_cifar10"
+    os.makedirs(str(g))
+    with open(str(g / "green_car_transformed_test.pkl"), "wb") as f:
+        pickle.dump(rng.normal(0, 1, (7, 3, 32, 32)).astype(np.float32), f)
+    _, te2 = load_edge_case_pool(str(tmp_path), "greencar")
+    assert te2.shape == (7, 32, 32, 3)
+    # reference aliases resolve to the same pool
+    tr3, _ = load_edge_case_pool(str(tmp_path), "greencar-neo")
+    np.testing.assert_array_equal(tr, tr3)
+
+
+def test_greencar_fallback_without_data():
+    tr, te = load_edge_case_pool(None, "greencar", (32, 32, 3))
+    assert tr.shape[1:] == (32, 32, 3) and te.shape[1:] == (32, 32, 3)
+
+
 def test_poison_edge_case_mixes_attacker_shards():
     data = load_data("cifar10", client_num_in_total=4, batch_size=8,
                      synthetic_scale=0.005, partition_method="homo")
