@@ -11,7 +11,25 @@ Both return per-position logits [B, T, vocab]; the loss masks padding.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+def _lstm(hidden_size: int, h):
+    """nn.RNN over an OptimizedLSTMCell with a carry whose shard_map
+    variance matches the inputs.
+
+    nn.RNN's default carry is fresh zeros — replicated-typed under
+    shard_map, while the scan body's carry output varies with the
+    (client-sharded) inputs: a lax.scan carry-type mismatch.  Adding
+    `0 * sum(0 * h)` promotes the zeros to h's variance without changing
+    a bit (same invariant as core/pytree.tree_vary_noop)."""
+    cell = nn.OptimizedLSTMCell(hidden_size)
+    carry = cell.initialize_carry(jax.random.PRNGKey(0),
+                                  h.shape[:-2] + h.shape[-1:])
+    bump = jnp.sum(h * 0)                       # 0.0, but input-varying
+    carry = jax.tree.map(lambda a: a + bump.astype(a.dtype), carry)
+    return nn.RNN(cell)(h, initial_carry=carry)
 
 
 class RNNOriginalFedAvg(nn.Module):
@@ -26,8 +44,8 @@ class RNNOriginalFedAvg(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
-        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
-        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = _lstm(self.hidden_size, h)
+        h = _lstm(self.hidden_size, h)
         if self.last_only:
             h = h[:, -1]
         return nn.Dense(self.vocab_size)(h)
@@ -41,6 +59,6 @@ class RNNStackOverflow(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
-        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = _lstm(self.hidden_size, h)
         h = nn.Dense(self.embedding_dim)(h)
         return nn.Dense(self.vocab_size)(h)
