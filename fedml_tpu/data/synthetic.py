@@ -70,19 +70,30 @@ def synthetic_segmentation(n: int, hw: tuple[int, int], n_classes: int,
     return x, y
 
 
-def synthetic_sequences(n: int, seq_len: int, vocab: int, seed: int = 0):
+def synthetic_sequences(n: int, seq_len: int, vocab: int, seed: int = 0,
+                        chunk: int = 16384):
     """Markov-chain token sequences for LM tasks (shakespeare/stackoverflow
-    stand-in): x = seq[:-1], y = seq[1:]."""
+    stand-in): x = seq[:-1], y = seq[1:].
+
+    Sampling is chunked over rows: the naive gather materializes an
+    [n, vocab] float64 row matrix — ~55 GB at the reference's 342k-client
+    stackoverflow scale (684,954 rows × 10,004 vocab), which OOM'd the
+    host.  Chunking draws the SAME rng stream in the same order (rand of
+    c rows at a time == rand(n) split), so the output is bit-identical to
+    the unchunked version at any chunk size."""
     rng = np.random.RandomState(seed)
     # sparse transition matrix => learnable structure
     trans = rng.dirichlet(np.full(vocab, 0.05), size=vocab)
+    cumt = np.cumsum(trans, axis=1)       # precompute rows once
+    del trans
     seqs = np.zeros((n, seq_len + 1), np.int32)
     seqs[:, 0] = rng.randint(0, vocab, n)
     for t in range(seq_len):
-        p = trans[seqs[:, t]]
-        cum = np.cumsum(p, axis=1)
-        r = rng.rand(n, 1)
-        seqs[:, t + 1] = (r > cum).sum(axis=1).clip(0, vocab - 1)
+        for s in range(0, n, chunk):
+            e = min(s + chunk, n)
+            cum = cumt[seqs[s:e, t]]      # [<=chunk, vocab]
+            r = rng.rand(e - s, 1)
+            seqs[s:e, t + 1] = (r > cum).sum(axis=1).clip(0, vocab - 1)
     return seqs[:, :-1].astype(np.int32), seqs[:, 1:].astype(np.int64)
 
 

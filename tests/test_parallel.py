@@ -425,6 +425,56 @@ def test_streaming_large_client_count():
     assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
 
 
+def test_streaming_reference_scale_memory_bound():
+    """The reference's FEMNIST benchmark client count — 3,400 clients
+    (benchmark/README.md:54) — through the streaming engine, with a
+    device-residency assertion: across all rounds the live device bytes
+    never exceed the pre-round baseline (model + optimizer + eval shards)
+    plus TWO padded cohorts (the double-buffer prefetch) — i.e. device
+    memory is O(cohort), not O(client_num_in_total)."""
+    n = 3400
+    cfg = _mnist_like_cfg(client_num_in_total=n, client_num_per_round=10,
+                          comm_round=3, frequency_of_the_test=100)
+    data = load_data("femnist", client_num_in_total=n, batch_size=20,
+                     synthetic_scale=0.0, seed=0)
+    assert data.client_num == n
+    stack_bytes = sum(np.asarray(v).nbytes
+                      for v in data.client_shards.values())
+    model = create_model("cnn", output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=0.05)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           streaming=True)
+
+    def live_bytes():
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.live_arrays())
+
+    cohort, w = eng.stream_cohort(0)
+    cohort_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                       for a in jax.tree.leaves(cohort)) + w.nbytes
+    del cohort, w
+    v = eng.init_variables()
+    v = eng._prepare_variables(v)
+    baseline = live_bytes() + cohort_bytes  # v + anything engine init left
+
+    peaks = []
+    orig = eng.stream_cohort
+    eng.stream_cohort = lambda r: (peaks.append(live_bytes()), orig(r))[1]
+    v = eng.run(variables=v, rounds=3)
+    assert eng._stack is None          # resident stack never built
+    assert len(peaks) >= 3
+    # every observation: <= baseline + 2 cohorts (prefetch double buffer)
+    # + the uploaded eval shards + slack; crucially O(cohort), never
+    # O(stack): the full stack is >100x a cohort at this scale
+    eval_bytes = sum(np.asarray(x).nbytes
+                     for shard in (data.train_global, data.test_global)
+                     for x in shard.values())
+    bound = baseline + 2 * cohort_bytes + eval_bytes + (8 << 20)
+    assert max(peaks) <= bound, (max(peaks), bound)
+    assert stack_bytes > 20 * cohort_bytes   # the bound is meaningful
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
+
+
 def test_multihost_mesh_helpers():
     """Single-process: helpers still build valid meshes over local devices
     (multi-host wiring is a no-op here)."""
