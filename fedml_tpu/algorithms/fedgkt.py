@@ -149,9 +149,14 @@ class FedGKTEngine:
             keep = functools.partial(tree_select, has)
             return (keep(optax.apply_updates(p, u), p), keep(opt2, opt)), loss
 
+        # all-padding steps (zero-weight pad clients on the mesh) are
+        # frozen no-ops; they must not dilute the epoch-loss metric either
+        step_real = (stream[3].sum(axis=1) > 0).astype(jnp.float32)
+
         def epoch(carry, _):
             carry, losses = jax.lax.scan(step, carry, stream)
-            return carry, losses.mean()
+            return carry, (jnp.sum(losses * step_real)
+                           / jnp.maximum(step_real.sum(), 1.0))
 
         (p, opt_state), losses = jax.lax.scan(
             epoch, (server_params, opt_state), None,
@@ -162,6 +167,13 @@ class FedGKTEngine:
         return p, opt_state, slog, losses.mean()
 
     # -- driver ---------------------------------------------------------------
+    def _setup_device_data(self):
+        """Device placement hook: returns (shards for the client phase,
+        y and mask for the server phase).  The mesh engine overrides this
+        to commit each to its phase's layout (client- vs batch-sharded)."""
+        shards, _ = self.data.device_shards()
+        return shards, shards["y"], shards["mask"]
+
     def run(self, rounds: Optional[int] = None):
         cfg = self.cfg
         cp0, sp = self.init_params()
@@ -171,7 +183,7 @@ class FedGKTEngine:
         cp_stack = jax.tree.map(
             lambda a: jnp.broadcast_to(a[None], (C,) + a.shape), cp0)
         server_opt = self.server_tx.init(sp)
-        shards, _ = self.data.device_shards()
+        shards, y_srv, m_srv = self._setup_device_data()
         B, bs = shards["mask"].shape[1:3]
         sample_logits = jnp.zeros((C, B, bs, self.data.class_num))
         rounds = rounds if rounds is not None else cfg.comm_round
@@ -180,14 +192,18 @@ class FedGKTEngine:
             cp_stack, feats, logits, losses = self._client_phase_v(
                 cp_stack, shards, sample_logits)
             sp, server_opt, sample_logits, s_loss = self._server_phase_j(
-                sp, server_opt, feats, logits, shards["y"],
-                shards["mask"])
+                sp, server_opt, feats, logits, y_srv, m_srv)
             if (round_idx % cfg.frequency_of_the_test == 0
                     or round_idx == rounds - 1):
                 stats = self.evaluate(
                     jax.tree.map(lambda a: a[0], cp_stack), sp)
+                # mean over clients that HAVE data (mesh pads the stack
+                # with zero-weight clients whose loss is a frozen 0)
+                real = jnp.asarray(self.data.client_num_samples) > 0
                 stats.update(round=round_idx,
-                             client_loss=float(jnp.mean(losses)),
+                             client_loss=float(
+                                 jnp.sum(losses * real)
+                                 / jnp.maximum(real.sum(), 1)),
                              server_loss=float(s_loss),
                              round_time=time.time() - t0)
                 self.metrics_history.append(stats)
@@ -208,3 +224,90 @@ class FedGKTEngine:
         shard = jax.tree.map(jnp.asarray, self.data.test_global)
         c, n = self._eval(client_params, server_params, shard)
         return {"test_acc": float(c) / max(float(n), 1.0)}
+
+
+class MeshFedGKTEngine(FedGKTEngine):
+    """FedGKT over a device mesh.
+
+    Two different parallel axes, matching the phase structure:
+
+    * client phase — the [C, ...] per-client model stack and shards are
+      sharded on the CLIENT axis; each device runs the vmapped local
+      phase for its slice (embarrassingly parallel, zero collectives).
+    * server phase — the reference's ONE classic-DP use is the GKT
+      server (`nn.DataParallel(model)`, GKTServerTrainer.py:27-29, whose
+      measured win is the incidental batch-scaling row in BASELINE.md):
+      here each distillation step's BATCH axis is sharded over the mesh,
+      params stay replicated, and XLA inserts the gradient psums — GSPMD
+      batch parallelism instead of replicated-module scatter/gather.
+
+    Both phases keep the exact single-device program (this class only
+    re-jits them with explicit shardings), so mesh == single-device up to
+    float reassociation — pinned by the oracle test."""
+
+    def __init__(self, client_model, server_model, data: FederatedData,
+                 cfg: FedConfig, mesh=None, **kw):
+        import dataclasses
+
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from fedml_tpu.parallel.mesh import make_mesh, pad_cohort
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._real_clients = data.client_num
+        n_dev = int(np.prod(list(self.mesh.shape.values())))
+        shards = dict(data.client_shards)
+        w = np.asarray(data.client_num_samples, np.float32)
+        pad_c = (-data.client_num) % n_dev
+        pad_bs = (-shards["mask"].shape[2]) % n_dev
+        if pad_c:
+            # GKT is full-participation resident: pad the stack itself
+            # with zero-mask clients (their local phase is a no-op and
+            # their mask-0 feature batches freeze the server scan steps)
+            shards, w = pad_cohort(shards, w, n_dev)
+        if pad_bs:
+            # the server phase shards each step's BATCH axis; pad it to a
+            # device multiple with mask-0 samples (masked losses/metrics
+            # give them zero weight in both phases)
+            def pad2(a):
+                width = [(0, 0)] * a.ndim
+                width[2] = (0, pad_bs)
+                return np.pad(np.asarray(a), width)
+            shards = {k: pad2(v) for k, v in shards.items()}
+        if pad_c or pad_bs:
+            data = dataclasses.replace(data, client_shards=shards,
+                                       client_num_samples=w,
+                                       _device_cache={})
+        super().__init__(client_model, server_model, data, cfg, **kw)
+        axes = self.mesh.axis_names
+        csh = NamedSharding(self.mesh, P(axes))           # leading C axis
+        rep = NamedSharding(self.mesh, P())
+        bsh = NamedSharding(self.mesh, P(None, None, axes))  # [K,B,bs,...]
+        self._csh, self._bsh = csh, bsh
+        # the client phase EMITS feats/logits batch-sharded (XLA inserts
+        # the client→server all-to-all inside the program — the "upload");
+        # jit rejects committed args whose layout differs from
+        # in_shardings, so the boundary layouts must agree exactly
+        self._client_phase_v = jax.jit(
+            jax.vmap(self._client_phase),
+            in_shardings=(csh, csh, csh),
+            out_shardings=(csh, bsh, bsh, csh))
+        self._server_phase_j = jax.jit(
+            self._server_phase,
+            in_shardings=(rep, rep, bsh, bsh, bsh, bsh),
+            # slog leaves client-sharded: the next client phase consumes
+            # it on the client axis (the per-client logits download)
+            out_shardings=(rep, rep, csh, rep))
+
+    def _setup_device_data(self):
+        # place the HOST arrays directly (not via device_shards(), whose
+        # cache would pin a second, unsharded full-stack copy in HBM)
+        shards = self.data.client_shards
+        client_shards = {k: jax.device_put(v, self._csh)
+                         for k, v in shards.items()}
+        return (client_shards, jax.device_put(shards["y"], self._bsh),
+                jax.device_put(shards["mask"], self._bsh))
+
+    def run(self, rounds: Optional[int] = None):
+        client_params, sp = super().run(rounds)
+        return client_params[:self._real_clients], sp

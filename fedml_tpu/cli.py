@@ -255,7 +255,7 @@ def build_engine(args, cfg: FedConfig, data):
     if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
                                          "fednova", "fedavg_robust",
                                          "hierarchical", "decentralized",
-                                         "fedseg", "fedgan",
+                                         "fedseg", "fedgan", "fedgkt",
                                          "centralized", "fednas"):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
@@ -414,9 +414,18 @@ def build_engine(args, cfg: FedConfig, data):
             kw["server_lr"] = args.server_lr
         if args.server_momentum is not None:
             kw["server_momentum"] = args.server_momentum
-        return FedGKTEngine(ResNetClientGKT(num_classes=data.class_num),
-                            ResNetServerGKT(num_classes=data.class_num),
-                            data, cfg, **kw)
+        models = (ResNetClientGKT(num_classes=data.class_num),
+                  ResNetServerGKT(num_classes=data.class_num))
+        if mesh is not None:
+            from fedml_tpu.algorithms.fedgkt import MeshFedGKTEngine
+            if args.streaming or args.cohort_chunk or args.local_dtype:
+                logging.getLogger(__name__).warning(
+                    "fedgkt mesh engine ignores --streaming/"
+                    "--cohort_chunk/--local_dtype (GKT is "
+                    "full-participation resident; phases are GSPMD-"
+                    "sharded, not cohort-chunked)")
+            return MeshFedGKTEngine(*models, data, cfg, mesh=mesh, **kw)
+        return FedGKTEngine(*models, data, cfg, **kw)
 
     if algo == "splitnn":
         from fedml_tpu.algorithms.split_nn import SplitNNEngine

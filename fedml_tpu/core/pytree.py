@@ -119,20 +119,22 @@ def tree_select(pred, new: Pytree, old: Pytree) -> Pytree:
 
 
 def tree_merge_counts(kept: Pytree, advanced: Pytree) -> Pytree:
-    """Return `kept` with every optax step-count field (NamedTuple field
-    named ``count``) taken from `advanced`.
+    """Return `kept` with every SCHEDULE step count (the ``count`` field
+    of optax ``ScaleByScheduleState`` NamedTuples) taken from `advanced`.
 
     The empty-batch guard freezes optimizer state via tree_select, which
     also freezes the schedule step count — so padded-lane clients would
-    stall on the LR schedule while real steps elapse.  The schedule count
-    measures elapsed local steps, not applied updates: merging the
+    stall on the LR schedule while real steps elapse.  The SCHEDULE
+    count measures elapsed local steps, not applied updates: merging the
     advanced count back makes every client in a ragged cohort walk the
     same LR trajectory over the padded E x B loop (the CLI sizes
-    total_steps to the padded batch count).  Momentum / moment buffers
-    stay frozen."""
+    total_steps to the padded batch count).  Other counts — notably
+    ScaleByAdamState.count, whose bias correction must agree with the
+    frozen mu/nu moments — and momentum / moment buffers stay frozen."""
     if hasattr(kept, "_fields"):          # optax states are NamedTuples
+        schedule = type(kept).__name__ == "ScaleByScheduleState"
         return type(kept)(**{
-            f: (getattr(advanced, f) if f == "count"
+            f: (getattr(advanced, f) if f == "count" and schedule
                 else tree_merge_counts(getattr(kept, f),
                                        getattr(advanced, f)))
             for f in kept._fields})

@@ -89,6 +89,30 @@ def test_cli_algorithm_table_is_exhaustive():
     assert sorted(_ALGO_FLAGS) == sorted(ALGORITHMS)
 
 
+def test_cli_fedgkt_mesh_dispatch():
+    """--mesh + fedgkt selects MeshFedGKTEngine and forwards explicit
+    --server_* values (dispatch only: the real ResNet pair's GSPMD
+    compile is minutes on the 1-core CPU proxy; engine semantics are
+    pinned by test_advanced_algorithms' tiny-model oracle)."""
+    from fedml_tpu.algorithms.fedgkt import MeshFedGKTEngine
+    from fedml_tpu.cli import build_parser, build_engine
+    from fedml_tpu.data.loaders import load_data
+    from fedml_tpu.utils.config import FedConfig
+
+    args = build_parser().parse_args(
+        ["--algorithm", "fedgkt", "--dataset", "cifar10", "--mesh",
+         "--client_num_in_total", "4", "--client_num_per_round", "4",
+         "--batch_size", "8", "--synthetic_scale", "0.002",
+         "--server_momentum", "0.0"])
+    cfg = FedConfig.from_args(args)
+    data = load_data("cifar10", client_num_in_total=4, batch_size=8,
+                     synthetic_scale=0.002)
+    eng = build_engine(args, cfg, data)
+    assert isinstance(eng, MeshFedGKTEngine)
+    assert eng.server_tx is not None
+    assert eng._real_clients == 4
+
+
 def test_cli_streaming_mesh(tmp_path):
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
                 "--model", "lr", "--mesh", "--streaming",

@@ -254,6 +254,40 @@ class TestLRScheduleAndLosses:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-7)
 
+    def test_padded_batches_advance_schedule_count_only(self):
+        """Empty (mask-0) batches advance the LR-schedule step count —
+        ragged clients share one decay trajectory (ADVICE r2) — while
+        adam's own count stays frozen with its mu/nu moments (its bias
+        correction must agree with how many updates were APPLIED)."""
+        from fedml_tpu.core.trainer import (ClientTrainer, TrainState,
+                                            make_lr_schedule)
+        from fedml_tpu.models import create_model
+        sched = make_lr_schedule("poly", 0.1, 8)
+        tr = ClientTrainer(create_model("lr", 2), lr=sched,
+                           optimizer="adam")
+        x = jnp.ones((2, 3, 4))
+        v = tr.init(jax.random.PRNGKey(0), x[0][:1])
+        state = TrainState(variables=v, opt_state=tr.init_opt(v),
+                           rng=jax.random.PRNGKey(1))
+        real = {"x": x[0], "y": jnp.zeros((3,), jnp.int32),
+                "mask": jnp.ones((3,))}
+        empty = {"x": x[1], "y": jnp.zeros((3,), jnp.int32),
+                 "mask": jnp.zeros((3,))}
+        step = jax.jit(tr.train_step)
+        state, _ = step(state, real)        # 1 applied update
+        state, _ = step(state, empty)       # padding: frozen no-op
+        state, _ = step(state, empty)
+        adam_state, sched_state = state.opt_state[-1]
+        assert int(sched_state.count) == 3    # elapsed local steps
+        assert int(adam_state.count) == 1     # applied updates only
+        mu_after = jax.tree.leaves(adam_state.mu)[0]
+        state2, _ = step(state, real)
+        assert int(state2.opt_state[-1][0].count) == 2
+        # moments moved again only on the real step
+        assert float(jnp.abs(
+            jax.tree.leaves(state2.opt_state[-1][0].mu)[0]
+            - mu_after).max()) > 0
+
     def test_scheduled_sgd_decays_within_round(self):
         from fedml_tpu.core.trainer import ClientTrainer, make_lr_schedule
         from fedml_tpu.models import create_model
