@@ -1,0 +1,114 @@
+"""Mounted-data acceptance rows (BASELINE.md / reference
+benchmark/README.md): each test reproduces one published accuracy row at
+the row's EXACT hyperparameters.
+
+Contract (round-2 VERDICT next-round #3): the tests SKIP when the real
+dataset files are not mounted (this image has zero egress and ships no
+task data) and FAIL LOUDLY when the data is present and the run lands
+below the published bar.  Point FEDML_DATA_ROOT at a directory holding
+the per-dataset layouts that `data/loaders.py` reads (see
+scripts/get_data.sh for the download recipes):
+
+    $FEDML_DATA_ROOT/mnist/{train,test}/*.json          LEAF
+    $FEDML_DATA_ROOT/femnist/fed_emnist_{train,test}.h5 TFF
+    $FEDML_DATA_ROOT/cifar10/cifar-10-batches-py/       pickles
+
+Budgets are the reference's (hundreds to thousands of rounds) — this
+file is an ACCEPTANCE harness for real hardware, not a CI unit suite;
+without mounted data every test skips in milliseconds.  Bars assert the
+published number minus 2 points of run-to-run noise.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.utils.config import FedConfig
+
+DATA_ROOT = os.environ.get("FEDML_DATA_ROOT", "/root/data")
+
+
+def _load_or_skip(dataset: str, subdir: str, **kw):
+    """load_data with the mounted dir; skip when the loader fell back to
+    the synthetic stand-in (files absent)."""
+    path = os.path.join(DATA_ROOT, subdir)
+    if not os.path.isdir(path):        # fast path: no dir, no 30s
+        pytest.skip(f"{path} not mounted")  # synthetic fallback build
+    data = load_data(dataset, data_dir=path, **kw)
+    if data.synthetic:
+        pytest.skip(f"{dataset} files not mounted under {DATA_ROOT}/{subdir}")
+    return data
+
+
+def _fedavg(data, cfg, model_name, **trainer_kw):
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+
+    from fedml_tpu.algorithms import FedAvgEngine
+    trainer = ClientTrainer(create_model(model_name, data.class_num),
+                            lr=cfg.lr, momentum=cfg.momentum,
+                            weight_decay=cfg.wd, **trainer_kw)
+    eng = FedAvgEngine(trainer, data, cfg)
+    v = eng.run()
+    return eng.evaluate(v)
+
+
+def test_row_mnist_lr():
+    """MNIST + LR, power-law, 1000 clients (10/round), bs=10, lr=0.03,
+    E=1, >100 rounds -> >75% (benchmark/README.md:12)."""
+    data = _load_or_skip("mnist", "mnist", client_num_in_total=1000,
+                         batch_size=10, partition_method="power_law")
+    cfg = FedConfig(client_num_in_total=1000, client_num_per_round=10,
+                    comm_round=150, epochs=1, batch_size=10, lr=0.03,
+                    frequency_of_the_test=50)
+    m = _fedavg(data, cfg, "lr")
+    assert m["test_acc"] > 0.75, m
+
+
+def test_row_femnist_lr():
+    """FEMNIST + LR, 200 clients (10/round), bs=10, lr=0.003, E=1,
+    >200 rounds -> 10-40% (benchmark/README.md:13; the published band's
+    FLOOR is the bar)."""
+    data = _load_or_skip("femnist", "femnist", client_num_in_total=200,
+                         batch_size=10)
+    cfg = FedConfig(client_num_in_total=200, client_num_per_round=10,
+                    comm_round=250, epochs=1, batch_size=10, lr=0.003,
+                    frequency_of_the_test=50)
+    m = _fedavg(data, cfg, "lr")
+    assert m["test_acc"] > 0.10, m
+
+
+def test_row_femnist_cnn():
+    """FederatedEMNIST + CNN, 3400 clients (10/round), bs=20, lr=0.1,
+    E=1, >1500 rounds -> 84.9% (benchmark/README.md:54)."""
+    data = _load_or_skip("femnist", "femnist", client_num_in_total=3400,
+                         batch_size=20)
+    cfg = FedConfig(client_num_in_total=3400, client_num_per_round=10,
+                    comm_round=1500, epochs=1, batch_size=20, lr=0.1,
+                    frequency_of_the_test=250)
+    m = _fedavg(data, cfg, "cnn")
+    assert m["test_acc"] > 0.849 - 0.02, m
+
+
+@pytest.mark.parametrize("partition,bar", [("homo", 0.9319),
+                                           ("hetero", 0.8712)])
+def test_row_cifar10_resnet56(partition, bar):
+    """CIFAR10 + ResNet-56, LDA alpha=0.5, 10 clients (10/round), bs=64,
+    lr=0.001, wd=0.001, E=20, 100 rounds -> 93.19 IID / 87.12 non-IID
+    (benchmark/README.md:105)."""
+    import jax.numpy as jnp
+    data = _load_or_skip("cifar10", "cifar10", client_num_in_total=10,
+                         batch_size=64, partition_method=partition,
+                         partition_alpha=0.5)
+    cfg = FedConfig(client_num_in_total=10, client_num_per_round=10,
+                    comm_round=100, epochs=20, batch_size=64, lr=0.001,
+                    wd=0.001, frequency_of_the_test=20, augment=True)
+    from fedml_tpu.data.augment import make_augment_fn
+    m = _fedavg(data, cfg, "resnet56",
+                train_dtype=jnp.bfloat16,
+                augment=make_augment_fn(crop_padding=4, flip=True,
+                                        cutout_length=16))
+    assert m["test_acc"] > bar - 0.02, m

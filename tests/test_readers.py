@@ -130,15 +130,19 @@ def test_leaf_reader_parses_reference_shipped_file():
     assert sum(len(v) for v in idx_map.values()) == len(y)
 
 
-@pytest.mark.skipif(not os.path.isfile(REF_SYNTH),
-                    reason="reference data not mounted")
-def test_baseline_row_synthetic_1_1_real_data():
-    """Reproduce the BASELINE.md synthetic(a,b) row on the reference's OWN
-    shipped data (benchmark/README.md:14-19: 30 clients, 10/round, bs=10,
-    lr=0.01, E=1 -> >60% acc): the first baseline row demonstrable without
-    network egress.  (The image ships only the test split; we train on a
-    per-client 90% slice of it and eval on the held-out 10% — same
-    distribution, same clients, same task dimensionality.)"""
+@pytest.mark.parametrize("variant", ["synthetic_0_0", "synthetic_0.5_0.5",
+                                     "synthetic_1_1"])
+def test_baseline_row_synthetic_real_data(variant):
+    """Reproduce ALL THREE BASELINE.md synthetic(a,b) rows on the
+    reference's OWN shipped data (benchmark/README.md:14-19: 30 clients,
+    10/round, bs=10, lr=0.01, E=1 -> >60% acc): the only baseline rows
+    demonstrable without network egress.  (The image ships only the test
+    split; we train on a per-client 90% slice of it and eval on the
+    held-out 10% — same distribution, same clients, same task
+    dimensionality.)"""
+    ref_dir = f"/root/reference/data/{variant}/test"
+    if not os.path.isdir(ref_dir):
+        pytest.skip("reference data not mounted")
     import jax
     from fedml_tpu.algorithms import FedAvgEngine
     from fedml_tpu.core import ClientTrainer
@@ -147,7 +151,7 @@ def test_baseline_row_synthetic_1_1_real_data():
     from fedml_tpu.models import create_model
     from fedml_tpu.utils.config import FedConfig
 
-    users, ud = readers.read_leaf_dir(os.path.dirname(REF_SYNTH))
+    users, ud = readers.read_leaf_dir(ref_dir)
     x, y, idx_map = readers.leaf_to_arrays(users, ud)
     tr_map, te_idx = {}, []
     for k, idx in idx_map.items():
