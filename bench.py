@@ -68,7 +68,7 @@ def main() -> None:
     # artifact distinguishes "no chip" from a perf regression
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "420"))
     ok, detail = _probe_devices(probe_timeout)
-    if ok and detail == "cpu" and not os.environ.get("JAX_PLATFORMS"):
+    if ok and detail == "cpu" and os.environ.get("JAX_PLATFORMS") != "cpu":
         # the tunnel backend failed FAST and jax fell through to the
         # sitecustomize's cpu fallback: without an explicit
         # JAX_PLATFORMS=cpu opt-in, a cpu bench would record a ~100x
@@ -126,9 +126,9 @@ def main() -> None:
     # bf16 compute / f32 masters: the MXU fast path (core/trainer.py)
     trainer = ClientTrainer(model, lr=cfg.lr, train_dtype=jnp.bfloat16)
     mesh = make_mesh()
-    # chunk=4 + bf16 local masters: the measured v5e optimum
-    # (tools/profile_bench.py L4; PERF.md round-2 decomposition)
-    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh, chunk=4,
+    # chunk=2 + bf16 local masters: the measured v5e optimum
+    # (tools/profile_bench.py L2 1.851 s/round; PERF.md round-3 table)
+    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh, chunk=2,
                               local_dtype=jnp.bfloat16)
 
     variables = engine.init_variables()

@@ -95,6 +95,13 @@ def pad_and_chunk(cohort, weights, rngs, chunk_cap: int):
     return jax.tree.map(resh, cohort), resh(weights), resh(rngs)
 
 
+def default_chunk(local_dtype) -> int:
+    """Measured v5e chunk optima (tools/profile_bench.py, PERF.md): the
+    L-curve bottoms at 2 with bf16 local masters (1.851 s/round vs 2.080
+    at 4, 1.920 at 1); with f32 masters the F-curve bottoms at 8."""
+    return 2 if local_dtype == jnp.bfloat16 else 8
+
+
 def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
                            epochs, vary_axes, chunk_cap: int = 8,
                            client_transform=None,
@@ -181,7 +188,9 @@ class MeshFedAvgEngine(FedAvgEngine):
                  streaming: bool = False, local_dtype=None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = int(np.prod(list(self.mesh.shape.values())))
-        self.chunk = chunk
+        if chunk is not None and chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.chunk = chunk if chunk is not None else default_chunk(local_dtype)
         self.streaming = streaming
         self.local_dtype = local_dtype
         super().__init__(trainer, data, cfg, donate=donate)
@@ -251,7 +260,7 @@ class MeshFedAvgEngine(FedAvgEngine):
         local_vars = cast_local(variables, self.local_dtype)
         num, den, lsum = chunked_weighted_train(
             self.trainer, local_vars, cohort, weights, client_rngs,
-            self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk or 8,
+            self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk,
             client_transform=self.client_transform)
         num = jax.lax.psum(num, axes)
         den = jax.lax.psum(den, axes)
@@ -486,7 +495,7 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
         epochs = self.cfg.epochs
         trainer = self.trainer
         ch_cohort, ch_w, ch_r = pad_and_chunk(
-            cohort, weights, client_rngs, self.chunk or 8)
+            cohort, weights, client_rngs, self.chunk)
 
         from fedml_tpu.algorithms.fednova import fednova_tau
 
@@ -606,7 +615,7 @@ class MeshRobustEngine(MeshFedAvgEngine):
         # path with the norm_clip/FedAvg engines)
         num, den, lsum, flats = chunked_weighted_train(
             self.trainer, local_vars, cohort, weights, client_rngs,
-            self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk or 8,
+            self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk,
             emit_flat_params=True)
         rest_num = {k: v for k, v in num.items() if k != "params"}
         # [n_chunks, chunk, P] -> this shard's clients; drop the in-chunk

@@ -31,7 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.trainer import ClientTrainer
 from fedml_tpu.data.federated import FederatedData
-from fedml_tpu.parallel.engine import cast_local, chunked_weighted_train
+from fedml_tpu.parallel.engine import (cast_local, chunked_weighted_train,
+                                       default_chunk)
 from fedml_tpu.parallel.mesh import (CLIENT_AXIS, SILO_AXIS, make_mesh_2d,
                                      pvary_tree)
 from fedml_tpu.utils.config import FedConfig
@@ -53,7 +54,10 @@ class MeshHierarchicalEngine(FedAvgEngine):
                  group_comm_round: int = 1,
                  mesh: Optional[Mesh] = None, donate: bool = True,
                  chunk: Optional[int] = None, local_dtype=None):
-        self.chunk = chunk
+        if chunk is not None and chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.chunk = (chunk if chunk is not None
+                      else default_chunk(local_dtype))
         self.local_dtype = local_dtype   # bf16 local masters (engine.py)
         self.mesh = mesh if mesh is not None else make_mesh_2d(n_silos)
         self.n_silos = self.mesh.shape[SILO_AXIS]
@@ -145,7 +149,7 @@ class MeshHierarchicalEngine(FedAvgEngine):
                 num, den, lsum = chunked_weighted_train(
                     trainer, local_vars, cohort, weights, crngs, epochs,
                     vary_axes=(SILO_AXIS, CLIENT_AXIS),
-                    chunk_cap=self.chunk or 8)
+                    chunk_cap=self.chunk)
                 num = jax.lax.psum(num, CLIENT_AXIS)        # ICI tier
                 den = jax.lax.psum(den, CLIENT_AXIS)
                 silo_vars = jax.tree.map(

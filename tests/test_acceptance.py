@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import os
 
-import numpy as np
 import pytest
 
 from fedml_tpu.data.loaders import load_data

@@ -213,6 +213,11 @@ def exp_H32():
           flush=True)
 
 
+def exp_L1():
+    print(f"L1 chunked(1,bf16 masters): "
+          f"{_bf16_master_round(1):.3f}s/round", flush=True)
+
+
 def exp_L2():
     print(f"L2 chunked(2,bf16 masters): "
           f"{_bf16_master_round(2):.3f}s/round", flush=True)
@@ -253,6 +258,20 @@ def _conv_formulation(kind, k=8, b=32, h=32, w=32, cin=64, cout=64,
                 xi, wi, (1, 1), "SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
         f = jax.vmap(conv1)
+    elif kind == "fgc":
+        def f(xs, ws):
+            # feature-group-count merge: client i's batch slots share the
+            # batch dim with every other client (conv is per-sample
+            # independent), while its channels live in block i — one
+            # grouped conv with k*cin inputs / k*cout outputs, so the
+            # channel dims fill the MXU even when cin=cout=64
+            xg = xs.transpose(1, 2, 3, 0, 4).reshape(b, h, w, k * cin)
+            wg = ws.transpose(1, 2, 3, 0, 4).reshape(3, 3, cin, k * cout)
+            out = jax.lax.conv_general_dilated(
+                xg, wg, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=k)
+            return out.reshape(b, h, w, k, cout).transpose(3, 0, 1, 2, 4)
     else:
         def f(xs, ws):
             # im2col: [k, b*h*w, 9*cin] patches, then one batched matmul
@@ -290,6 +309,34 @@ def exp_CONV():
               f"im2col {ti*1e3:.2f}ms  ratio {tv/ti:.2f}x", flush=True)
 
 
+def exp_PAD():
+    """Absolute cost of widening cout 64->128 on the stem shape (VERDICT r2
+    next-#2 cout-padding lever): a padded-channel model variant only wins if
+    the 128-wide conv costs ~the same wall time as the 64-wide one (the MXU
+    columns were half-idle).  2x time = exactly proportional = padding loses."""
+    for k in [4, 2]:
+        t64 = _conv_formulation("vmap", k=k, cin=64, cout=64, h=32, w=32)
+        t128 = _conv_formulation("vmap", k=k, cin=64, cout=128, h=32, w=32)
+        tw = _conv_formulation("vmap", k=k, cin=128, cout=128, h=32, w=32)
+        print(f"PAD k={k}@32: cout64 {t64*1e3:.2f}ms  cout128 "
+              f"{t128*1e3:.2f}ms ({t128/t64:.2f}x)  both128 "
+              f"{tw*1e3:.2f}ms ({tw/t64:.2f}x)", flush=True)
+
+
+def exp_FGC():
+    """Per-client conv as ONE feature-group-count conv (clients side-by-side
+    in the channel dim) vs the vmapped conv — the block-diagonal-matmul
+    formulation of the per-client grouped conv (VERDICT r2 next-#2)."""
+    for k in [4, 8]:
+        for cin, cout, hw in [(64, 64, 32), (128, 128, 16), (256, 256, 8)]:
+            tv = _conv_formulation("vmap", k=k, cin=cin, cout=cout,
+                                   h=hw, w=hw)
+            tf = _conv_formulation("fgc", k=k, cin=cin, cout=cout,
+                                   h=hw, w=hw)
+            print(f"FGC k={k} {cin}x{cout}@{hw}: vmap {tv*1e3:.2f}ms  "
+                  f"fgc {tf*1e3:.2f}ms  ratio {tv/tf:.2f}x", flush=True)
+
+
 def _barrier_gn_model():
     """ResNet-18-GN with norm_fusion_barrier=True (models/resnet_gn.py):
     optimization_barriers before every GroupNorm stop XLA from output-
@@ -317,13 +364,17 @@ def exp_R():
     from fedml_tpu.core import robust as robust_ops
     from fedml_tpu.ops import robust_weighted_mean_pallas
 
+    # 64 clients: the 128-stack + the pallas kernel's padded temps exceed
+    # v5e HBM (measured 16.03G/15.75G, 2026-07-30) — the XLA pipeline alone
+    # fits 128, which is itself a datum for the kernel-default question
+    K = 64
     model = create_model("resnet18_gn", output_dim=10)
     g = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
                    train=False)["params"]
     stacked = jax.tree.map(
-        lambda a: a[None] + 0.01 * jnp.arange(N_CLIENTS).reshape(
-            (N_CLIENTS,) + (1,) * a.ndim).astype(a.dtype), g)
-    w = jnp.full((N_CLIENTS,), float(SPC), jnp.float32)
+        lambda a: a[None] + 0.01 * jnp.arange(K).reshape(
+            (K,) + (1,) * a.ndim).astype(a.dtype), g)
+    w = jnp.full((K,), float(SPC), jnp.float32)
     tau = 5.0
 
     def xla_pipeline(stacked, w, g):
@@ -344,9 +395,79 @@ def exp_R():
               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
     tx = timeit(lambda: f_xla(stacked, w, g), warmup=2, iters=10)
     tp = timeit(lambda: f_pal(stacked, w, g), warmup=2, iters=10)
-    print(f"R robust-agg 128xResNet18: xla {tx*1e3:.1f}ms  "
+    print(f"R robust-agg {K}xResNet18: xla {tx*1e3:.1f}ms  "
           f"pallas {tp*1e3:.1f}ms  ratio {tx/tp:.2f}x  maxerr {err:.2e}",
           flush=True)
+
+
+def exp_SCAN():
+    """run_scanned vs the jitted per-round loop at ms-scale rounds (VERDICT
+    r2 next-#6): LR on MNIST shapes, 1000-client cross-device sim, 10
+    clients/round — the regime where per-round dispatch could dominate and
+    in-program multi-round scan() should pay if it ever does."""
+    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+    from fedml_tpu.utils.config import FedConfig
+
+    C, spc, bs = 1000, 20, 10
+    rs = np.random.RandomState(0)
+    n = C * spc
+    x = rs.rand(n, 784).astype(np.float32)
+    y = rs.randint(0, 10, n).astype(np.int64)
+    idx = {i: np.arange(i * spc, (i + 1) * spc) for i in range(C)}
+    ev = build_eval_shard(x[:bs], y[:bs], bs)
+    data = FederatedData(
+        train_data_num=n, test_data_num=n, train_global=ev, test_global=ev,
+        client_shards=build_client_shards(x, y, idx, bs),
+        client_num_samples=np.full(C, spc, np.float32),
+        test_client_shards=None, class_num=10, synthetic=True)
+    cfg = FedConfig(model="lr", dataset="mnist", client_num_in_total=C,
+                    client_num_per_round=10, epochs=1, batch_size=bs,
+                    lr=0.03, frequency_of_the_test=10_000)
+    model = create_model("lr", input_dim=784, output_dim=10)
+    trainer = ClientTrainer(model, lr=cfg.lr)
+    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(),
+                              donate=False)
+    variables = engine.init_variables()
+    server_state = engine.server_init(variables)
+    stack, stack_w = engine._device_stack()
+    rng = jax.random.PRNGKey(0)
+
+    R = 100
+    # (a) the jitted per-round loop: host dispatch every round
+    ids, wmask = engine.sample_padded(0)
+    v, s = variables, server_state
+    for _ in range(2):
+        v, s, m = engine.round_fn(v, s, stack, stack_w, ids, wmask, rng)
+    force(m["train_loss"])
+    t0 = time.perf_counter()
+    v, s = variables, server_state
+    for r in range(R):
+        ids, wmask = engine.sample_padded(r)
+        v, s, m = engine.round_fn(v, s, stack, stack_w, ids, wmask, rng)
+    force(m["train_loss"])
+    t_loop = (time.perf_counter() - t0) / R
+
+    # (b) run_scanned: R rounds as scan blocks of 50.  Each call evals
+    # twice (round 0 is a cadence point, + the final block), which the
+    # loop timing above excludes — measure the warm eval cost and
+    # subtract it so the comparison is per-ROUND on both sides.
+    engine.run_scanned(R, block=50)          # compile + warm
+    ve = engine._prepare_variables(engine.init_variables())
+    for _ in range(2):
+        engine.evaluate(ve)                  # blocking (returns floats)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        engine.evaluate(ve)
+    t_eval = (time.perf_counter() - t0) / 5
+    t0 = time.perf_counter()
+    engine.run_scanned(R, block=50)
+    t_scan = (time.perf_counter() - t0 - 2 * t_eval) / R
+    print(f"SCAN lr/mnist 1000x10: loop {t_loop*1e3:.2f}ms/round  "
+          f"scanned {t_scan*1e3:.2f}ms/round (eval-corrected)  "
+          f"ratio {t_loop/t_scan:.2f}x", flush=True)
 
 
 def exp_U8():
