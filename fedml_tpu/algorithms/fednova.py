@@ -20,12 +20,19 @@ from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.pytree import tree_sub
 
 
-def fednova_tau(shard, epochs):
+def fednova_tau(shard, epochs, batch_axes=()):
     """tau_i = local optimization steps that saw real data: non-empty
     batches x epochs (the reference's step counter,
-    fednova.py local_normalizing_vec)."""
-    nonempty = jnp.sum((jnp.sum(shard["mask"], axis=1) > 0)
-                       .astype(jnp.float32))
+    fednova.py local_normalizing_vec).
+
+    Under a batch-split mesh (`batch_axes`) a step counts when the batch
+    has valid samples on ANY shard — matching train_step's global
+    empty-batch guard — so per-batch counts are psum'd first."""
+    counts = jnp.sum(shard["mask"], axis=1)
+    if batch_axes:
+        counts = jax.lax.pcast(jax.lax.psum(counts, batch_axes),
+                               batch_axes, to="varying")
+    nonempty = jnp.sum((counts > 0).astype(jnp.float32))
     return nonempty * epochs
 
 

@@ -117,6 +117,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "f32 (the measured v5e bench recipe, PERF.md)")
     p.add_argument("--mesh", action="store_true",
                    help="shard the cohort over all visible devices")
+    p.add_argument("--mesh_batch", type=int, default=None,
+                   help="with --mesh: fold this many devices into a "
+                        "'batch' axis (clients x batch mesh) — each "
+                        "client's per-step batch splits over it with a "
+                        "per-step grad psum (per-client sample "
+                        "parallelism for chips > cohort; must divide "
+                        "both the device count and the batch size)")
     p.add_argument("--multihost", action="store_true",
                    help="join the multi-host runtime first "
                         "(jax.distributed.initialize; replaces mpirun)")
@@ -243,14 +250,29 @@ def build_engine(args, cfg: FedConfig, data):
     """Algorithm dispatch (the reference's fed_launch algorithm select)."""
     algo = args.algorithm
     mesh = None
-    if (args.streaming or args.cohort_chunk or args.local_dtype) \
-            and not args.mesh:
-        raise SystemExit("--streaming/--cohort_chunk/--local_dtype require "
-                         "--mesh (they configure the mesh engine's cohort "
-                         "path)")
+    if args.mesh_batch is not None and args.mesh_batch < 1:
+        raise SystemExit(f"--mesh_batch must be >= 1, got {args.mesh_batch}")
+    if (args.streaming or args.cohort_chunk or args.local_dtype
+            or args.mesh_batch) and not args.mesh:
+        raise SystemExit("--streaming/--cohort_chunk/--local_dtype/"
+                         "--mesh_batch require --mesh (they configure the "
+                         "mesh engine's cohort path)")
     if args.mesh:
-        from fedml_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh()
+        from fedml_tpu.parallel.mesh import make_mesh, make_mesh_batch
+        if args.mesh_batch:
+            if algo not in ("fedavg", "fedopt", "fedprox", "fednova",
+                            "fedavg_robust", "fedseg"):
+                raise SystemExit(f"--mesh_batch supports the FedAvg-family "
+                                 f"mesh engines, not {algo!r}")
+            import jax as _jax
+            n_dev = len(_jax.devices())
+            if n_dev % args.mesh_batch:
+                raise SystemExit(f"--mesh_batch {args.mesh_batch} must "
+                                 f"divide the device count ({n_dev})")
+            mesh = make_mesh_batch(n_dev // args.mesh_batch,
+                                   args.mesh_batch)
+        else:
+            mesh = make_mesh()
 
     if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
                                          "fednova", "fedavg_robust",

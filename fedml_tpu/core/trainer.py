@@ -169,6 +169,14 @@ class ClientTrainer:
         reference's poly/cos/step LR_Scheduler; restarts per local round
         because opt state is re-initialized per local_train — parity).
       loss: "ce" | "bce" | "focal" (focal: fedseg utils.py:97, γ=2 α=0.5).
+      batch_axes: shard_map mesh axis names that split each per-step
+        batch's SAMPLE dim across devices (parallel/mesh.py BATCH_AXIS).
+        When set, every train step computes the full-batch gradient with
+        one psum: the loss normalizes by the GLOBAL valid-sample count,
+        grads/loss are psum'd and the empty-batch guard keys on the
+        global count — so the trained weights are those of the unsplit
+        batch (bit-level up to reduction order).  Mesh engines set this
+        automatically when their mesh has a "batch" axis.
     """
 
     def __init__(self, model, loss: str = "ce", optimizer: str = "sgd",
@@ -178,7 +186,8 @@ class ClientTrainer:
                  train_dtype=jnp.float32,
                  augment: Optional[Callable] = None,
                  eval_ignore_id: Optional[int] = None,
-                 train_ignore_id: Optional[int] = None):
+                 train_ignore_id: Optional[int] = None,
+                 batch_axes: tuple = ()):
         self.model = model
         self.loss_name = loss
         if loss not in ("ce", "bce", "focal"):
@@ -193,6 +202,14 @@ class ClientTrainer:
         self.augment = augment
         self.eval_ignore_id = eval_ignore_id
         self.train_ignore_id = train_ignore_id
+        self.batch_axes = tuple(batch_axes)
+
+    def _revary(self, tree):
+        """psum over batch_axes makes a value invariant along them; cast it
+        back to varying so it composes with the (pvary'd) params/opt state
+        under shard_map's vma type check.  Values are unchanged."""
+        return jax.tree.map(
+            lambda a: jax.lax.pcast(a, self.batch_axes, to="varying"), tree)
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array, sample_input: jax.Array) -> Pytree:
@@ -213,6 +230,14 @@ class ClientTrainer:
         is bfloat16 the forward/backward compute runs through bf16 casts —
         the MXU recipe: bf16 matmuls, f32 accumulation and update."""
         x, y, mask = batch["x"], batch["y"], batch["mask"]
+        if self.batch_axes:
+            # decorrelate the sample-wise randomness (augment offsets,
+            # dropout masks) across batch shards: the carried rng is
+            # replicated along the batch axes, and augment draws (bs,)
+            # vectors from it — without the fold-in, sample i on every
+            # shard would share its crop/flip/cutout draw
+            for ax in self.batch_axes:
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
         if self.augment is not None:
             rng, aug_rng = jax.random.split(rng)
             x = self.augment(aug_rng, x)
@@ -251,11 +276,24 @@ class ClientTrainer:
             loss = masked_bce(logits, y, mask)
         else:
             loss = masked_focal_loss(logits, y, mask)
+        if self.batch_axes:
+            # batch-split normalization: the masked losses divide by this
+            # SHARD's valid count; rescale to S_l / C_g so the psum over
+            # the batch axes (train_step) yields the unsplit batch's mean
+            c_l = jnp.sum(mask.astype(jnp.float32))
+            c_g = self._revary(jax.lax.psum(c_l, self.batch_axes))
+            loss = loss * c_l / jnp.maximum(c_g, 1.0)
         if self.prox_mu > 0.0 and global_params is not None:
             sq = jax.tree.map(lambda a, b: jnp.sum(jnp.square(a - b)),
                               params, global_params)
-            loss = loss + 0.5 * self.prox_mu * jnp.sum(
+            prox = 0.5 * self.prox_mu * jnp.sum(
                 jnp.stack(jax.tree.leaves(sq)))
+            if self.batch_axes:
+                # the prox term is computed identically on every batch
+                # shard; divide by the axis size so its psum counts once
+                prox = prox / self._revary(
+                    jax.lax.psum(jnp.float32(1), self.batch_axes))
+            loss = loss + prox
         return loss, new_rest
 
     # -- one SGD step -------------------------------------------------------
@@ -264,14 +302,26 @@ class ClientTrainer:
         rng, step_rng = jax.random.split(state.rng)
         (loss, new_rest), grads = jax.value_and_grad(self._loss, has_aux=True)(
             params, rest, batch, step_rng, global_params)
+        n_valid = jnp.sum(batch["mask"])
+        if self.batch_axes:
+            # the full-batch gradient: each shard computed S_l/C_g-normalized
+            # grads over its sample slice; one psum per step completes them.
+            # Every batch shard then applies the IDENTICAL update, keeping
+            # the per-client weights replicated along the batch axes.
+            grads = self._revary(jax.lax.psum(grads, self.batch_axes))
+            loss = self._revary(jax.lax.psum(loss, self.batch_axes))
+            new_rest = self._revary(jax.lax.pmean(new_rest, self.batch_axes))
+            n_valid = self._revary(jax.lax.psum(n_valid, self.batch_axes))
         updates, opt_state = self.tx.update(grads, state.opt_state, params)
         # empty-batch guard: for params, scaling the UPDATES by the has-data
         # flag is exactly equivalent to a post-hoc select (additive updates;
         # u*0 leaves params bitwise unchanged) but fuses into apply_updates
         # instead of costing an extra full-tree pass per step.  Stats
         # collections and optimizer state are not additive, so they keep the
-        # select (core/pytree.py:tree_select).
-        has_data = jnp.sum(batch["mask"]) > 0
+        # select (core/pytree.py:tree_select).  Under batch_axes the guard
+        # keys on the GLOBAL count — a shard whose slice is all padding must
+        # still apply the other shards' gradient contribution.
+        has_data = n_valid > 0
         g = has_data.astype(jnp.float32)
         new_params = optax.apply_updates(
             params, jax.tree.map(lambda u: u * g.astype(u.dtype), updates))
@@ -307,7 +357,10 @@ class ClientTrainer:
 
         def batch_body(state, batch):
             state, loss = self.train_step(state, batch, global_params)
-            return state, (loss, jnp.sum(batch["mask"]))
+            cnt = jnp.sum(batch["mask"])
+            if self.batch_axes:   # loss is already global; weight it globally
+                cnt = self._revary(jax.lax.psum(cnt, self.batch_axes))
+            return state, (loss, cnt)
 
         def epoch_body(state, _):
             state, (losses, counts) = jax.lax.scan(batch_body, state, shard,
@@ -317,6 +370,8 @@ class ClientTrainer:
 
         state, epoch_losses = jax.lax.scan(epoch_body, state, None, length=epochs)
         n = jnp.sum(shard["mask"])
+        if self.batch_axes:   # the client's TOTAL sample count (agg weight)
+            n = self._revary(jax.lax.psum(n, self.batch_axes))
         return state.variables, jnp.mean(epoch_losses), n
 
     # -- eval ---------------------------------------------------------------

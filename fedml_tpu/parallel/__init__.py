@@ -16,8 +16,9 @@ over ICI.  Hierarchical FL maps onto a 2-D mesh — inner `psum` over the
 intra-silo axis (ICI), outer `psum` over the cross-silo axis (DCN) — and
 decentralized gossip is `lax.ppermute` neighbor exchange over a mesh ring.
 """
-from fedml_tpu.parallel.mesh import (make_mesh, client_sharding,
-                                     replicated_sharding, shard_cohort)
+from fedml_tpu.parallel.mesh import (make_mesh, make_mesh_batch,
+                                     client_sharding, replicated_sharding,
+                                     shard_cohort)
 from fedml_tpu.parallel.engine import (MeshFedAvgEngine, MeshFedNovaEngine,
                                        MeshFedOptEngine, MeshFedProxEngine,
                                        MeshRobustEngine)

@@ -32,8 +32,10 @@ from fedml_tpu.algorithms.fedopt import make_server_optimizer
 from fedml_tpu.core import robust as robust_ops
 from fedml_tpu.core.trainer import ClientTrainer
 from fedml_tpu.data.federated import FederatedData
-from fedml_tpu.parallel.mesh import (client_sharding, make_mesh,
-                                     pvary_tree, replicated_sharding)
+from fedml_tpu.parallel.mesh import (BATCH_AXIS, client_axes,
+                                     client_sharding, make_mesh, pvary_tree,
+                                     replicated_sharding, shard_stack,
+                                     stack_leaf_sharding, stack_leaf_spec)
 from fedml_tpu.utils.config import FedConfig
 
 log = logging.getLogger(__name__)
@@ -180,14 +182,50 @@ class MeshFedAvgEngine(FedAvgEngine):
     psum in f32, and the global model stays f32 across rounds (the server
     average's small increments need the f32 grid; the 13 local steps at
     lr≫ulp do not).  Measured on v5e: 2.310 → 2.080 s/round at chunk 4
-    (tools/profile_bench.py L4 vs F8)."""
+    (tools/profile_bench.py L4 vs F8).
+
+    A mesh with a "batch" axis (make_mesh_batch) additionally splits each
+    client's per-step batch over that axis — per-client SAMPLE parallelism
+    for when chips outnumber the cohort.  The trainer completes each
+    step's gradient with one psum over the batch axis (ClientTrainer
+    batch_axes; set here automatically), so per-client weights stay
+    replicated along it and the round's result equals the unsplit run.
+    The cohort pads/shards over the CLIENT axes only.  Models whose
+    normalization is per-sample (GroupNorm/LayerNorm — incl. the flagship
+    ResNet-18-GN) are oracle-equal to the unsplit run; plain BatchNorm
+    would normalize by shard-local statistics, so engines reject a
+    batch_stats collection under a batch axis unless
+    `allow_batch_stats=True` asserts the model's BN is the cross-replica
+    variant bound to the "batch" axis (models/norms.py::sync_batch_norm
+    with axis_name="batch")."""
 
     def __init__(self, trainer: ClientTrainer, data: FederatedData,
                  cfg: FedConfig, mesh: Optional[Mesh] = None,
                  donate: bool = True, chunk: Optional[int] = None,
-                 streaming: bool = False, local_dtype=None):
+                 streaming: bool = False, local_dtype=None,
+                 allow_batch_stats: bool = False):
+        self.allow_batch_stats = allow_batch_stats
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_shards = int(np.prod(list(self.mesh.shape.values())))
+        # a "batch" mesh axis splits each client's per-step batch over
+        # devices (per-client sample parallelism: mesh.py BATCH_AXIS, the
+        # chips>cohort scaling axis).  The cohort pads to the CLIENT axes
+        # only; the trainer gains a per-step grad psum over the batch axes.
+        self.client_axes = client_axes(self.mesh)
+        self.batch_axes = tuple(a for a in self.mesh.axis_names
+                                if a == BATCH_AXIS)
+        self.n_shards = int(np.prod([self.mesh.shape[a]
+                                     for a in self.client_axes]))
+        if self.batch_axes:
+            nb = self.mesh.shape[BATCH_AXIS]
+            bs = int(np.shape(data.client_shards["mask"])[2])
+            if bs % nb:
+                raise ValueError(
+                    f"batch mesh axis ({nb}) must divide the per-step "
+                    f"batch size ({bs})")
+            if getattr(trainer, "batch_axes", ()) != self.batch_axes:
+                import copy
+                trainer = copy.copy(trainer)
+                trainer.batch_axes = self.batch_axes
         if chunk is not None and chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.chunk = chunk if chunk is not None else default_chunk(local_dtype)
@@ -231,9 +269,9 @@ class MeshFedAvgEngine(FedAvgEngine):
             shards, weights = self.data.client_shards, self.data.client_num_samples
             shards, weights = pad_cohort(dict(shards), np.asarray(
                 weights, np.float32), self.n_shards)
-            sh = client_sharding(self.mesh)
-            self._stack = {k: jax.device_put(v, sh) for k, v in shards.items()}
-            self._stack_weights = jax.device_put(weights.astype(np.float32), sh)
+            self._stack = shard_stack(self.mesh, shards)
+            self._stack_weights = jax.device_put(
+                weights.astype(np.float32), client_sharding(self.mesh))
         return self._stack, self._stack_weights
 
     def _upload_eval_stack(self, shards):
@@ -245,8 +283,7 @@ class MeshFedAvgEngine(FedAvgEngine):
         C = jax.tree.leaves(shards)[0].shape[0]
         shards, _ = pad_cohort(dict(shards),
                                np.zeros(C, np.float32), self.n_shards)
-        sh = client_sharding(self.mesh)
-        return {k: jax.device_put(v, sh) for k, v in shards.items()}
+        return shard_stack(self.mesh, shards)
 
     # -- the round program ----------------------------------------------------
     def _shard_body(self, variables, cohort, weights, client_rngs):
@@ -276,12 +313,14 @@ class MeshFedAvgEngine(FedAvgEngine):
         update — so subclass overrides of _shard_body/server_update apply to
         BOTH paths identically."""
         mesh = self.mesh
-        csh = P(mesh.axis_names)
+        csh = P(self.client_axes)
+        cohort_specs = {k: stack_leaf_spec(mesh, v)
+                        for k, v in cohort.items()}
         rng, agg_rng = jax.random.split(rng)
         client_rngs = jax.random.split(rng, weights.shape[0])
         avg, train_loss = jax.shard_map(
             self._shard_body, mesh=mesh,
-            in_specs=(P(), csh, csh, csh), out_specs=(P(), P()))(
+            in_specs=(P(), cohort_specs, csh, csh), out_specs=(P(), P()))(
                 variables, cohort, weights, client_rngs)
         new_variables, server_state = self.server_update(
             avg, variables, server_state, agg_rng)
@@ -291,9 +330,8 @@ class MeshFedAvgEngine(FedAvgEngine):
                     wmask, rng):
         # cohort gather: device-side take along the sharded client axis; XLA
         # lowers the cross-shard gather to ICI collectives.
-        csh = P(self.mesh.axis_names)
         cohort = {k: jax.lax.with_sharding_constraint(
-            jnp.take(v, ids, axis=0), NamedSharding(self.mesh, csh))
+            jnp.take(v, ids, axis=0), stack_leaf_sharding(self.mesh, v))
             for k, v in stack.items()}
         weights = jnp.take(stack_w, ids) * wmask
         return self._train_and_update(variables, server_state, cohort,
@@ -313,12 +351,13 @@ class MeshFedAvgEngine(FedAvgEngine):
         uploading only the cohort (chunk-multiple padding happens inside
         chunked_weighted_train)."""
         ids, wmask = self._sample_padded_np(round_idx)
-        sh = client_sharding(self.mesh)
-        cohort = {k: jax.device_put(np.take(np.asarray(v), ids, axis=0), sh)
+        cohort = {k: jax.device_put(np.take(np.asarray(v), ids, axis=0),
+                                    stack_leaf_sharding(self.mesh, v))
                   for k, v in self.data.client_shards.items()}
         weights = jax.device_put(
             np.take(np.asarray(self.data.client_num_samples,
-                               np.float32), ids) * wmask, sh)
+                               np.float32), ids) * wmask,
+            client_sharding(self.mesh))
         return cohort, weights
 
     # -- fully on-device multi-round training --------------------------------
@@ -416,6 +455,16 @@ class MeshFedAvgEngine(FedAvgEngine):
 
     # the base FedAvgEngine.run drives the loop through these two hooks
     def _prepare_variables(self, variables: Pytree) -> Pytree:
+        if self.batch_axes and not self.allow_batch_stats and any(
+                k != "params" for k in variables):
+            raise ValueError(
+                "model carries a stats collection "
+                f"({[k for k in variables if k != 'params']}) and the mesh "
+                "has a 'batch' axis: plain BatchNorm would normalize by "
+                "shard-local statistics.  Use per-sample normalization "
+                "(GroupNorm/LayerNorm), or sync_batch_norm(axis_name="
+                "'batch') (models/norms.py) and pass "
+                "allow_batch_stats=True")
         return jax.device_put(variables, replicated_sharding(self.mesh))
 
     def _round_args(self, round_idx: int) -> tuple:
@@ -502,7 +551,7 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
         def one(shard, crng):
             v, loss, _n = trainer.local_train(local_vars, shard, crng,
                                               epochs)
-            return v, loss, fednova_tau(shard, epochs)
+            return v, loss, fednova_tau(shard, epochs, self.batch_axes)
 
         def split(v):
             return v["params"], {k: x for k, x in v.items() if k != "params"}
@@ -574,6 +623,11 @@ class MeshRobustEngine(MeshFedAvgEngine):
         self.defense = defense
         self.n_byzantine = n_byzantine
         super().__init__(trainer, data, cfg, **kw)
+        if defense != "norm_clip" and self.batch_axes:
+            # the order-stat scatter offsets index CLIENT rows per shard;
+            # a batch axis would duplicate rows at distinct offsets
+            raise ValueError(f"defense {defense!r} does not support a "
+                             f"'batch' mesh axis (norm_clip does)")
         if defense != "norm_clip":
             K = min(cfg.client_num_per_round, data.client_num)
             if K % self.n_shards:

@@ -9,6 +9,10 @@ Axis conventions used throughout the framework:
 
   "clients"  — the federated data-parallel axis (cohort dimension K).
   "silo"     — the cross-silo / DCN tier for hierarchical FL (2-D meshes).
+  "batch"    — per-client sample parallelism (each client's per-step batch
+               split over devices, grads psum'd per step): the scaling
+               axis once chips outnumber the cohort (PERF.md v4-128
+               projection break #1/#2).
 
 Multi-host note: on a real pod these helpers take `jax.devices()` spanning
 hosts; ICI carries the "clients" psum within a slice and DCN the "silo"
@@ -26,6 +30,7 @@ Pytree = Any
 
 CLIENT_AXIS = "clients"
 SILO_AXIS = "silo"
+BATCH_AXIS = "batch"
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -49,6 +54,18 @@ def make_mesh_2d(n_silos: int, per_silo: Optional[int] = None,
     return Mesh(grid, (SILO_AXIS, CLIENT_AXIS))
 
 
+def make_mesh_batch(n_client_shards: int, n_batch: int,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """2-D (clients × batch) mesh: the cohort splits over the first axis
+    and each client's per-step batch over the second.  This is the layout
+    for chips > cohort (PERF.md projection break #2): with K clients and
+    N = K·b chips, every client trains on b devices at once."""
+    devs = list(devices) if devices is not None else jax.devices()
+    devs = devs[: n_client_shards * n_batch]
+    grid = np.array(devs).reshape(n_client_shards, n_batch)
+    return Mesh(grid, (CLIENT_AXIS, BATCH_AXIS))
+
+
 def pvary_tree(tree: Pytree, axis_names) -> Pytree:
     """Mark a replicated pytree as varying over `axis_names` inside
     shard_map (needed before per-shard scans/vmaps mutate it, else the
@@ -57,10 +74,51 @@ def pvary_tree(tree: Pytree, axis_names) -> Pytree:
         lambda a: jax.lax.pcast(a, axis_names, to="varying"), tree)
 
 
+def client_axes(mesh: Mesh) -> tuple:
+    """The mesh axes that shard the CLIENT dimension — every axis except
+    "batch" (which shards within-client samples instead)."""
+    return tuple(a for a in mesh.axis_names if a != BATCH_AXIS)
+
+
 def client_sharding(mesh: Mesh) -> NamedSharding:
     """Shard a [K, ...] cohort/stack along its leading (client) axis over
-    every mesh axis — on a 2-D mesh clients are split over silo×clients."""
-    return NamedSharding(mesh, P(mesh.axis_names))
+    the client axes — on a silo×clients mesh clients split over both; a
+    "batch" axis never shards the client dim (replicated there)."""
+    return NamedSharding(mesh, P(client_axes(mesh)))
+
+
+def _splits_batch(mesh: Mesh, leaf) -> bool:
+    """Whether a stack leaf's per-step sample dim (axis 2) splits over the
+    "batch" axis.  A non-dividing sample dim falls back to replication
+    along "batch" — still numerically correct (each shard then holds the
+    full batch and the trainer's S/C_g normalization makes the per-step
+    psum a mean over identical contributions), just without the split."""
+    return (BATCH_AXIS in mesh.axis_names and np.ndim(leaf) >= 3
+            and np.shape(leaf)[2] % mesh.shape[BATCH_AXIS] == 0)
+
+
+def stack_leaf_sharding(mesh: Mesh, leaf) -> NamedSharding:
+    """Per-leaf sharding for a client data stack {x,y,mask}[C,B,bs,...]:
+    the client dim over the client axes and — when the mesh has a "batch"
+    axis — the per-step sample dim (axis 2) over it.  Weight/[C] leaves
+    fall back to client_sharding."""
+    ca = client_axes(mesh)
+    if _splits_batch(mesh, leaf):
+        return NamedSharding(mesh, P(ca, None, BATCH_AXIS))
+    return NamedSharding(mesh, P(ca))
+
+
+def stack_leaf_spec(mesh: Mesh, leaf) -> P:
+    """shard_map PartitionSpec matching stack_leaf_sharding."""
+    if _splits_batch(mesh, leaf):
+        return P(client_axes(mesh), None, BATCH_AXIS)
+    return P(client_axes(mesh))
+
+
+def shard_stack(mesh: Mesh, stack: dict) -> dict:
+    """device_put a client data stack with per-leaf stack_leaf_sharding."""
+    return {k: jax.device_put(v, stack_leaf_sharding(mesh, v))
+            for k, v in stack.items()}
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -20,3 +20,11 @@ import jax  # noqa: E402  (import after env vars)
 # The sitecustomize hook force-sets jax_platforms="axon,cpu"; pin it back so
 # backends() never initializes the (possibly unreachable) tunnel backend.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite compiles hundreds of XLA programs
+# (mesh round programs dominate wall-clock — VERDICT r2 weak #8); repeat
+# runs hit the disk cache instead of recompiling.  Safe to share across
+# processes; keyed on program + compile options.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/fedml_tpu_jax_tests"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

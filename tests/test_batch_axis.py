@@ -1,0 +1,193 @@
+"""Per-client batch parallelism (the "batch" mesh axis).
+
+When chips outnumber the cohort, the engine splits each client's per-step
+batch over a second mesh axis and completes the gradient with one psum per
+step (core/trainer.py batch_axes).  The invariant: a clients×batch mesh
+must reproduce the single-device engine's weights — the batch split is an
+execution layout, not an algorithm change.  Ragged clients (hetero LDA
+partition) make some batch shards all-padding, exercising the GLOBAL
+empty-batch guard and the S/C_g loss normalization.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.algorithms.fednova import FedNovaEngine
+from fedml_tpu.algorithms.fedopt import FedOptEngine
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel import (MeshFedAvgEngine, MeshFedNovaEngine,
+                                MeshFedOptEngine, MeshFedProxEngine,
+                                MeshRobustEngine)
+from fedml_tpu.parallel.mesh import make_mesh_batch
+from fedml_tpu.utils.config import FedConfig
+
+
+def _cfg(**kw):
+    base = dict(model="lr", dataset="mnist",
+                client_num_in_total=8, client_num_per_round=8,
+                comm_round=3, epochs=2, batch_size=16, lr=0.1,
+                partition_method="hetero",      # ragged shards
+                frequency_of_the_test=100)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _setup(cfg, prox_mu=0.0, momentum=0.0):
+    data = load_data(cfg.dataset, client_num_in_total=cfg.client_num_in_total,
+                     batch_size=cfg.batch_size, synthetic_scale=0.02,
+                     partition_method=cfg.partition_method, seed=cfg.seed)
+    model = create_model(cfg.model, output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=cfg.lr, optimizer=cfg.client_optimizer,
+                            prox_mu=prox_mu, momentum=momentum)
+    return trainer, data
+
+
+def _assert_close(v_ref, v_got, rtol=2e-4, atol=2e-5):
+    for a, b in zip(jax.tree.leaves(v_ref), jax.tree.leaves(v_got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol)
+
+
+def test_batch_axis_matches_single_device():
+    cfg = _cfg()
+    trainer, data = _setup(cfg)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+
+    eng = MeshFedAvgEngine(trainer, data, cfg,
+                           mesh=make_mesh_batch(2, 4), donate=False)
+    assert eng.batch_axes == ("batch",)
+    assert eng.n_shards == 2                     # padding: client axes only
+    v_b = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    _assert_close(v_ref, v_b)
+
+
+def test_batch_axis_with_momentum_and_ragged_guard():
+    # momentum makes the empty-batch guard meaningful: a frozen-vs-applied
+    # divergence between batch shards would corrupt the momentum buffer
+    cfg = _cfg(client_num_in_total=6, client_num_per_round=6)
+    trainer, data = _setup(cfg, momentum=0.9)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedAvgEngine(trainer, data, cfg,
+                           mesh=make_mesh_batch(2, 4), donate=False)
+    v_b = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    _assert_close(v_ref, v_b)
+
+
+def test_batch_axis_streaming():
+    cfg = _cfg(client_num_per_round=4)
+    trainer, data = _setup(cfg)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh_batch(2, 4),
+                           streaming=True, donate=False)
+    v_b = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    _assert_close(v_ref, v_b)
+
+
+def test_batch_axis_fedopt_and_prox():
+    cfg = _cfg(server_optimizer="adam", server_lr=0.05)
+    trainer, data = _setup(cfg, prox_mu=0.1)
+    ref = FedOptEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedOptEngine(trainer, data, cfg,
+                           mesh=make_mesh_batch(2, 4), donate=False)
+    v_b = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    _assert_close(v_ref, v_b)
+
+    cfgp = _cfg(prox_mu=0.1)
+    trainer_p, data_p = _setup(cfgp, prox_mu=0.1)
+    engp = MeshFedProxEngine(trainer_p, data_p, cfgp,
+                             mesh=make_mesh_batch(4, 2), donate=False)
+    refp = FedAvgEngine(trainer_p, data_p, cfgp, donate=False)
+    v0p = refp.init_variables()
+    v_refp = refp.run(variables=jax.tree.map(jnp.copy, v0p), rounds=2)
+    v_bp = engp.run(variables=jax.tree.map(jnp.copy, v0p), rounds=2)
+    _assert_close(v_refp, v_bp)
+
+
+def test_batch_axis_fednova():
+    cfg = _cfg()
+    trainer, data = _setup(cfg)
+    ref = FedNovaEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshFedNovaEngine(trainer, data, cfg,
+                            mesh=make_mesh_batch(2, 4), donate=False)
+    v_b = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    _assert_close(v_ref, v_b)
+
+
+def test_batch_axis_robust_norm_clip_and_order_stat_guard():
+    cfg = _cfg(norm_bound=0.5, stddev=0.0)
+    trainer, data = _setup(cfg)
+    ref = MeshRobustEngine(trainer, data, cfg, defense="norm_clip",
+                           mesh=make_mesh_batch(8, 1), donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng = MeshRobustEngine(trainer, data, cfg, defense="norm_clip",
+                           mesh=make_mesh_batch(2, 4), donate=False)
+    v_b = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    _assert_close(v_ref, v_b)
+
+    with pytest.raises(ValueError, match="batch"):
+        MeshRobustEngine(trainer, data, cfg, defense="median",
+                         mesh=make_mesh_batch(2, 4), donate=False)
+
+
+def test_batch_axis_batchnorm_guard_and_sync_bn_oracle():
+    """Plain BatchNorm under a batch split would normalize by shard-local
+    statistics — the engine rejects it; the cross-replica variant
+    (sync_batch_norm bound to the 'batch' axis) is oracle-equal to the
+    effectively-unsplit (batch axis of size 1) run."""
+    import flax.linen as nn
+
+    from fedml_tpu.models.norms import sync_batch_norm
+
+    class BNNet(nn.Module):
+        sync: bool = False
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            h = nn.Dense(16)(x.reshape((x.shape[0], -1)))
+            h = sync_batch_norm(use_running_average=not train,
+                                sync=self.sync, axis_name="batch")(h)
+            return nn.Dense(10)(nn.relu(h))
+
+    cfg = _cfg(epochs=1)
+    data = load_data("mnist", client_num_in_total=8, batch_size=16,
+                     synthetic_scale=0.02,
+                     partition_method="hetero", seed=cfg.seed)
+
+    plain = ClientTrainer(BNNet(sync=False), lr=cfg.lr)
+    eng = MeshFedAvgEngine(plain, data, cfg, mesh=make_mesh_batch(2, 4),
+                           donate=False)
+    with pytest.raises(ValueError, match="batch_stats"):
+        eng.run(rounds=1)
+
+    sync = ClientTrainer(BNNet(sync=True), lr=cfg.lr)
+    ref = MeshFedAvgEngine(sync, data, cfg, mesh=make_mesh_batch(8, 1),
+                           donate=False, allow_batch_stats=True)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    eng2 = MeshFedAvgEngine(sync, data, cfg, mesh=make_mesh_batch(2, 4),
+                            donate=False, allow_batch_stats=True)
+    v_b = eng2.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    _assert_close(v_ref, v_b, rtol=5e-4, atol=5e-5)
+
+
+def test_batch_axis_indivisible_raises():
+    cfg = _cfg(batch_size=16)
+    trainer, data = _setup(cfg)
+    with pytest.raises(ValueError, match="divide"):
+        MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh_batch(2, 3),
+                         donate=False)

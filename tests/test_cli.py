@@ -126,6 +126,22 @@ def test_cli_fednova_mesh(tmp_path):
     assert s
 
 
+def test_cli_mesh_batch(tmp_path):
+    # clients x batch mesh: 8 devices -> 4x2, per-step batch split 2 ways
+    s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--mesh", "--mesh_batch", "2")
+    assert "test_acc" in s
+
+
+def test_cli_mesh_batch_requires_mesh_and_family(tmp_path):
+    with pytest.raises(SystemExit):
+        run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--mesh_batch", "2")
+    with pytest.raises(SystemExit):
+        run_cli(tmp_path, "--algorithm", "decentralized", "--dataset",
+                "mnist", "--model", "lr", "--mesh", "--mesh_batch", "2")
+
+
 def test_cli_scan_block(tmp_path):
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
                 "--model", "lr", "--mesh", "--scan_block", "2")

@@ -62,6 +62,37 @@ def time_round(n_shards: int, n_clients: int, iters: int = 5) -> float:
     return (time.perf_counter() - t0) / iters
 
 
+def time_round_batch(n_c: int, n_b: int, n_clients: int = 8,
+                     iters: int = 5) -> float:
+    """One round on a clients×batch mesh (per-client sample parallelism):
+    fixed cohort and batch size, the per-step batch split n_b ways.  On
+    the 1-core host total work is fixed ⇒ flat is ideal; growth is the
+    per-step psum + partitioning overhead of the batch axis."""
+    from fedml_tpu.parallel.mesh import make_mesh_batch
+    cfg = FedConfig(model="cnn", dataset="femnist",
+                    client_num_in_total=n_clients,
+                    client_num_per_round=n_clients, epochs=1, batch_size=16,
+                    lr=0.1, frequency_of_the_test=10_000)
+    data = load_data("femnist", client_num_in_total=n_clients, batch_size=16,
+                     synthetic_scale=0.01, seed=0)
+    trainer = ClientTrainer(create_model("cnn", output_dim=data.class_num),
+                            lr=0.1)
+    eng = MeshFedAvgEngine(trainer, data, cfg,
+                           mesh=make_mesh_batch(n_c, n_b), donate=False)
+    v = eng.init_variables()
+    v = eng._prepare_variables(v)
+    s = eng.server_init(v)
+    args = eng._round_args(0)
+    rng = jax.random.PRNGKey(0)
+    out = eng.round_fn(v, s, *args, rng)          # compile + warm
+    jax.block_until_ready(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = eng.round_fn(v, s, *args, rng)
+    jax.block_until_ready(out[0])
+    return (time.perf_counter() - t0) / iters
+
+
 def time_gkt_server(n_shards: int, iters: int = 3) -> float:
     """One GKT server distillation epoch over fixed client uploads
     (8 clients × bs 256 — the reference's own DataParallel scaling row
@@ -136,6 +167,19 @@ def main() -> None:
         base = base or dt
         lines.append(f"| {n} | {4 * n} | {dt:.3f} | "
                      f"{dt / (base * n):.2f}x |")
+        print(lines[-1], flush=True)
+
+    lines += ["", "## Per-client batch parallelism — 8 clients, "
+              "per-step batch split over the batch axis", "",
+              "(clients×batch mesh, make_mesh_batch; fixed total work ⇒ "
+              "flat is ideal on the 1-core host — growth is the per-step "
+              "grad-psum + partitioning overhead)", "",
+              "| mesh (c×b) | s/round | vs 8×1 |", "|---|---|---|"]
+    base = None
+    for n_c, n_b in ((8, 1), (4, 2), (2, 4), (1, 8)):
+        dt = time_round_batch(n_c, n_b)
+        base = base or dt
+        lines.append(f"| {n_c}x{n_b} | {dt:.3f} | {dt / base:.2f}x |")
         print(lines[-1], flush=True)
 
     lines += ["", "## FedGKT server distillation — fixed uploads, "
