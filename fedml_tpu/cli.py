@@ -104,11 +104,6 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cohort_chunk", type=int, default=None,
                    help="max client model replicas live per shard "
                         "(default 8; tools/profile_bench.py)")
-    p.add_argument("--scan_block", type=int, default=None,
-                   help="EXPERIMENTAL: run federated rounds as lax.scan "
-                        "blocks of this size — zero host dispatch inside "
-                        "a block (mesh engines; in-program fold-in "
-                        "sampling; see run_scanned docstring for status)")
     p.add_argument("--local_dtype", type=str, default=None,
                    choices=("float32", "bfloat16"),
                    help="dtype of the LOCAL training masters (mesh "
@@ -269,6 +264,10 @@ def build_engine(args, cfg: FedConfig, data):
             if n_dev % args.mesh_batch:
                 raise SystemExit(f"--mesh_batch {args.mesh_batch} must "
                                  f"divide the device count ({n_dev})")
+            if cfg.batch_size % args.mesh_batch:
+                raise SystemExit(f"--mesh_batch {args.mesh_batch} must "
+                                 f"divide the batch size "
+                                 f"({cfg.batch_size})")
             mesh = make_mesh_batch(n_dev // args.mesh_batch,
                                    args.mesh_batch)
         else:
@@ -586,22 +585,6 @@ def main(argv: Optional[list[str]] = None) -> int:
     engine_logs = "logger" in run_params
 
     def _run():
-        if args.scan_block is not None:
-            if args.scan_block < 1:
-                raise SystemExit("--scan_block must be >= 1")
-            if (not hasattr(eng, "run_scanned")
-                    or getattr(eng, "streaming", False)):
-                raise SystemExit(
-                    "--scan_block needs a mesh FedAvg-family engine "
-                    "(--mesh, without --streaming)")
-            if args.ckpt_dir or args.resume:
-                raise SystemExit(
-                    "--scan_block does not support --ckpt_dir/--resume "
-                    "(rounds run inside one XLA program); drop one of "
-                    "the flags")
-            eng.run_scanned(cfg.comm_round, block=args.scan_block,
-                            logger=logger)
-            return
         kw = {}
         if engine_logs:
             kw = dict(logger=logger, ckpt=ckpt,

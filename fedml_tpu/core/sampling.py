@@ -58,7 +58,7 @@ class ClientSampler:
         (Not bit-identical to numpy — use `sample` when oracle comparability
         with the reference matters.)  Full participation returns arange,
         mirroring `sample` — so client→rng-lane pairing matches the Python
-        loop exactly in that regime (the run_scanned equivalence)."""
+        loop exactly in that regime."""
         if self.client_num_per_round >= self.client_num_in_total:
             return jnp.arange(self.client_num_in_total, dtype=jnp.int32)
         key = jax.random.fold_in(jax.random.PRNGKey(0), round_idx)

@@ -175,8 +175,12 @@ class ClientTrainer:
         one psum: the loss normalizes by the GLOBAL valid-sample count,
         grads/loss are psum'd and the empty-batch guard keys on the
         global count — so the trained weights are those of the unsplit
-        batch (bit-level up to reduction order).  Mesh engines set this
-        automatically when their mesh has a "batch" axis.
+        batch (bit-level up to reduction order) PROVIDED the step is
+        deterministic given the batch: with augment or dropout the
+        per-shard rng fold-in deliberately decorrelates those draws
+        from the unsplit run, so results differ by the augmentation
+        noise (not an error).  Mesh engines set this automatically when
+        their mesh has a "batch" axis.
     """
 
     def __init__(self, model, loss: str = "ce", optimizer: str = "sgd",
@@ -237,7 +241,8 @@ class ClientTrainer:
             # vectors from it — without the fold-in, sample i on every
             # shard would share its crop/flip/cutout draw
             for ax in self.batch_axes:
-                rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+                if jax.lax.axis_size(ax) > 1:   # size-1 axis: stay a no-op
+                    rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
         if self.augment is not None:
             rng, aug_rng = jax.random.split(rng)
             x = self.augment(aug_rng, x)

@@ -17,7 +17,6 @@ uploaded once, sharded over the mesh; per-round traffic is an index vector.
 """
 from __future__ import annotations
 
-import functools
 import logging
 import time
 from typing import Any, Optional
@@ -360,88 +359,15 @@ class MeshFedAvgEngine(FedAvgEngine):
             client_sharding(self.mesh))
         return cohort, weights
 
-    # -- fully on-device multi-round training --------------------------------
-    def run_scanned(self, rounds: int, variables: Optional[Pytree] = None,
-                    block: int = 10, logger=None) -> Pytree:
-        """Run `rounds` federated rounds as lax.scan blocks of `block`
-        rounds — the whole block (sampling, cohort gather, local SGD,
-        aggregation, server update) is ONE XLA program with no host
-        round-trips, something the reference's process-per-client
-        architecture cannot express.
-
-        STATUS: correctness-pinned, perf EXPERIMENTAL.  The intended win
-        is amortizing per-round dispatch for ms-scale rounds (small
-        models, cross-device sim); on the 8-device CPU proxy the scanned
-        body currently measures ~2.4x SLOWER per round than the jitted
-        loop (the in-scan gather + shard_map compile less efficiently
-        there), so until a real-chip measurement shows otherwise prefer
-        run().
-
-        Sampling uses the traceable `ClientSampler.sample_jax` (fold-in
-        permutation) — deterministic, but NOT bit-identical to the
-        reference's numpy semantics; use run() when the sampling oracle
-        matters.  Under full participation the two paths ARE identical
-        (sample_jax returns arange there, so client→rng pairing matches)
-        — tests pin that equivalence.  Eval runs after any block that
-        crossed the frequency_of_the_test cadence, and after the last."""
-        cfg = self.cfg
-        if self.streaming:
-            raise ValueError("run_scanned needs the device-resident stack "
-                             "(sampling happens inside the program)")
-        variables = (variables if variables is not None
-                     else self.init_variables())
-        variables = self._prepare_variables(variables)
-        server_state = self.server_init(variables)
-        stack, stack_w = self._device_stack()
-        rng_base = jax.random.PRNGKey(cfg.seed + 1)
-        K = min(cfg.client_num_per_round, self.sampler.client_num_in_total)
-        pad = (-K) % self.n_shards
-        wmask = jnp.concatenate([jnp.ones((K,), jnp.float32),
-                                 jnp.zeros((pad,), jnp.float32)])
-
-        def round_body(carry, round_idx):
-            v, s, stack, stack_w = carry
-            ids = self.sampler.sample_jax(round_idx)
-            ids = jnp.concatenate(
-                [ids, jnp.zeros((pad,), ids.dtype)]).astype(jnp.int32)
-            rr = jax.random.fold_in(rng_base, round_idx)
-            v, s, m = self._mesh_round(v, s, stack, stack_w, ids, wmask, rr)
-            return (v, s, stack, stack_w), m["train_loss"]
-
-        @functools.partial(
-            jax.jit, static_argnames=("n",),
-            donate_argnums=(0, 1) if self.donate else ())
-        def run_block(v, s, stack, stack_w, start, n):
-            # stack rides the scan carry unchanged, as an explicit arg —
-            # the jit never embeds the dataset in the program (the same
-            # rule as round_fn).  `start` is traced: one compile per
-            # distinct block LENGTH, not per block position.
-            (v, s, _, _), losses = jax.lax.scan(
-                round_body, (v, s, stack, stack_w), start + jnp.arange(n))
-            return (v, s), losses
-
-        done = 0
-        freq = max(cfg.frequency_of_the_test, 1)
-        while done < rounds:
-            n = min(block, rounds - done)
-            (variables, server_state), losses = run_block(
-                variables, server_state, stack, stack_w, jnp.int32(done),
-                n=n)
-            done += n
-            # the block spanned rounds [done-n, done): eval iff a cadence
-            # point r % freq == 0 lies inside, or this was the last block
-            crossed = (done - 1) // freq != (done - n - 1) // freq
-            if crossed or done >= rounds:
-                stats = self.evaluate(variables)
-                stats.update(round=done - 1,
-                             train_loss=float(losses[-1]))
-                self.metrics_history.append(stats)
-                if logger is not None:
-                    logger.log(stats, step=done - 1)
-                log.info("scanned rounds %d-%d: %s", done - n, done - 1,
-                         stats)
-        return variables
-
+    # NOTE: a fully on-device multi-round path (`run_scanned`: whole blocks
+    # of rounds as one lax.scan program, in-program fold-in sampling) was
+    # built and CUT after chip measurement: at ms-scale rounds (LR/MNIST,
+    # 1000 clients, 10/round — the regime where amortizing per-round
+    # dispatch should pay if it ever does) the jitted per-round loop ran
+    # 2.56 ms/round vs 23.8 ms/round scanned (tools/profile_bench.py
+    # exp_SCAN, v5e, 2026-07-31; PERF.md).  The in-scan cohort gather +
+    # shard_map compile far worse than the host-dispatched round program,
+    # and per-round dispatch is not a bottleneck at any measured scale.
     # -- driver loop ----------------------------------------------------------
     def _sample_padded_np(self, round_idx: int):
         """Sample the round's cohort and pad to a mesh-size multiple

@@ -140,12 +140,10 @@ def test_cli_mesh_batch_requires_mesh_and_family(tmp_path):
     with pytest.raises(SystemExit):
         run_cli(tmp_path, "--algorithm", "decentralized", "--dataset",
                 "mnist", "--model", "lr", "--mesh", "--mesh_batch", "2")
-
-
-def test_cli_scan_block(tmp_path):
-    s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
-                "--model", "lr", "--mesh", "--scan_block", "2")
-    assert "test_acc" in s
+    with pytest.raises(SystemExit):   # batch size not divisible by axis
+        run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--mesh", "--mesh_batch", "2",
+                "--batch_size", "15")
 
 
 def test_cli_augment_flag(tmp_path):

@@ -112,6 +112,24 @@ class TestSampler:
         np.testing.assert_array_equal(s.sample(3), s.sample(3))
         assert not np.array_equal(s.sample(3), s.sample(4))
 
+    def test_sample_jax_traceable_variant(self):
+        """sample_jax: deterministic per round, a valid k-subset, arange
+        under full participation (matching sample's branch so the
+        client->rng-lane pairing agrees between the two samplers)."""
+        import jax
+        import jax.numpy as jnp
+        s = ClientSampler(50, 5)
+        a = np.asarray(s.sample_jax(jnp.int32(3)))
+        np.testing.assert_array_equal(a, np.asarray(s.sample_jax(jnp.int32(3))))
+        assert len(np.unique(a)) == 5 and a.min() >= 0 and a.max() < 50
+        assert not np.array_equal(a, np.asarray(s.sample_jax(jnp.int32(4))))
+        full = ClientSampler(8, 8)
+        np.testing.assert_array_equal(np.asarray(full.sample_jax(jnp.int32(0))),
+                                      np.arange(8))
+        # traceable: usable from inside jit (the property sample() lacks)
+        b = jax.jit(lambda r: s.sample_jax(r))(jnp.int32(3))
+        np.testing.assert_array_equal(np.asarray(b), a)
+
 
 class TestTopology:
     def test_symmetric_rows_normalized(self):

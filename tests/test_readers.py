@@ -177,6 +177,14 @@ def test_baseline_row_synthetic_real_data(variant):
     v = eng.run()
     m = eng.evaluate(v)
     assert m["test_acc"] > 0.6, m                   # the reference's bar
+    # pinned band (VERDICT r2 weak-#5): the run is seeded and the data is
+    # the reference's shipped file, so the final accuracy is reproducible;
+    # a silent multi-point regression fails here even while clearing the
+    # published 60% floor.  Calibrated 2026-07-31.
+    pinned = {"synthetic_0_0": 0.7468, "synthetic_0.5_0.5": 0.7004,
+              "synthetic_1_1": 0.8945}[variant]
+    assert abs(m["test_acc"] - pinned) <= 0.04, \
+        f"pinned-band violation: acc={m['test_acc']:.4f}, pinned {pinned}"
 
 
 def test_leaf_shakespeare_loader(tmp_path):

@@ -400,74 +400,12 @@ def exp_R():
           flush=True)
 
 
-def exp_SCAN():
-    """run_scanned vs the jitted per-round loop at ms-scale rounds (VERDICT
-    r2 next-#6): LR on MNIST shapes, 1000-client cross-device sim, 10
-    clients/round — the regime where per-round dispatch could dominate and
-    in-program multi-round scan() should pay if it ever does."""
-    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
-                                          build_eval_shard)
-    from fedml_tpu.parallel import MeshFedAvgEngine
-    from fedml_tpu.parallel.mesh import make_mesh
-    from fedml_tpu.utils.config import FedConfig
-
-    C, spc, bs = 1000, 20, 10
-    rs = np.random.RandomState(0)
-    n = C * spc
-    x = rs.rand(n, 784).astype(np.float32)
-    y = rs.randint(0, 10, n).astype(np.int64)
-    idx = {i: np.arange(i * spc, (i + 1) * spc) for i in range(C)}
-    ev = build_eval_shard(x[:bs], y[:bs], bs)
-    data = FederatedData(
-        train_data_num=n, test_data_num=n, train_global=ev, test_global=ev,
-        client_shards=build_client_shards(x, y, idx, bs),
-        client_num_samples=np.full(C, spc, np.float32),
-        test_client_shards=None, class_num=10, synthetic=True)
-    cfg = FedConfig(model="lr", dataset="mnist", client_num_in_total=C,
-                    client_num_per_round=10, epochs=1, batch_size=bs,
-                    lr=0.03, frequency_of_the_test=10_000)
-    model = create_model("lr", input_dim=784, output_dim=10)
-    trainer = ClientTrainer(model, lr=cfg.lr)
-    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(),
-                              donate=False)
-    variables = engine.init_variables()
-    server_state = engine.server_init(variables)
-    stack, stack_w = engine._device_stack()
-    rng = jax.random.PRNGKey(0)
-
-    R = 100
-    # (a) the jitted per-round loop: host dispatch every round
-    ids, wmask = engine.sample_padded(0)
-    v, s = variables, server_state
-    for _ in range(2):
-        v, s, m = engine.round_fn(v, s, stack, stack_w, ids, wmask, rng)
-    force(m["train_loss"])
-    t0 = time.perf_counter()
-    v, s = variables, server_state
-    for r in range(R):
-        ids, wmask = engine.sample_padded(r)
-        v, s, m = engine.round_fn(v, s, stack, stack_w, ids, wmask, rng)
-    force(m["train_loss"])
-    t_loop = (time.perf_counter() - t0) / R
-
-    # (b) run_scanned: R rounds as scan blocks of 50.  Each call evals
-    # twice (round 0 is a cadence point, + the final block), which the
-    # loop timing above excludes — measure the warm eval cost and
-    # subtract it so the comparison is per-ROUND on both sides.
-    engine.run_scanned(R, block=50)          # compile + warm
-    ve = engine._prepare_variables(engine.init_variables())
-    for _ in range(2):
-        engine.evaluate(ve)                  # blocking (returns floats)
-    t0 = time.perf_counter()
-    for _ in range(5):
-        engine.evaluate(ve)
-    t_eval = (time.perf_counter() - t0) / 5
-    t0 = time.perf_counter()
-    engine.run_scanned(R, block=50)
-    t_scan = (time.perf_counter() - t0 - 2 * t_eval) / R
-    print(f"SCAN lr/mnist 1000x10: loop {t_loop*1e3:.2f}ms/round  "
-          f"scanned {t_scan*1e3:.2f}ms/round (eval-corrected)  "
-          f"ratio {t_loop/t_scan:.2f}x", flush=True)
+# exp_SCAN (removed 2026-07-31): run_scanned vs the jitted per-round loop
+# at ms-scale rounds (LR/MNIST, 1000 clients, 10/round, R=100, blocks of
+# 50 — the regime where amortizing per-round dispatch should pay if it
+# ever does).  Measured on the v5e chip: loop 2.56 ms/round, scanned
+# 23.81 ms/round (eval-corrected) — the scanned path lost 9.3x, so
+# run_scanned was cut from the engine (VERDICT r2 next-#6; PERF.md).
 
 
 def exp_U8():
