@@ -1,0 +1,46 @@
+"""The shared model/data/config for the multi-host SPMD oracle test:
+both the 2-process workers (multihost_worker.py) and the single-process
+oracle (test_multihost_spmd.py) build EXACTLY this engine, so any digest
+difference is attributable to the process boundary, not the workload."""
+import numpy as np
+
+
+def build_case():
+    import jax.numpy as jnp  # deferred: workers must set platform first
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.federated import (FederatedData, build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+    from fedml_tpu.utils.config import FedConfig
+
+    C, spc, bs, dim = 16, 24, 8, 32
+    rs = np.random.RandomState(7)
+    n = C * spc
+    w = rs.randn(dim, 10)
+    x = rs.randn(n, dim).astype(np.float32)
+    y = np.argmax(x @ w + 0.2 * rs.randn(n, 10), axis=1).astype(np.int64)
+    idx = {i: np.arange(i * spc, (i + 1) * spc) for i in range(C)}
+    data = FederatedData(
+        train_data_num=n, test_data_num=n,
+        train_global=build_eval_shard(x, y, n),
+        test_global=build_eval_shard(x, y, n),
+        client_shards=build_client_shards(x, y, idx, bs),
+        client_num_samples=np.full(C, spc, np.float32),
+        test_client_shards=None, class_num=10)
+    cfg = FedConfig(client_num_in_total=C, client_num_per_round=8,
+                    comm_round=3, epochs=1, batch_size=bs, lr=0.1,
+                    frequency_of_the_test=100)
+    model = create_model("lr", output_dim=10)
+    return MeshFedAvgEngine(ClientTrainer(model, lr=cfg.lr), data, cfg,
+                            mesh=make_mesh(8), donate=False)
+
+
+def digest(variables):
+    """Order-stable scalar digest of a params tree (sum of |params|)."""
+    import jax
+
+    return float(sum(float(np.abs(np.asarray(a)).sum())
+                     for a in jax.tree.leaves(variables)))

@@ -1,0 +1,37 @@
+"""Worker process for test_multihost_spmd: joins a 2-process
+jax.distributed CPU cluster (4 virtual devices per process -> 8-device
+GLOBAL mesh), runs MeshFedAvgEngine rounds over the global mesh, and
+prints a digest of the trained parameters.
+
+This is the DCN story executed for real: the same global-view SPMD
+engine code that runs single-host runs here across a process boundary,
+with the aggregation psum crossing between the two processes (gloo
+carries the CPU collectives; on a TPU pod the same program rides
+ICI/DCN).  Not a test file itself — launched by test_multihost_spmd.py.
+"""
+import os
+import sys
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from fedml_tpu.parallel.multihost import init_multihost  # noqa: E402
+
+init_multihost(coordinator_address=f"localhost:{port}", num_processes=2,
+               process_id=pid, required=True)
+
+import numpy as np  # noqa: E402
+
+from tests.multihost_case import build_case, digest  # noqa: E402
+
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+engine = build_case()
+v = engine.run()
+m = engine.evaluate(v)
+print(f"DIGEST {digest(v):.10e} ACC {m['test_acc']:.6f}", flush=True)
