@@ -11,14 +11,20 @@ IMG28 = (2, 28, 28, 1)
 
 
 def _forward(model, shape, train=False, **init_kw):
+    """init+apply under jit: eager dispatch of the deep zoo models costs
+    tens of seconds per test on CPU and is uncacheable; as two compiled
+    programs the persistent compilation cache (conftest) makes warm suite
+    runs near-instant."""
     x = jnp.zeros(shape, jnp.float32)
-    variables = model.init(jax.random.PRNGKey(0), x, train=False, **init_kw)
+    init = jax.jit(lambda k, xi: model.init(k, xi, train=False, **init_kw))
+    variables = init(jax.random.PRNGKey(0), x)
     if train:
-        out = model.apply(variables, x, train=True,
-                          rngs={"dropout": jax.random.PRNGKey(1)},
-                          mutable=["batch_stats"])
-        return out[0]
-    return model.apply(variables, x, train=False)
+        apply = jax.jit(lambda v, xi, k: model.apply(
+            v, xi, train=True, rngs={"dropout": k},
+            mutable=["batch_stats"]))
+        return apply(variables, x, jax.random.PRNGKey(1))[0]
+    apply = jax.jit(lambda v, xi: model.apply(v, xi, train=False))
+    return apply(variables, x)
 
 
 @pytest.mark.parametrize("name,shape,classes", [

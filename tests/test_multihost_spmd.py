@@ -41,23 +41,32 @@ def test_two_process_mesh_matches_single_process():
     port = _free_port()
     env = {**os.environ,
            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
-    # the workers re-set JAX_PLATFORMS/XLA_FLAGS themselves (4 devices
-    # each); drop the suite's 8-device forcing so it can't leak in
-    env.pop("XLA_FLAGS", None)
     procs = [subprocess.Popen(
         [sys.executable, WORKER, str(i), str(port)], env=env, text=True,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO)
         for i in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    # drain both workers CONCURRENTLY: if one crashes at init, its peer
+    # blocks in the collective — sequential communicate() would stall the
+    # full timeout and lose the crashed worker's traceback
+    import threading
+    results = [None, None]
+
+    def _drain(i):
+        try:
+            results[i] = procs[i].communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            procs[i].kill()
+            results[i] = procs[i].communicate()
+    threads = [threading.Thread(target=_drain, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, p in enumerate(procs):
+        out, err = results[i]
+        assert p.returncode == 0, \
+            f"worker {i} failed (rc={p.returncode}):\n{err[-3000:]}"
+    outs = [results[0][0], results[1][0]]
 
     d0, a0 = _parse(outs[0])
     d1, a1 = _parse(outs[1])
