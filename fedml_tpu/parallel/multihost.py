@@ -54,6 +54,25 @@ def init_multihost(coordinator_address: Optional[str] = None,
     explicit = (required or coordinator_address is not None
                 or num_processes is not None or process_id is not None)
     try:
+        # CPU cross-process collectives need an explicit transport; without
+        # it the global mesh forms but the first psum fails.  gloo is the
+        # one jaxlib ships (test_multihost_spmd exercises it).  Set it
+        # unconditionally BEFORE initialize: it only affects the cpu
+        # backend (TPU pods use ICI/DCN natively), and probing the
+        # platform here would initialize the backend — which
+        # jax.distributed.initialize forbids (see module docstring).
+        try:
+            cur = getattr(jax.config,
+                          "jax_cpu_collectives_implementation", "absent")
+            if cur in (None, "", "none"):
+                # unset/disabled only (this jaxlib's default is already
+                # "gloo"): an operator's explicit transport choice (env
+                # JAX_CPU_COLLECTIVES_IMPLEMENTATION=mpi or a prior
+                # config.update) must win
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+        except Exception:       # older jaxlib: option absent
+            pass
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -19,7 +19,8 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+# no explicit gloo config here: init_multihost sets the CPU collectives
+# transport itself — this worker exercises that product path
 
 from fedml_tpu.parallel.multihost import init_multihost  # noqa: E402
 

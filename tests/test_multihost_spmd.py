@@ -57,6 +57,11 @@ def test_two_process_mesh_matches_single_process():
         except subprocess.TimeoutExpired:
             procs[i].kill()
             results[i] = procs[i].communicate()
+        except Exception as e:          # decode errors etc: kill BOTH so
+            for p in procs:             # the peer doesn't hang in psum,
+                if p.poll() is None:    # and surface what happened
+                    p.kill()
+            results[i] = ("", f"drain failed: {e!r}")
     threads = [threading.Thread(target=_drain, args=(i,)) for i in range(2)]
     for t in threads:
         t.start()
