@@ -18,7 +18,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from fedml_tpu.core.topology import BaseTopologyManager
 from fedml_tpu.core.trainer import ClientTrainer

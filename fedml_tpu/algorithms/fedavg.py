@@ -19,10 +19,9 @@ _aggregate), fedml_api/distributed/fedavg/FedAVGAggregator.py:59-98
 """
 from __future__ import annotations
 
-import functools
 import logging
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

@@ -31,7 +31,6 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from fedml_tpu.core.pytree import (tree_select, tree_vary_noop,

@@ -17,7 +17,6 @@ import jax
 import jax.numpy as jnp
 
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
-from fedml_tpu.core.pytree import tree_sub
 
 
 def fednova_tau(shard, epochs, batch_axes=()):

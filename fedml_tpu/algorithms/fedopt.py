@@ -15,7 +15,6 @@ from __future__ import annotations
 
 from typing import Any
 
-import jax
 import optax
 
 from fedml_tpu.algorithms.fedavg import FedAvgEngine

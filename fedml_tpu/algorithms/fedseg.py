@@ -11,7 +11,6 @@ only evaluation differs: per-class IoU from a jitted confusion matrix
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

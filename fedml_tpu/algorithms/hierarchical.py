@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.pytree import tree_weighted_mean

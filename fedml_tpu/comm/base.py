@@ -10,8 +10,6 @@ from __future__ import annotations
 
 import abc
 import queue
-import threading
-from typing import Optional
 
 from fedml_tpu.comm.message import Message
 

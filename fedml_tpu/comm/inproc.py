@@ -10,7 +10,6 @@ encode/decode so the wire path is exercised.
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message, MessageCodec

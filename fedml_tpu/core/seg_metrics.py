@@ -9,9 +9,7 @@ under jit; metrics derive from it on host.
 """
 from __future__ import annotations
 
-from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -13,7 +13,6 @@ train=True), so augmentation is a no-op at eval by construction.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

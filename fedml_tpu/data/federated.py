@@ -19,7 +19,7 @@ part #1: unequal client sizes become padding+masking, not control flow).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ client) — the output feeds straight into build_client_shards.
 """
 from __future__ import annotations
 
-import collections
 import json
 import os
 from typing import Iterable, Optional

@@ -9,7 +9,6 @@ Inputs are NHWC (TPU-native layout; the reference is NCHW torch).
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class CNNOriginalFedAvg(nn.Module):

@@ -27,7 +27,7 @@ TPU-first deviations (deliberate, documented):
 from __future__ import annotations
 
 from collections import namedtuple
-from typing import Any, Sequence
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -8,7 +8,6 @@ the reference's cross-silo CIFAR usage; pass `imagenet_stem=True` for the
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -8,7 +8,6 @@ per-pixel class logits [B, H, W, C].
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class SegEncoderDecoder(nn.Module):

@@ -8,7 +8,6 @@ maps x → activations at the cut, the server half activations → logits
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class MLPLower(nn.Module):

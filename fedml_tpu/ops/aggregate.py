@@ -21,7 +21,6 @@ mode (tests), selected automatically.
 """
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax

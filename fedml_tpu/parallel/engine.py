@@ -18,13 +18,12 @@ uploaded once, sharded over the mesh; per-round traffic is an index vector.
 from __future__ import annotations
 
 import logging
-import time
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.algorithms.fedopt import make_server_optimizer

@@ -24,10 +24,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
-from fedml_tpu.core.topology import SymmetricTopologyManager
 from fedml_tpu.core.trainer import ClientTrainer
 from fedml_tpu.data.federated import FederatedData
-from fedml_tpu.parallel.mesh import CLIENT_AXIS, make_mesh
+from fedml_tpu.parallel.mesh import make_mesh
 from fedml_tpu.utils.config import FedConfig
 
 log = logging.getLogger(__name__)

@@ -20,7 +20,6 @@ because Σ_g (W_g/W)·(Σ_i w_i v_i / W_g) = Σ_i (w_i/W) v_i.
 from __future__ import annotations
 
 import logging
-import time
 from typing import Any, Optional
 
 import jax

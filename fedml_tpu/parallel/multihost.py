@@ -28,8 +28,7 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh
 
-from fedml_tpu.parallel.mesh import (CLIENT_AXIS, SILO_AXIS, make_mesh,
-                                     make_mesh_2d)
+from fedml_tpu.parallel.mesh import CLIENT_AXIS, make_mesh, make_mesh_2d
 
 log = logging.getLogger(__name__)
 
@@ -54,13 +53,15 @@ def init_multihost(coordinator_address: Optional[str] = None,
     explicit = (required or coordinator_address is not None
                 or num_processes is not None or process_id is not None)
     try:
-        # CPU cross-process collectives need an explicit transport; without
-        # it the global mesh forms but the first psum fails.  gloo is the
-        # one jaxlib ships (test_multihost_spmd exercises it).  Set it
-        # unconditionally BEFORE initialize: it only affects the cpu
-        # backend (TPU pods use ICI/DCN natively), and probing the
-        # platform here would initialize the backend — which
-        # jax.distributed.initialize forbids (see module docstring).
+        # CPU cross-process collectives need a transport; without one the
+        # global mesh forms but the first psum fails.  Current jaxlib
+        # defaults the option to "gloo" (test_multihost_spmd runs over
+        # it); this fallback covers builds whose default is unset/"none".
+        # It must happen BEFORE initialize, and without probing the
+        # platform — that would initialize the backend, which
+        # jax.distributed.initialize forbids (see module docstring) — so
+        # the option is set whenever it is not already configured (it
+        # only affects the cpu backend; TPU pods use ICI/DCN natively).
         try:
             cur = getattr(jax.config,
                           "jax_cpu_collectives_implementation", "absent")

@@ -12,7 +12,6 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
-import jax
 import numpy as np
 
 Pytree = Any

@@ -6,8 +6,7 @@ import numpy as np
 
 
 def build_case():
-    import jax.numpy as jnp  # deferred: workers must set platform first
-
+    # imports deferred: workers must set the jax platform before these
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.data.federated import (FederatedData, build_client_shards,
                                           build_eval_shard)

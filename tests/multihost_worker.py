@@ -19,15 +19,15 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# no explicit gloo config here: init_multihost sets the CPU collectives
-# transport itself — this worker exercises that product path
+# no explicit gloo config here: on current jaxlib the option already
+# defaults to "gloo"; init_multihost's fallback covers builds where it
+# doesn't (that branch is a no-op in this CI)
 
 from fedml_tpu.parallel.multihost import init_multihost  # noqa: E402
 
 init_multihost(coordinator_address=f"localhost:{port}", num_processes=2,
                process_id=pid, required=True)
 
-import numpy as np  # noqa: E402
 
 from tests.multihost_case import build_case, digest  # noqa: E402
 

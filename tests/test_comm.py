@@ -10,11 +10,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.comm import (BaseCommManager, ClientManager, InProcBackend,
-                            InProcRouter, Message, MessageCodec,
-                            ServerManager)
-from fedml_tpu.comm.fedavg_messaging import (FedAvgAggregator,
-                                             run_messaging_fedavg)
+from fedml_tpu.comm import (ClientManager, InProcRouter, Message,
+                            MessageCodec, ServerManager)
+from fedml_tpu.comm.fedavg_messaging import run_messaging_fedavg
 
 
 def test_message_codec_roundtrip():
@@ -157,7 +155,6 @@ def test_grpc_loopback():
         msg = Message(7, 0, 1)
         msg.add_params("w", w)
         a.send_message(msg)
-        import queue
         got = b._inbox.get(timeout=10)
         assert got.get_type() == 7
         np.testing.assert_array_equal(got.get("w"), w)

@@ -1,6 +1,5 @@
 """Extended dataset coverage: ImageNet/Landmarks/UCI loaders (synthetic
 fallback path), VFL data, and the backdoor-poisoning pipeline."""
-import jax
 import numpy as np
 import pytest
 

@@ -3,7 +3,6 @@
 streams)."""
 import jax
 import numpy as np
-import pytest
 
 from fedml_tpu.algorithms import DecentralizedGossipEngine
 from fedml_tpu.core.topology import (AsymmetricTopologyManager,

@@ -6,7 +6,6 @@ round-robin turns, and a TCP loopback variant over real sockets.
 Closes VERDICT r1 missing #2 / next-round #3.
 """
 import numpy as np
-import pytest
 
 import flax.linen as nn
 import jax
