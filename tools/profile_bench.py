@@ -54,32 +54,41 @@ def client_batches(rs, n_clients=N_CLIENTS, n_batches=N_BATCHES):
     return {"x": jnp.asarray(x), "y": jnp.asarray(y), "mask": jnp.asarray(m)}
 
 
-def exp_A():
-    """Full bench round via MeshFedAvgEngine (same code path as bench.py)."""
+def _bench_workload(C: int):
+    """The bench workload at a C-client cohort: cfg + synthetic
+    CIFAR10-shaped data (SPC samples/client) + bf16-compute trainer — ONE
+    definition so exp_A, exp_C512/exp_C1024 and bench.py-shaped runs all
+    measure the same per-client work."""
     from fedml_tpu.data.federated import (FederatedData, build_client_shards,
                                           build_eval_shard)
-    from fedml_tpu.parallel import MeshFedAvgEngine
-    from fedml_tpu.parallel.mesh import make_mesh
     from fedml_tpu.utils.config import FedConfig
 
     cfg = FedConfig(model="resnet18_gn", dataset="cifar10",
-                    client_num_in_total=N_CLIENTS,
-                    client_num_per_round=N_CLIENTS,
+                    client_num_in_total=C, client_num_per_round=C,
                     epochs=1, batch_size=BS, lr=0.1,
                     frequency_of_the_test=10_000)
     rs = np.random.RandomState(0)
-    n = N_CLIENTS * SPC
+    n = C * SPC
     x = rs.rand(n, 32, 32, 3).astype(np.float32)
     y = rs.randint(0, 10, n).astype(np.int64)
-    idx = {i: np.arange(i * SPC, (i + 1) * SPC) for i in range(N_CLIENTS)}
+    idx = {i: np.arange(i * SPC, (i + 1) * SPC) for i in range(C)}
     ev = build_eval_shard(x[:BS], y[:BS], BS)
     data = FederatedData(
         train_data_num=n, test_data_num=n, train_global=ev, test_global=ev,
         client_shards=build_client_shards(x, y, idx, BS),
-        client_num_samples=np.full(N_CLIENTS, SPC, np.float32),
+        client_num_samples=np.full(C, SPC, np.float32),
         test_client_shards=None, class_num=10, synthetic=True)
     model = create_model("resnet18_gn", output_dim=10)
     trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
+    return cfg, data, trainer
+
+
+def exp_A():
+    """Full bench round via MeshFedAvgEngine (same code path as bench.py)."""
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    cfg, data, trainer = _bench_workload(N_CLIENTS)
     engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(),
                               donate=False)
     variables = engine.init_variables()
@@ -95,6 +104,54 @@ def exp_A():
 
     dt = timeit(round_once, warmup=2, iters=3)
     print(f"A full_round: {dt:.3f}s/round", flush=True)
+
+
+# measured bench-128 standalone round at the committed recipe (chunk 2,
+# bf16 masters; the L2 row below) — the per-client parity denominator for
+# the cohort-scale experiments.  UPDATE when the bench recipe moves.
+BENCH_128_S = 1.851
+
+
+def _cohort_scale_round(C: int):
+    """One streaming round at a C-client full-participation cohort with the
+    bench recipe (chunk 2, bf16 masters), SAME per-client work as bench
+    (13 batches x bs 32): measures cohort-scaling ON CHIP — time should be
+    linear in C because the chunked scan keeps HBM O(chunk), not O(C)."""
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    cfg, data, trainer = _bench_workload(C)
+    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(), chunk=2,
+                              local_dtype=jnp.bfloat16, streaming=True,
+                              donate=False)
+    variables = engine.init_variables()
+    server_state = engine.server_init(variables)
+    t0 = time.perf_counter()
+    cohort, weights = engine.stream_cohort(0)
+    # force() (scalar fetch), not block_until_ready: the latter can return
+    # early on the tunnel platform (see force docstring)
+    force(cohort["x"])
+    t_up = time.perf_counter() - t0
+    rng = jax.random.PRNGKey(0)
+
+    def round_once():
+        v, s, m = engine.round_fn_streaming(variables, server_state, cohort,
+                                            weights, rng)
+        return m["train_loss"]
+
+    dt = timeit(round_once, warmup=1, iters=2)
+    gb = cohort["x"].nbytes / 1e9
+    print(f"C{C} cohort-scale: {dt:.3f}s/round  upload {t_up:.1f}s "
+          f"({gb:.2f} GB)  vs bench-128 "
+          f"{dt / BENCH_128_S * 128 / C:.2f}x/client", flush=True)
+
+
+def exp_C512():
+    _cohort_scale_round(512)
+
+
+def exp_C1024():
+    _cohort_scale_round(1024)
 
 
 def exp_B():
