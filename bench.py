@@ -123,11 +123,14 @@ def main() -> None:
         test_client_shards=None, class_num=10, synthetic=True)
 
     model = create_model("resnet18_gn", output_dim=10)
-    # bf16 compute / f32 masters: the MXU fast path (core/trainer.py)
-    trainer = ClientTrainer(model, lr=cfg.lr, train_dtype=jnp.bfloat16)
+    # bf16 compute / f32 masters: the MXU fast path (core/trainer.py);
+    # batch_unroll=8 unrolls the 13-step batch scan (measured −2.5%:
+    # L2U8 1.806 vs L2 1.851, PERF.md round-3 table)
+    trainer = ClientTrainer(model, lr=cfg.lr, train_dtype=jnp.bfloat16,
+                            batch_unroll=8)
     mesh = make_mesh()
     # chunk=2 + bf16 local masters: the measured v5e optimum
-    # (tools/profile_bench.py L2 1.851 s/round; PERF.md round-3 table)
+    # (tools/profile_bench.py L2 rows; PERF.md round-3 table)
     engine = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh, chunk=2,
                               local_dtype=jnp.bfloat16)
 

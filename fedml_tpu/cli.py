@@ -104,6 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cohort_chunk", type=int, default=None,
                    help="max client model replicas live per shard "
                         "(default 8; tools/profile_bench.py)")
+    p.add_argument("--batch_unroll", type=int, default=None,
+                   help="unroll factor of the local batch scan (perf "
+                        "knob; 8 measured -2.5%% on the v5e bench round "
+                        "at chunk 2, PERF.md)")
     p.add_argument("--local_dtype", type=str, default=None,
                    choices=("float32", "bfloat16"),
                    help="dtype of the LOCAL training masters (mesh "
@@ -230,7 +234,8 @@ def _trainer(cfg: FedConfig, data, model_name: Optional[str] = None,
                          weight_decay=cfg.wd, prox_mu=cfg.prox_mu,
                          has_time_axis=has_time, train_dtype=dtype,
                          augment=aug, eval_ignore_id=ignore,
-                         train_ignore_id=train_ignore)
+                         train_ignore_id=train_ignore,
+                         batch_unroll=cfg.batch_unroll)
 
 
 def _local_dtype(args):

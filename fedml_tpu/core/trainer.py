@@ -191,7 +191,8 @@ class ClientTrainer:
                  augment: Optional[Callable] = None,
                  eval_ignore_id: Optional[int] = None,
                  train_ignore_id: Optional[int] = None,
-                 batch_axes: tuple = ()):
+                 batch_axes: tuple = (),
+                 batch_unroll: int = 1):
         self.model = model
         self.loss_name = loss
         if loss not in ("ce", "bce", "focal"):
@@ -207,6 +208,11 @@ class ClientTrainer:
         self.eval_ignore_id = eval_ignore_id
         self.train_ignore_id = train_ignore_id
         self.batch_axes = tuple(batch_axes)
+        # default unroll of the batch scan in local_train (perf knob;
+        # see local_train docstring for the measured story)
+        if int(batch_unroll) < 1:
+            raise ValueError(f"batch_unroll must be >= 1, got {batch_unroll}")
+        self.batch_unroll = int(batch_unroll)
 
     def _revary(self, tree):
         """psum over batch_axes makes a value invariant along them; cast it
@@ -343,15 +349,19 @@ class ClientTrainer:
 
     # -- local training: epochs x batches under lax.scan --------------------
     def local_train(self, variables: Pytree, shard, rng: jax.Array,
-                    epochs: int, global_params=None, unroll: int = 1):
+                    epochs: int, global_params=None,
+                    unroll: Optional[int] = None):
         """Run E local epochs of SGD over one client's padded shard.
 
         shard: {"x": [B, bs, ...], "y": [B, bs, ...], "mask": [B, bs]}
         Returns (new_variables, mean_loss, n_samples). This is the reference's
         client hot loop (my_model_trainer_classification.py:19-53) as a single
-        scanned XLA program.  `unroll` is threaded to the batch scan (a perf
-        knob probed by tools/profile_bench.py; measured neutral on v5e).
+        scanned XLA program.  `unroll` (default: the constructor's
+        batch_unroll) unrolls the batch scan — measured on v5e at the
+        bench shape: neutral at chunk 8, and at the chunk-2 optimum a
+        full-shard unroll wins ~1-2% (tools/profile_bench.py L2U rows).
         """
+        unroll = self.batch_unroll if unroll is None else unroll
         # tree_vary_noop: align the fresh (replicated-typed) optimizer
         # state with the varying type it takes after step 1 under
         # shard_map (core/pytree.py)

@@ -146,6 +146,19 @@ def test_cli_mesh_batch_requires_mesh_and_family(tmp_path):
                 "--batch_size", "15")
 
 
+def test_cli_batch_unroll_flag(tmp_path):
+    """--batch_unroll threads to the trainer's batch scan; scan unroll is
+    semantics-preserving, so the run must produce the SAME result as the
+    rolled loop (same ops in the same order — identical on one platform)."""
+    s1 = run_cli(tmp_path / "u1", "--algorithm", "fedavg", "--dataset",
+                 "mnist", "--model", "lr", "--lr", "0.1")
+    s2 = run_cli(tmp_path / "u2", "--algorithm", "fedavg", "--dataset",
+                 "mnist", "--model", "lr", "--lr", "0.1",
+                 "--batch_unroll", "2")
+    assert abs(s1["test_acc"] - s2["test_acc"]) < 1e-9
+    assert abs(s1["test_loss"] - s2["test_loss"]) < 1e-6
+
+
 def test_cli_augment_flag(tmp_path):
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "cifar10",
                 "--model", "cnn", "--augment")

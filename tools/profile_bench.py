@@ -54,11 +54,12 @@ def client_batches(rs, n_clients=N_CLIENTS, n_batches=N_BATCHES):
     return {"x": jnp.asarray(x), "y": jnp.asarray(y), "mask": jnp.asarray(m)}
 
 
-def _bench_workload(C: int):
+def _bench_workload(C: int, batch_unroll: int = 8):
     """The bench workload at a C-client cohort: cfg + synthetic
-    CIFAR10-shaped data (SPC samples/client) + bf16-compute trainer — ONE
-    definition so exp_A, exp_C512/exp_C1024 and bench.py-shaped runs all
-    measure the same per-client work."""
+    CIFAR10-shaped data (SPC samples/client) + bf16-compute trainer with
+    the committed batch_unroll — ONE definition so exp_A,
+    exp_C512/exp_C1024 and bench.py-shaped runs all measure the same
+    per-client work at the same recipe."""
     from fedml_tpu.data.federated import (FederatedData, build_client_shards,
                                           build_eval_shard)
     from fedml_tpu.utils.config import FedConfig
@@ -79,7 +80,8 @@ def _bench_workload(C: int):
         client_num_samples=np.full(C, SPC, np.float32),
         test_client_shards=None, class_num=10, synthetic=True)
     model = create_model("resnet18_gn", output_dim=10)
-    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
+    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16,
+                            batch_unroll=batch_unroll)
     return cfg, data, trainer
 
 
@@ -107,16 +109,20 @@ def exp_A():
 
 
 # measured bench-128 standalone round at the committed recipe (chunk 2,
-# bf16 masters; the L2 row below) — the per-client parity denominator for
-# the cohort-scale experiments.  UPDATE when the bench recipe moves.
-BENCH_128_S = 1.851
+# bf16 masters, batch_unroll=8; the L2U8 row below) — the per-client
+# parity denominator for the cohort-scale experiments.  UPDATE when the
+# bench recipe moves.  (The SCALING.md C512/C1024 rows were measured at
+# the earlier unroll-1 recipe against its 1.851 denominator — ratios are
+# recipe-consistent either way since both sides share the trainer.)
+BENCH_128_S = 1.806
 
 
 def _cohort_scale_round(C: int):
     """One streaming round at a C-client full-participation cohort with the
-    bench recipe (chunk 2, bf16 masters), SAME per-client work as bench
-    (13 batches x bs 32): measures cohort-scaling ON CHIP — time should be
-    linear in C because the chunked scan keeps HBM O(chunk), not O(C)."""
+    bench recipe (chunk 2, bf16 masters, unroll 8), SAME per-client work
+    as bench (13 batches x bs 32): measures cohort-scaling ON CHIP — time
+    should be linear in C because the chunked scan keeps HBM O(chunk),
+    not O(C)."""
     from fedml_tpu.parallel import MeshFedAvgEngine
     from fedml_tpu.parallel.mesh import make_mesh
 
@@ -128,9 +134,13 @@ def _cohort_scale_round(C: int):
     server_state = engine.server_init(variables)
     t0 = time.perf_counter()
     cohort, weights = engine.stream_cohort(0)
-    # force() (scalar fetch), not block_until_ready: the latter can return
-    # early on the tunnel platform (see force docstring)
-    force(cohort["x"])
+    # completion barrier: a 1-element on-device slice then a scalar fetch —
+    # computing the slice needs the uploaded buffer resident, and the
+    # device_get moves 4 bytes, not the cohort (force(cohort["x"]) would
+    # download the whole multi-GB array; block_until_ready can return
+    # early on the tunnel platform)
+    x = cohort["x"]
+    force(x[(0,) * (x.ndim - 1)][None])
     t_up = time.perf_counter() - t0
     rng = jax.random.PRNGKey(0)
 
@@ -278,6 +288,30 @@ def exp_L1():
 def exp_L2():
     print(f"L2 chunked(2,bf16 masters): "
           f"{_bf16_master_round(2):.3f}s/round", flush=True)
+
+
+def exp_L2U2():
+    print(f"L2U2 chunked(2,bf16 masters,unroll=2): "
+          f"{_chunked_round(2, master_dtype=jnp.bfloat16, unroll=2):.3f}"
+          f"s/round", flush=True)
+
+
+def exp_L2U4():
+    print(f"L2U4 chunked(2,bf16 masters,unroll=4): "
+          f"{_chunked_round(2, master_dtype=jnp.bfloat16, unroll=4):.3f}"
+          f"s/round", flush=True)
+
+
+def exp_L2U8():
+    print(f"L2U8 chunked(2,bf16 masters,unroll=8): "
+          f"{_chunked_round(2, master_dtype=jnp.bfloat16, unroll=8):.3f}"
+          f"s/round", flush=True)
+
+
+def exp_L2U13():
+    print(f"L2U13 chunked(2,bf16 masters,unroll=13 = full): "
+          f"{_chunked_round(2, master_dtype=jnp.bfloat16, unroll=13):.3f}"
+          f"s/round", flush=True)
 
 
 def exp_L4():
