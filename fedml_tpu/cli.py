@@ -286,6 +286,18 @@ def build_engine(args, cfg: FedConfig, data):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
+    if args.batch_unroll is not None and args.batch_unroll < 1:
+        raise SystemExit(
+            f"--batch_unroll must be >= 1, got {args.batch_unroll}")
+    if args.batch_unroll is not None and algo in ("fednas", "fedgan",
+                                                  "fedgkt", "splitnn",
+                                                  "vfl"):
+        # same courtesy the other engine knobs get (see the per-branch
+        # --streaming/--cohort_chunk warnings): these engines never build
+        # a ClientTrainer batch scan, so the knob cannot reach one
+        logging.getLogger(__name__).warning(
+            "--batch_unroll is ignored by %s (no ClientTrainer batch "
+            "scan)", algo)
     if algo in ("fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
                 "turboaggregate", "centralized"):
         trainer = _trainer(cfg, data)

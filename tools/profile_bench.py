@@ -134,13 +134,13 @@ def _cohort_scale_round(C: int):
     server_state = engine.server_init(variables)
     t0 = time.perf_counter()
     cohort, weights = engine.stream_cohort(0)
-    # completion barrier: a 1-element on-device slice then a scalar fetch —
+    # completion barrier: a scalar on-device slice then a scalar fetch —
     # computing the slice needs the uploaded buffer resident, and the
-    # device_get moves 4 bytes, not the cohort (force(cohort["x"]) would
-    # download the whole multi-GB array; block_until_ready can return
-    # early on the tunnel platform)
+    # device_get moves one element, not the cohort (force(cohort["x"])
+    # would download the whole multi-GB array; block_until_ready can
+    # return early on the tunnel platform)
     x = cohort["x"]
-    force(x[(0,) * (x.ndim - 1)][None])
+    force(x[(0,) * x.ndim])
     t_up = time.perf_counter() - t0
     rng = jax.random.PRNGKey(0)
 
@@ -164,10 +164,15 @@ def exp_C1024():
     _cohort_scale_round(1024)
 
 
-def exp_B():
-    """Centralized ceiling: shared weights, 13 steps of effective batch 4096."""
+def exp_B(batch_unroll: int = 1):
+    """Centralized ceiling: shared weights, 13 steps of effective batch
+    4096.  `batch_unroll` must match the recipe of the round it anchors
+    (exp_BU8 for the committed unroll-8 recipe) — comparing a U8 round
+    against a U1 ceiling would conflate the unroll win with the
+    grouped-conv cost."""
     model = create_model("resnet18_gn", output_dim=10)
-    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
+    trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16,
+                            batch_unroll=batch_unroll)
     rs = np.random.RandomState(0)
     x = rs.rand(N_BATCHES, BS * N_CLIENTS, 32, 32, 3).astype(np.float32)
     y = rs.randint(0, 10, (N_BATCHES, BS * N_CLIENTS)).astype(np.int32)
@@ -177,7 +182,12 @@ def exp_B():
     fn = jax.jit(lambda v, s, r: trainer.local_train(v, s, r, 1)[1])
     rng = jax.random.PRNGKey(1)
     dt = timeit(lambda: fn(variables, shard, rng))
-    print(f"B centralized_ceiling: {dt:.3f}s/round-equivalent", flush=True)
+    print(f"B centralized_ceiling(unroll={batch_unroll}): "
+          f"{dt:.3f}s/round-equivalent", flush=True)
+
+
+def exp_BU8():
+    exp_B(batch_unroll=8)
 
 
 def _chunked_round(chunk, data_dtype=None, master_dtype=None,
@@ -311,6 +321,18 @@ def exp_L2U8():
 def exp_L2U13():
     print(f"L2U13 chunked(2,bf16 masters,unroll=13 = full): "
           f"{_chunked_round(2, master_dtype=jnp.bfloat16, unroll=13):.3f}"
+          f"s/round", flush=True)
+
+
+def exp_L1U8():
+    print(f"L1U8 chunked(1,bf16 masters,unroll=8): "
+          f"{_chunked_round(1, master_dtype=jnp.bfloat16, unroll=8):.3f}"
+          f"s/round", flush=True)
+
+
+def exp_L4U8():
+    print(f"L4U8 chunked(4,bf16 masters,unroll=8): "
+          f"{_chunked_round(4, master_dtype=jnp.bfloat16, unroll=8):.3f}"
           f"s/round", flush=True)
 
 
