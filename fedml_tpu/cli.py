@@ -286,9 +286,6 @@ def build_engine(args, cfg: FedConfig, data):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
-    if args.batch_unroll is not None and args.batch_unroll < 1:
-        raise SystemExit(
-            f"--batch_unroll must be >= 1, got {args.batch_unroll}")
     if args.batch_unroll is not None and algo in ("fednas", "fedgan",
                                                   "fedgkt", "splitnn",
                                                   "vfl"):
@@ -565,6 +562,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    if args.batch_unroll is not None and args.batch_unroll < 1:
+        # here, not in build_engine: the --deploy path builds its
+        # trainer without build_engine and must get the same clean error
+        raise SystemExit(
+            f"--batch_unroll must be >= 1, got {args.batch_unroll}")
     cfg = FedConfig.from_args(args)
     cfg.ci = bool(args.ci)
     if args.multihost:

@@ -1,18 +1,18 @@
 """The shared model/data/config for the multi-host SPMD oracle test:
 both the 2-process workers (multihost_worker.py) and the single-process
-oracle (test_multihost_spmd.py) build EXACTLY this engine, so any digest
-difference is attributable to the process boundary, not the workload."""
+oracle (test_multihost_spmd.py) build EXACTLY these engines, so any
+digest difference is attributable to the process boundary, not the
+workload."""
 import numpy as np
 
 
-def build_case():
+def _case_data_cfg(comm_round: int):
+    """One data+config construction shared by the flat and hierarchical
+    cases — the worker/oracle digest comparison relies on both sides
+    building bit-identical workloads, so this must not be duplicated."""
     # imports deferred: workers must set the jax platform before these
-    from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.data.federated import (FederatedData, build_client_shards,
                                           build_eval_shard)
-    from fedml_tpu.models import create_model
-    from fedml_tpu.parallel import MeshFedAvgEngine
-    from fedml_tpu.parallel.mesh import make_mesh
     from fedml_tpu.utils.config import FedConfig
 
     C, spc, bs, dim = 16, 24, 8, 32
@@ -30,11 +30,44 @@ def build_case():
         client_num_samples=np.full(C, spc, np.float32),
         test_client_shards=None, class_num=10)
     cfg = FedConfig(client_num_in_total=C, client_num_per_round=8,
-                    comm_round=3, epochs=1, batch_size=bs, lr=0.1,
+                    comm_round=comm_round, epochs=1, batch_size=bs, lr=0.1,
                     frequency_of_the_test=100)
+    return data, cfg
+
+
+def build_case():
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    data, cfg = _case_data_cfg(comm_round=3)
     model = create_model("lr", output_dim=10)
     return MeshFedAvgEngine(ClientTrainer(model, lr=cfg.lr), data, cfg,
                             mesh=make_mesh(8), donate=False)
+
+
+def build_hier_case(multihost: bool):
+    """Two-tier hierarchical engine over a (silo × clients) mesh: with
+    multihost=True the mesh comes from make_hierarchical_host_mesh (one
+    silo per PROCESS — the inner psum stays host-local, only the silo
+    tier crosses the process boundary, i.e. the DCN layout); the
+    single-process oracle uses the same 2×4 logical mesh over its 8
+    local devices.  Same data as build_case (shared _case_data_cfg);
+    fewer global rounds — each runs group_comm_round inner rounds."""
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel import (MeshHierarchicalEngine,
+                                    make_hierarchical_host_mesh)
+    from fedml_tpu.parallel.mesh import make_mesh_2d
+
+    data, cfg = _case_data_cfg(comm_round=2)
+    mesh = (make_hierarchical_host_mesh(silos=2) if multihost
+            else make_mesh_2d(n_silos=2))
+    model = create_model("lr", output_dim=10)
+    return MeshHierarchicalEngine(ClientTrainer(model, lr=cfg.lr), data,
+                                  cfg, mesh=mesh, group_comm_round=2,
+                                  donate=False)
 
 
 def digest(variables):

@@ -29,10 +29,17 @@ init_multihost(coordinator_address=f"localhost:{port}", num_processes=2,
                process_id=pid, required=True)
 
 
-from tests.multihost_case import build_case, digest  # noqa: E402
+from tests.multihost_case import build_case, build_hier_case, digest  # noqa: E402
 
 assert jax.device_count() == 8 and jax.local_device_count() == 4
 engine = build_case()
 v = engine.run()
 m = engine.evaluate(v)
 print(f"DIGEST {digest(v):.10e} ACC {m['test_acc']:.6f}", flush=True)
+
+# two-tier hierarchical over one-silo-per-PROCESS: the inner FedAvg psum
+# stays inside each process's devices, the silo tier crosses the boundary
+h = build_hier_case(multihost=True)
+hv = h.run()
+hm = h.evaluate(hv)
+print(f"HDIGEST {digest(hv):.10e} HACC {hm['test_acc']:.6f}", flush=True)

@@ -33,8 +33,10 @@ def _free_port():
 
 def _parse(out: str):
     m = re.search(r"DIGEST ([\d.e+-]+) ACC ([\d.]+)", out)
-    assert m, f"worker produced no digest:\n{out[-2000:]}"
-    return float(m.group(1)), float(m.group(2))
+    h = re.search(r"HDIGEST ([\d.e+-]+) HACC ([\d.]+)", out)
+    assert m and h, f"worker produced no digest:\n{out[-2000:]}"
+    return (float(m.group(1)), float(m.group(2)),
+            float(h.group(1)), float(h.group(2)))
 
 
 def test_two_process_mesh_matches_single_process():
@@ -73,14 +75,16 @@ def test_two_process_mesh_matches_single_process():
             f"worker {i} failed (rc={p.returncode}):\n{err[-3000:]}"
     outs = [results[0][0], results[1][0]]
 
-    d0, a0 = _parse(outs[0])
-    d1, a1 = _parse(outs[1])
+    d0, a0, hd0, ha0 = _parse(outs[0])
+    d1, a1, hd1, ha1 = _parse(outs[1])
     # both SPMD replicas hold the identical replicated result
     assert d0 == pytest.approx(d1, rel=1e-7)
     assert a0 == a1
+    assert hd0 == pytest.approx(hd1, rel=1e-7)
+    assert ha0 == ha1
 
     # single-process oracle on the same 8 (virtual) devices
-    from tests.multihost_case import build_case, digest
+    from tests.multihost_case import build_case, build_hier_case, digest
     eng = build_case()
     v = eng.run()
     m = eng.evaluate(v)
@@ -88,3 +92,11 @@ def test_two_process_mesh_matches_single_process():
     # than the single-process ring — equality up to float tolerance
     assert d0 == pytest.approx(digest(v), rel=1e-5)
     assert a0 == pytest.approx(m["test_acc"], abs=1e-6)
+
+    # hierarchical: one silo per process (inner psum host-local, silo
+    # tier crosses the boundary) == the single-process 2x4 silo mesh
+    h = build_hier_case(multihost=False)
+    hv = h.run()
+    hm = h.evaluate(hv)
+    assert hd0 == pytest.approx(digest(hv), rel=1e-5)
+    assert ha0 == pytest.approx(hm["test_acc"], abs=1e-6)
