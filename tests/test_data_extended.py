@@ -86,3 +86,29 @@ def test_poison_pipeline_and_backdoor_eval():
     v = eng.run(rounds=1)
     bd = eng.evaluate_backdoor(v, shard)
     assert 0.0 <= bd["backdoor_acc"] <= 1.0
+
+
+def test_synthetic_sequences_bit_identical_to_row_formulation():
+    """The grouped-searchsorted sampler must reproduce the historical
+    row-gather formulation BIT-exactly (same RandomState stream, and
+    (r > cum).sum() == searchsorted(cum, r, 'left') for sorted cum) —
+    the synthetic text stand-ins feed seeded tests, so regenerating
+    different sequences would silently move their accuracy floors."""
+    from fedml_tpu.data.synthetic import synthetic_sequences
+
+    n, seq_len, vocab, seed = 700, 6, 53, 3
+    x, y = synthetic_sequences(n, seq_len, vocab, seed=seed)
+
+    # historical formulation, inline (the pre-optimization algorithm)
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.full(vocab, 0.05), size=vocab)
+    cumt = np.cumsum(trans, axis=1)
+    seqs = np.zeros((n, seq_len + 1), np.int32)
+    seqs[:, 0] = rng.randint(0, vocab, n)
+    for t in range(seq_len):
+        cum = cumt[seqs[:, t]]
+        r = rng.rand(n, 1)
+        seqs[:, t + 1] = (r > cum).sum(axis=1).clip(0, vocab - 1)
+
+    np.testing.assert_array_equal(x, seqs[:, :-1])
+    np.testing.assert_array_equal(y, seqs[:, 1:])
