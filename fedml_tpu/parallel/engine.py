@@ -201,8 +201,19 @@ class MeshFedAvgEngine(FedAvgEngine):
                  cfg: FedConfig, mesh: Optional[Mesh] = None,
                  donate: bool = True, chunk: Optional[int] = None,
                  streaming: bool = False, local_dtype=None,
+                 stack_dtype=None,
                  allow_batch_stats: bool = False):
         self.allow_batch_stats = allow_batch_stats
+        # stack_dtype stores the client stack's INPUT leaf ("x") in this
+        # dtype on device — bf16 halves the cohort's HBM footprint and
+        # upload bytes, which is what prices in past ~512 bench-shaped
+        # clients per chip (measured: the 1024-client knee flattens from
+        # 1.32x to 1.06x per client — PERF.md/SCALING.md).  Only "x" is
+        # cast: y is integral, and mask must stay f32 (bf16 0/1 sums
+        # lose exactness past 256 — sample counts feed the aggregation
+        # weights).  Opt-in: inputs at bf16 precision is an accuracy
+        # tradeoff the user chooses (tests pin closeness to f32).
+        self.stack_dtype = stack_dtype
         self.mesh = mesh if mesh is not None else make_mesh()
         # a "batch" mesh axis splits each client's per-step batch over
         # devices (per-client sample parallelism: mesh.py BATCH_AXIS, the
@@ -259,14 +270,24 @@ class MeshFedAvgEngine(FedAvgEngine):
         return avg_variables, server_state
 
     # -- device data ----------------------------------------------------------
+    def _cast_stack_x(self, shards: dict) -> dict:
+        """Apply stack_dtype to the input leaf (see __init__); identity
+        when unset."""
+        if self.stack_dtype is not None and "x" in shards:
+            shards = dict(shards)
+            shards["x"] = np.asarray(shards["x"],
+                                     jnp.dtype(self.stack_dtype))
+        return shards
+
     def _device_stack(self):
         """Upload the [C,...] client stack ONCE, leading axis sharded over the
         mesh (C padded to a mesh-size multiple with zero-weight clients)."""
         if self._stack is None:
             from fedml_tpu.parallel.mesh import pad_cohort
             shards, weights = self.data.client_shards, self.data.client_num_samples
-            shards, weights = pad_cohort(dict(shards), np.asarray(
-                weights, np.float32), self.n_shards)
+            shards, weights = pad_cohort(
+                self._cast_stack_x(dict(shards)),
+                np.asarray(weights, np.float32), self.n_shards)
             self._stack = shard_stack(self.mesh, shards)
             self._stack_weights = jax.device_put(
                 weights.astype(np.float32), client_sharding(self.mesh))
@@ -349,9 +370,11 @@ class MeshFedAvgEngine(FedAvgEngine):
         uploading only the cohort (chunk-multiple padding happens inside
         chunked_weighted_train)."""
         ids, wmask = self._sample_padded_np(round_idx)
-        cohort = {k: jax.device_put(np.take(np.asarray(v), ids, axis=0),
-                                    stack_leaf_sharding(self.mesh, v))
-                  for k, v in self.data.client_shards.items()}
+        host = self._cast_stack_x(
+            {k: np.take(np.asarray(v), ids, axis=0)
+             for k, v in self.data.client_shards.items()})
+        cohort = {k: jax.device_put(v, stack_leaf_sharding(self.mesh, v))
+                  for k, v in host.items()}
         weights = jax.device_put(
             np.take(np.asarray(self.data.client_num_samples,
                                np.float32), ids) * wmask,

@@ -146,6 +146,16 @@ def test_cli_mesh_batch_requires_mesh_and_family(tmp_path):
                 "--batch_size", "15")
 
 
+def test_cli_stack_dtype_flag(tmp_path):
+    s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--lr", "0.1", "--mesh", "--streaming",
+                "--stack_dtype", "bfloat16")
+    assert "test_acc" in s
+    with pytest.raises(SystemExit):      # requires --mesh
+        run_cli(tmp_path / "e", "--algorithm", "fedavg", "--dataset",
+                "mnist", "--model", "lr", "--stack_dtype", "bfloat16")
+
+
 def test_cli_batch_unroll_flag(tmp_path):
     """--batch_unroll threads to the trainer's batch scan; scan unroll is
     semantics-preserving, so the unrolled run must train to the same

@@ -331,6 +331,37 @@ def test_local_dtype_bf16_close_to_f32():
                                    rtol=0.05, atol=0.02)
 
 
+def test_stack_dtype_bf16_close_to_f32():
+    """bf16 cohort storage (the >512-clients-per-chip HBM lever, PERF.md):
+    only the input leaf is cast — y stays integral, mask stays f32 (its
+    0/1 sums feed aggregation weights and lose exactness past 256 in
+    bf16) — and training stays close to the f32-stack run.  Covers both
+    the resident and streaming upload paths."""
+    cfg = _mnist_like_cfg(comm_round=3)
+    trainer, data = _setup(cfg)
+    ref = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False)
+    v0 = ref.init_variables()
+    v_f32 = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for streaming in (False, True):
+        eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                               donate=False, streaming=streaming,
+                               stack_dtype=jnp.bfloat16)
+        if streaming:
+            cohort, _w = eng.stream_cohort(0)
+            assert cohort["x"].dtype == jnp.bfloat16
+            assert cohort["mask"].dtype == jnp.float32
+        else:
+            stack, _w = eng._device_stack()
+            assert stack["x"].dtype == jnp.bfloat16
+            assert stack["mask"].dtype == jnp.float32
+        v_bf = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+        for a, b in zip(jax.tree.leaves(v_f32), jax.tree.leaves(v_bf)):
+            assert a.dtype == b.dtype       # globals keep the f32 grid
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0.05, atol=0.02)
+
+
 @pytest.mark.parametrize("defense", ["median", "krum", "trimmed_mean"])
 def test_mesh_orderstat_defense_matches_single_device(defense):
     """krum/median/trimmed-mean on the mesh (flatten + all_gather + order

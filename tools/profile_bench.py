@@ -117,19 +117,20 @@ def exp_A():
 BENCH_128_S = 1.806
 
 
-def _cohort_scale_round(C: int):
+def _cohort_scale_round(C: int, data_dtype=None):
     """One streaming round at a C-client full-participation cohort with the
     bench recipe (chunk 2, bf16 masters, unroll 8), SAME per-client work
     as bench (13 batches x bs 32): measures cohort-scaling ON CHIP — time
     should be linear in C because the chunked scan keeps HBM O(chunk),
-    not O(C)."""
+    not O(C).  `data_dtype` stores the cohort x in that dtype on device
+    (exp_C1024H)."""
     from fedml_tpu.parallel import MeshFedAvgEngine
     from fedml_tpu.parallel.mesh import make_mesh
 
     cfg, data, trainer = _bench_workload(C)
     engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(), chunk=2,
                               local_dtype=jnp.bfloat16, streaming=True,
-                              donate=False)
+                              stack_dtype=data_dtype, donate=False)
     variables = engine.init_variables()
     server_state = engine.server_init(variables)
     t0 = time.perf_counter()
@@ -162,6 +163,14 @@ def exp_C512():
 
 def exp_C1024():
     _cohort_scale_round(1024)
+
+
+def exp_C1024H():
+    """C1024 with the cohort x stored bf16 on device: compute was
+    measured dtype-neutral at 128 clients (H16), but at 1024 the f32
+    cohort is a third of HBM — halving it probes whether the 1.32×
+    per-client knee is capacity/bandwidth pressure from the data stack."""
+    _cohort_scale_round(1024, data_dtype=jnp.bfloat16)
 
 
 def exp_B(batch_unroll: int = 1):
