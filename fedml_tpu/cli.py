@@ -203,7 +203,8 @@ def _trainer(cfg: FedConfig, data, model_name: Optional[str] = None,
     has_time = force_time_axis or cfg.dataset in ("fed_shakespeare",
                                                   "stackoverflow_nwp")
     kw = ({"last_only": True}
-          if cfg.model == "rnn" and cfg.dataset == "shakespeare" else {})
+          if cfg.model in ("rnn", "transformer")
+          and cfg.dataset == "shakespeare" else {})
     model = create_model(model_name or cfg.model, data.class_num, **kw)
     dtype = jnp.bfloat16 if cfg.train_dtype == "bfloat16" else jnp.float32
     aug = None

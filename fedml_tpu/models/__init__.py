@@ -30,6 +30,11 @@ def create_model(model_name: str, output_dim: int, input_dim: int | None = None,
         return RNNOriginalFedAvg(vocab_size=kw.pop("vocab_size", 90), **kw)
     if name == "rnn_stackoverflow":
         return RNNStackOverflow(**kw)
+    if name == "transformer":
+        # beyond-reference: causal decoder LM for the next-token tasks
+        # (models/transformer.py) — vocab from the dataset's class count
+        from fedml_tpu.models.transformer import TransformerLM
+        return TransformerLM(vocab_size=output_dim, **kw)
     if name in ("resnet18_gn", "resnet18"):
         return ResNet18GN(num_classes=output_dim, **kw)
     if name == "resnet56":

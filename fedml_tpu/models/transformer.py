@@ -1,0 +1,70 @@
+"""Decoder-only transformer LM — a BEYOND-reference model family.
+
+The reference's NLP zoo stops at LSTMs (fedml_api/model/nlp/rnn.py); this
+adds a small causal transformer for the same next-token tasks
+(shakespeare / stackoverflow_nwp), because on TPU the attention matmuls
+map onto the MXU far better than a sequential LSTM scan: every position
+is one batched matmul instead of a length-T dependency chain.
+
+Interface matches the RNN zoo: tokens [B, T] int -> per-position logits
+[B, T, vocab]; the trainer's has_time_axis loss masks padding the same
+way.  Sized for federated cross-device work (2 layers, d=128 by
+default), not LLM scale — sequence lengths here are 20-80 tokens, so no
+long-context machinery is warranted (SURVEY.md §5: the reference has
+none to mirror).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Block(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+
+    @nn.compact
+    def __call__(self, h, mask):
+        a = nn.LayerNorm()(h)
+        a = nn.MultiHeadDotProductAttention(
+            num_heads=self.n_heads, qkv_features=self.d_model,
+            deterministic=True)(a, a, mask=mask)
+        h = h + a
+        f = nn.LayerNorm()(h)
+        f = nn.Dense(self.d_ff)(f)
+        f = nn.gelu(f)
+        f = nn.Dense(self.d_model)(f)
+        return h + f
+
+
+class TransformerLM(nn.Module):
+    """Pre-LN causal decoder: embed + learned positions -> N blocks ->
+    LN -> vocab projection."""
+    vocab_size: int = 10004
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 512
+    # LEAF-shakespeare mode: one next-token logit from the final position
+    # (same contract as RNNOriginalFedAvg(last_only=True))
+    last_only: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.int32)
+        T = x.shape[-1]
+        h = nn.Embed(self.vocab_size, self.d_model)(x)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_len, self.d_model))
+        h = h + pos[:T].astype(h.dtype)
+        causal = np.tril(np.ones((T, T), bool))[None, None]
+        for _ in range(self.n_layers):
+            h = _Block(self.d_model, self.n_heads, self.d_ff)(
+                h, jnp.asarray(causal))
+        h = nn.LayerNorm()(h)
+        if self.last_only:
+            h = h[:, -1]
+        return nn.Dense(self.vocab_size)(h)

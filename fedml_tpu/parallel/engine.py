@@ -272,8 +272,12 @@ class MeshFedAvgEngine(FedAvgEngine):
     # -- device data ----------------------------------------------------------
     def _cast_stack_x(self, shards: dict) -> dict:
         """Apply stack_dtype to the input leaf (see __init__); identity
-        when unset."""
-        if self.stack_dtype is not None and "x" in shards:
+        when unset — and for INTEGER inputs (token ids on the text
+        datasets): bf16 represents integers exactly only up to 256, so
+        casting ids would silently remap most of a 10k vocabulary."""
+        if (self.stack_dtype is not None and "x" in shards
+                and np.issubdtype(np.asarray(shards["x"]).dtype,
+                                  np.floating)):
             shards = dict(shards)
             shards["x"] = np.asarray(shards["x"],
                                      jnp.dtype(self.stack_dtype))

@@ -361,6 +361,18 @@ def test_stack_dtype_bf16_close_to_f32():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=0.05, atol=0.02)
 
+    # INTEGER inputs (token ids on text datasets) must never be cast:
+    # bf16 is exact only to 256, so casting ids silently remaps vocab
+    int_data = _setup(cfg)[1]
+    int_data.client_shards["x"] = np.asarray(
+        (np.abs(int_data.client_shards["x"][..., :1]) * 1000),
+        np.int32)
+    eng = MeshFedAvgEngine(trainer, int_data, cfg, mesh=make_mesh(8),
+                           donate=False, streaming=True,
+                           stack_dtype=jnp.bfloat16)
+    cohort, _w = eng.stream_cohort(0)
+    assert cohort["x"].dtype == jnp.int32
+
 
 @pytest.mark.parametrize("defense", ["median", "krum", "trimmed_mean"])
 def test_mesh_orderstat_defense_matches_single_device(defense):

@@ -530,6 +530,38 @@ def exp_R():
 # run_scanned was cut from the engine (VERDICT r2 next-#6; PERF.md).
 
 
+def exp_NWP():
+    """StackOverflow-NWP per-client local epoch: reference LSTM
+    (RNNStackOverflow, 4.1M total params, sequential scan over 20 tokens)
+    vs the beyond-reference TransformerLM at ~2× the total params
+    (d256/4L/ff1024, 8.4M): does attention's batched-matmul formulation
+    beat the LSTM's length-T dependency chain on the MXU?  (Both printed
+    counts are TOTALS over all param leaves, embeddings included.)"""
+    import jax.numpy as jnp
+
+    B, bs, T = 13, 16, 20
+    rs = np.random.RandomState(0)
+    shard = {
+        "x": jnp.asarray(rs.randint(0, 10004, (B, bs, T)), jnp.int32),
+        "y": jnp.asarray(rs.randint(0, 10004, (B, bs, T)), jnp.int64),
+        "mask": jnp.ones((B, bs), jnp.float32),
+    }
+    for name, kw in (("rnn_stackoverflow", {}),
+                     ("transformer", dict(d_model=256, n_heads=4,
+                                          n_layers=4, d_ff=1024))):
+        model = create_model(name, 10004, **kw)
+        trainer = ClientTrainer(model, lr=0.3, has_time_axis=True,
+                                train_dtype=jnp.bfloat16)
+        v = trainer.init(jax.random.PRNGKey(0), shard["x"][0, :1])
+        n_params = sum(int(np.prod(a.shape))
+                       for a in jax.tree.leaves(v["params"]))
+        fn = jax.jit(lambda vv, s, r: trainer.local_train(vv, s, r, 1)[1])
+        rng = jax.random.PRNGKey(1)
+        dt = timeit(lambda: fn(v, shard, rng), warmup=2, iters=10)
+        print(f"NWP {name} ({n_params/1e6:.1f}M params): "
+              f"{dt*1e3:.2f} ms per 13-step local epoch", flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
