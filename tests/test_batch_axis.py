@@ -191,3 +191,25 @@ def test_batch_axis_indivisible_raises():
     with pytest.raises(ValueError, match="divide"):
         MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh_batch(2, 3),
                          donate=False)
+
+
+def test_batch_axis_composes_with_stack_dtype_and_unroll():
+    """The three round-3 perf levers compose: a clients x batch mesh with
+    bf16 cohort storage and an unrolled batch scan still trains close to
+    the plain single-device run (stack_dtype is a precision tradeoff, so
+    closeness not equality; unroll and the batch split are exact)."""
+    cfg = _cfg()
+    trainer, data = _setup(cfg)
+    ref = FedAvgEngine(trainer, data, cfg, donate=False)
+    v0 = ref.init_variables()
+    v_ref = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+
+    import copy
+    tr2 = copy.copy(trainer)
+    tr2.batch_unroll = 2
+    eng = MeshFedAvgEngine(tr2, data, cfg, mesh=make_mesh_batch(2, 4),
+                           stack_dtype=jnp.bfloat16, donate=False)
+    stack, _w = eng._device_stack()
+    assert stack["x"].dtype == jnp.bfloat16
+    v_b = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    _assert_close(v_ref, v_b, rtol=0.05, atol=0.02)   # bf16-input band
