@@ -34,7 +34,9 @@ N_CLIENTS = 128
 BATCH_SIZE = 32
 SAMPLES_PER_CLIENT = 50_000 // N_CLIENTS      # ≈ CIFAR10 over 128 clients
 WARMUP_ROUNDS = 2
-TIMED_ROUNDS = 5
+TIMED_ROUNDS = 8     # measured run-to-run spread at 5 was 0.544-0.549
+                     # rounds/sec; 8 tightens the single-run estimate
+                     # for ~5 s extra driver time
 
 
 def _probe_devices(timeout: float) -> tuple[bool, str]:
