@@ -17,7 +17,9 @@ sample (ResNet-18 @32×32 ≈ 0.58 GFLOP fwd) ≈ 1.7e14 FLOP/round; 8×V100
 at 125 TFLOP/s peak fp16 and a generous 35% utilization ≈ 350 TFLOP/s
 ⇒ ~0.5 s/round ⇒ ~2.0 rounds/s. We use 2.0 — conservative (favors the
 reference: real FedML additionally pays MPI serialization + CPU
-aggregation per round).
+aggregation per round).  Sensitivity of vs_baseline to the utilization
+assumption ({25%, 35%, 50%} ⇒ denominator 1.47/2.06/2.94) is tabulated
+in PERF.md §"Baseline sensitivity".
 """
 from __future__ import annotations
 

@@ -183,6 +183,11 @@ class FedAvgEngine:
             out.update(self.evaluate_local(variables))
         return out
 
+    def _local_eval_transform(self, shard: dict) -> dict:
+        """Per-client shard hook inside evaluate_local's vmap (mesh
+        engines restore flat_stack x here; identity for this engine)."""
+        return shard
+
     def _upload_eval_stack(self, shards):
         """Device placement for the [C,...] per-client eval stack (mesh
         engines override to shard the client axis — evaluate_local must
@@ -209,16 +214,27 @@ class FedAvgEngine:
                              "host; evaluate_local would materialize it "
                              "in HBM")
         if self._local_eval_fn is None:
+            # _local_eval_transform: mesh engines restore flat_stack x
+            # in-program before the per-client eval (identity here)
             self._local_eval_fn = jax.jit(jax.vmap(
-                self.trainer.evaluate, in_axes=(None, 0)))
+                lambda v, s: self.trainer.evaluate(
+                    v, self._local_eval_transform(s)),
+                in_axes=(None, 0)))
         if split not in self._local_eval_shards:
             if split == "train" and not self.cfg.ci:
                 # a train stack is already device-resident for cohorts —
                 # reuse it rather than holding a second HBM copy: the mesh
                 # engine's padded sharded stack (zero-weight pad lanes
                 # have mask 0, so they add nothing to the sums), else the
-                # plain engine's device_shards cache
+                # plain engine's device_shards cache.  Only a [C, ...]
+                # stack qualifies (the hierarchical engine keeps a
+                # silo-major [S, C/S, ...] layout — fall through to a
+                # fresh upload there).
                 resident = getattr(self, "_stack", None)
+                if (resident is not None
+                        and resident["mask"].ndim
+                        != np.asarray(self.data.client_shards["mask"]).ndim):
+                    resident = None
                 self._local_eval_shards[split] = (
                     resident if resident is not None
                     else self.data.device_shards()[0])

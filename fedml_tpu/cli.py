@@ -123,6 +123,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(measured knee 1.32x -> 1.06x at 1024; "
                         "PERF.md); inputs at bf16 precision is an "
                         "accuracy tradeoff")
+    p.add_argument("--no_flat_stack", action="store_true",
+                   help="disable flat image-cohort storage (mesh "
+                        "engines store image inputs [C,B,bs,h*w*c] and "
+                        "restore per chunk in-scan; avoids XLA's padded "
+                        "tiled relayout of small minor dims — measured "
+                        "on v5e: removes the 1024-cohort knee outright "
+                        "and unblocks 2048-client bf16 cohorts that "
+                        "otherwise OOM in compile, SCALING.md)")
     p.add_argument("--mesh", action="store_true",
                    help="shard the cohort over all visible devices")
     p.add_argument("--mesh_batch", type=int, default=None,
@@ -343,7 +351,8 @@ def build_engine(args, cfg: FedConfig, data):
             return cls(trainer, data, cfg, mesh=mesh,
                        streaming=args.streaming, chunk=args.cohort_chunk,
                        local_dtype=_local_dtype(args),
-                       stack_dtype=_stack_dtype(args), **kw)
+                       stack_dtype=_stack_dtype(args),
+                       flat_stack=not args.no_flat_stack, **kw)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
             if mesh is not None and (args.streaming or args.cohort_chunk
@@ -377,7 +386,8 @@ def build_engine(args, cfg: FedConfig, data):
             return MeshHierarchicalEngine(
                 _trainer(cfg, data), data, cfg, mesh=mesh2,
                 group_comm_round=args.group_comm_round,
-                chunk=args.cohort_chunk, local_dtype=_local_dtype(args))
+                chunk=args.cohort_chunk, local_dtype=_local_dtype(args),
+                flat_stack=not args.no_flat_stack)
         from fedml_tpu.algorithms import HierarchicalFedAvgEngine
         return HierarchicalFedAvgEngine(
             _trainer(cfg, data), data, cfg, group_num=args.group_num,

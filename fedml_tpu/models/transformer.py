@@ -56,6 +56,10 @@ class TransformerLM(nn.Module):
     def __call__(self, x, train: bool = False):
         x = x.astype(jnp.int32)
         T = x.shape[-1]
+        if T > self.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len={self.max_len}; "
+                f"construct TransformerLM with a larger max_len")
         h = nn.Embed(self.vocab_size, self.d_model)(x)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (self.max_len, self.d_model))

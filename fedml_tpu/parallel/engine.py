@@ -102,10 +102,31 @@ def default_chunk(local_dtype) -> int:
     return 2 if local_dtype == jnp.bfloat16 else 8
 
 
+def flatten_stack_x(shards: dict):
+    """flat_stack flatten (host-side view): image x [C, B, bs, h, w(, c)]
+    -> [C, B, bs, prod]; returns (shards, image_shape) with
+    image_shape None when x is not image-shaped.  Rationale in
+    MeshFedAvgEngine.__init__ (flat_stack)."""
+    x = np.asarray(shards["x"]) if "x" in shards else None
+    if x is None or x.ndim < 5:
+        return shards, None
+    return {**shards, "x": x.reshape(x.shape[:3] + (-1,))}, x.shape[3:]
+
+
+def restore_chunk_x(image_shape, chunk_shards: dict) -> dict:
+    """Undo flatten_stack_x on one in-scan chunk slice: [chunk, B, bs, F]
+    -> [chunk, B, bs, *image].  Exact (a reshape), O(chunk) memory."""
+    if image_shape is None or "x" not in chunk_shards:
+        return chunk_shards
+    x = chunk_shards["x"]
+    return {**chunk_shards, "x": x.reshape(x.shape[:3] + tuple(image_shape))}
+
+
 def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
                            epochs, vary_axes, chunk_cap: int = 8,
                            client_transform=None,
-                           emit_flat_params: bool = False):
+                           emit_flat_params: bool = False,
+                           restore_x=None):
     """Train a shard-local cohort as a lax.scan over chunks of at most
     `chunk_cap` vmapped clients, accumulating Σ w·v / Σ w / Σ w·loss in the
     carry — the HBM-bounded inner loop shared by the flat and hierarchical
@@ -137,6 +158,8 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
     def chunk_body(carry, xs):
         num, den, lsum = carry
         cs, cw, cr = xs
+        if restore_x is not None:      # flat_stack: image shape back,
+            cs = restore_x(cs)         # O(chunk) per trip
         vs, losses = jax.vmap(one)(cs, cr)
         if client_transform is not None:
             vs = jax.vmap(client_transform,
@@ -201,9 +224,19 @@ class MeshFedAvgEngine(FedAvgEngine):
                  cfg: FedConfig, mesh: Optional[Mesh] = None,
                  donate: bool = True, chunk: Optional[int] = None,
                  streaming: bool = False, local_dtype=None,
-                 stack_dtype=None,
+                 stack_dtype=None, flat_stack: bool = True,
                  allow_batch_stats: bool = False):
         self.allow_batch_stats = allow_batch_stats
+        # flat_stack stores image cohorts as [C, B, bs, h*w*c] on device
+        # and restores [h, w, c] per chunk INSIDE the scan: XLA assigns
+        # the big input a tiled layout padded on small minor dims —
+        # measured on v5e at the 2048-client bf16 cohort: a 4x-padded
+        # relayout copy (bf16[2048,13,32,32,32,3] -> 20.9 GB vs 5.2 GB
+        # unpadded) that OOMs 15.75 GB HBM in compile.  The flat layout
+        # tiles cleanly (minor dim h*w*c = 3072 = 24*128); only the
+        # O(chunk) slice materializes in image layout per scan trip.
+        self.flat_stack = flat_stack
+        self._x_image_shape = None
         # stack_dtype stores the client stack's INPUT leaf ("x") in this
         # dtype on device — bf16 halves the cohort's HBM footprint and
         # upload bytes, which is what prices in past ~512 bench-shaped
@@ -214,6 +247,7 @@ class MeshFedAvgEngine(FedAvgEngine):
         # weights).  Opt-in: inputs at bf16 precision is an accuracy
         # tradeoff the user chooses (tests pin closeness to f32).
         self.stack_dtype = stack_dtype
+        self._stack_dtype_noop_warned = False
         self.mesh = mesh if mesh is not None else make_mesh()
         # a "batch" mesh axis splits each client's per-step batch over
         # devices (per-client sample parallelism: mesh.py BATCH_AXIS, the
@@ -275,13 +309,39 @@ class MeshFedAvgEngine(FedAvgEngine):
         when unset — and for INTEGER inputs (token ids on the text
         datasets): bf16 represents integers exactly only up to 256, so
         casting ids would silently remap most of a 10k vocabulary."""
-        if (self.stack_dtype is not None and "x" in shards
-                and np.issubdtype(np.asarray(shards["x"]).dtype,
-                                  np.floating)):
-            shards = dict(shards)
-            shards["x"] = np.asarray(shards["x"],
-                                     jnp.dtype(self.stack_dtype))
+        if self.stack_dtype is not None and "x" in shards:
+            if np.issubdtype(np.asarray(shards["x"]).dtype, np.floating):
+                shards = dict(shards)
+                shards["x"] = np.asarray(shards["x"],
+                                         jnp.dtype(self.stack_dtype))
+            elif not self._stack_dtype_noop_warned:
+                self._stack_dtype_noop_warned = True
+                log.warning(
+                    "stack_dtype=%s ignored: the input leaf is %s (token-id "
+                    "datasets keep integer inputs — casting would remap the "
+                    "vocabulary)", self.stack_dtype,
+                    np.asarray(shards["x"]).dtype)
+        if self.flat_stack:
+            shards, image_shape = flatten_stack_x(shards)
+            if image_shape is not None:
+                self._x_image_shape = image_shape
         return shards
+
+    def _restore_chunk_x(self, chunk_shards: dict) -> dict:
+        """Undo flat_stack on one in-scan chunk slice (restore_chunk_x)."""
+        return restore_chunk_x(self._x_image_shape, chunk_shards)
+
+    def _local_eval_transform(self, shard: dict) -> dict:
+        """Per-client shard hook inside evaluate_local's vmap: the
+        resident stack reused there stores x FLAT under flat_stack —
+        restore [B, bs, F] -> [B, bs, *image] in-program (uploaded
+        unflattened stacks pass through on the ndim check)."""
+        if (self._x_image_shape is not None and "x" in shard
+                and shard["x"].ndim == 3):
+            x = shard["x"]
+            return {**shard, "x": x.reshape(x.shape[:2]
+                                            + tuple(self._x_image_shape))}
+        return shard
 
     def _device_stack(self):
         """Upload the [C,...] client stack ONCE, leading axis sharded over the
@@ -321,7 +381,8 @@ class MeshFedAvgEngine(FedAvgEngine):
         num, den, lsum = chunked_weighted_train(
             self.trainer, local_vars, cohort, weights, client_rngs,
             self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk,
-            client_transform=self.client_transform)
+            client_transform=self.client_transform,
+            restore_x=self._restore_chunk_x)
         num = jax.lax.psum(num, axes)
         den = jax.lax.psum(den, axes)
         avg = jax.tree.map(
@@ -513,6 +574,7 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
         def chunk_body(carry, xs):
             dsum, rest_num, den, tsum, lsum = carry
             cs, cw, cr = xs
+            cs = self._restore_chunk_x(cs)      # flat_stack (engine.py)
             vs, losses, taus = jax.vmap(one)(cs, cr)
             v_params, v_rest = split(vs)
             # params: Σ w·(g − v)/τ  (zero-weight pad lanes contribute 0)
@@ -622,7 +684,7 @@ class MeshRobustEngine(MeshFedAvgEngine):
         num, den, lsum, flats = chunked_weighted_train(
             self.trainer, local_vars, cohort, weights, client_rngs,
             self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk,
-            emit_flat_params=True)
+            emit_flat_params=True, restore_x=self._restore_chunk_x)
         rest_num = {k: v for k, v in num.items() if k != "params"}
         # [n_chunks, chunk, P] -> this shard's clients; drop the in-chunk
         # pad lanes (they sit at the STATIC tail of the local stack)

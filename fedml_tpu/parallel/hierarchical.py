@@ -31,6 +31,7 @@ from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.trainer import ClientTrainer
 from fedml_tpu.data.federated import FederatedData
 from fedml_tpu.parallel.engine import (cast_local, chunked_weighted_train,
+                                       flatten_stack_x, restore_chunk_x,
                                        default_chunk)
 from fedml_tpu.parallel.mesh import (CLIENT_AXIS, SILO_AXIS, make_mesh_2d,
                                      pvary_tree)
@@ -52,12 +53,17 @@ class MeshHierarchicalEngine(FedAvgEngine):
                  cfg: FedConfig, n_silos: int = 2,
                  group_comm_round: int = 1,
                  mesh: Optional[Mesh] = None, donate: bool = True,
-                 chunk: Optional[int] = None, local_dtype=None):
+                 chunk: Optional[int] = None, local_dtype=None,
+                 flat_stack: bool = True):
         if chunk is not None and chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.chunk = (chunk if chunk is not None
                       else default_chunk(local_dtype))
         self.local_dtype = local_dtype   # bf16 local masters (engine.py)
+        # flat image-cohort storage + per-chunk restore, same rationale
+        # and helpers as MeshFedAvgEngine (engine.py flat_stack)
+        self.flat_stack = flat_stack
+        self._x_image_shape = None
         self.mesh = mesh if mesh is not None else make_mesh_2d(n_silos)
         self.n_silos = self.mesh.shape[SILO_AXIS]
         self.per_silo_shards = self.mesh.shape[CLIENT_AXIS]
@@ -86,7 +92,12 @@ class MeshHierarchicalEngine(FedAvgEngine):
                     z = np.zeros((S, pad) + a.shape[2:], a.dtype)
                     a = np.concatenate([a, z], axis=1)
                 return jax.device_put(a, sh)
-            self._stack = {k: up(v) for k, v in self.data.client_shards.items()}
+            shards = dict(self.data.client_shards)
+            if self.flat_stack:
+                shards, image_shape = flatten_stack_x(shards)
+                if image_shape is not None:
+                    self._x_image_shape = image_shape
+            self._stack = {k: up(v) for k, v in shards.items()}
             w = np.asarray(self.data.client_num_samples, np.float32)
             self._stack_w = up(w)
             self._cs_padded = Cs + pad
@@ -148,7 +159,9 @@ class MeshHierarchicalEngine(FedAvgEngine):
                 num, den, lsum = chunked_weighted_train(
                     trainer, local_vars, cohort, weights, crngs, epochs,
                     vary_axes=(SILO_AXIS, CLIENT_AXIS),
-                    chunk_cap=self.chunk)
+                    chunk_cap=self.chunk,
+                    restore_x=lambda cs: restore_chunk_x(
+                        self._x_image_shape, cs))
                 num = jax.lax.psum(num, CLIENT_AXIS)        # ICI tier
                 den = jax.lax.psum(den, CLIENT_AXIS)
                 silo_vars = jax.tree.map(

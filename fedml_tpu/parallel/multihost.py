@@ -62,18 +62,20 @@ def init_multihost(coordinator_address: Optional[str] = None,
         # jax.distributed.initialize forbids (see module docstring) — so
         # the option is set whenever it is not already configured (it
         # only affects the cpu backend; TPU pods use ICI/DCN natively).
-        try:
-            cur = getattr(jax.config,
-                          "jax_cpu_collectives_implementation", "absent")
-            if cur in (None, "", "none"):
-                # unset/disabled only (this jaxlib's default is already
-                # "gloo"): an operator's explicit transport choice (env
-                # JAX_CPU_COLLECTIVES_IMPLEMENTATION=mpi or a prior
-                # config.update) must win
-                jax.config.update("jax_cpu_collectives_implementation",
-                                  "gloo")
-        except Exception:       # older jaxlib: option absent
-            pass
+        # getattr's default covers the older-jaxlib option-absent case
+        # (cur = "absent" skips the update); a FAILING update on a jaxlib
+        # that HAS the option is a real configuration error and must not
+        # be swallowed — deferring it to the first cross-process psum
+        # yields a much worse message
+        cur = getattr(jax.config,
+                      "jax_cpu_collectives_implementation", "absent")
+        if cur in (None, "", "none"):
+            # unset/disabled only (this jaxlib's default is already
+            # "gloo"): an operator's explicit transport choice (env
+            # JAX_CPU_COLLECTIVES_IMPLEMENTATION=mpi or a prior
+            # config.update) must win
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -47,13 +47,15 @@ def build_case():
                             mesh=make_mesh(8), donate=False)
 
 
-def build_hier_case(multihost: bool):
+def build_hier_case(multihost: bool, silos: int = 2):
     """Two-tier hierarchical engine over a (silo × clients) mesh: with
     multihost=True the mesh comes from make_hierarchical_host_mesh (one
     silo per PROCESS — the inner psum stays host-local, only the silo
     tier crosses the process boundary, i.e. the DCN layout); the
-    single-process oracle uses the same 2×4 logical mesh over its 8
-    local devices.  Same data as build_case (shared _case_data_cfg);
+    single-process oracle uses the same silos×(8//silos) logical mesh
+    over its 8 local devices (device order is process-sorted on both
+    sides, so the silo grouping is identical and the digests are
+    comparable).  Same data as build_case (shared _case_data_cfg);
     fewer global rounds — each runs group_comm_round inner rounds."""
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.models import create_model
@@ -62,12 +64,32 @@ def build_hier_case(multihost: bool):
     from fedml_tpu.parallel.mesh import make_mesh_2d
 
     data, cfg = _case_data_cfg(comm_round=2)
-    mesh = (make_hierarchical_host_mesh(silos=2) if multihost
-            else make_mesh_2d(n_silos=2))
+    mesh = (make_hierarchical_host_mesh(silos=silos) if multihost
+            else make_mesh_2d(n_silos=silos))
     model = create_model("lr", output_dim=10)
     return MeshHierarchicalEngine(ClientTrainer(model, lr=cfg.lr), data,
                                   cfg, mesh=mesh, group_comm_round=2,
                                   donate=False)
+
+
+def build_fedopt_streaming_case():
+    """Streaming cohort + FedOpt server state across the process
+    boundary (VERDICT r3 weak-#6): per-round host-gathered cohort upload
+    (stream_cohort's global device_put) AND an adam server-optimizer
+    state that persists on device between rounds — the two pieces of
+    round state the flat resident case never exercises multi-host."""
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel import MeshFedOptEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    data, cfg = _case_data_cfg(comm_round=3)
+    cfg = type(cfg)(**{**cfg.__dict__, "server_optimizer": "adam",
+                       "server_lr": 0.05})
+    model = create_model("lr", output_dim=10)
+    return MeshFedOptEngine(ClientTrainer(model, lr=cfg.lr), data, cfg,
+                            mesh=make_mesh(8), streaming=True,
+                            donate=False)
 
 
 def digest(variables):

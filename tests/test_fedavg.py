@@ -213,6 +213,35 @@ def test_local_train_eval_always_available():
         eng.evaluate_local(v, split="validation")
 
 
+def test_local_train_eval_mesh_flat_stack_conv():
+    """Regression (round-4 review): the mesh engine's resident stack is
+    stored FLAT under flat_stack; evaluate_local(split='train') reuses
+    that stack and must restore the image shape in-program — a conv
+    model crashed on the flattened x before the _local_eval_transform
+    hook."""
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    data = load_data("femnist", client_num_in_total=8, batch_size=4,
+                     synthetic_scale=0.001, max_batches_per_client=1,
+                     seed=0)
+    cfg = FedConfig(client_num_in_total=8, client_num_per_round=8,
+                    comm_round=1, batch_size=4, lr=0.1,
+                    frequency_of_the_test=100)
+    eng = MeshFedAvgEngine(ClientTrainer(create_model("cnn", data.class_num),
+                                         lr=0.1),
+                           data, cfg, mesh=make_mesh(), donate=False)
+    assert eng.flat_stack
+    v = eng.init_variables()
+    v = eng._prepare_variables(v)
+    eng._device_stack()               # builds the (flat) resident stack
+    assert eng._x_image_shape == (28, 28, 1)
+    m = eng.evaluate_local(v, split="train")
+    assert 0.0 <= m["local_train_acc"] <= 1.0
+    assert np.isfinite(m["local_train_loss"])
+
+
 def test_centralized_mesh_batch_parallel_matches_single():
     """CentralizedTrainer with a mesh = the reference's DDP as a
     batch-sharded axis: results match the unsharded trainer (zero-mask

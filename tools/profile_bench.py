@@ -47,10 +47,18 @@ def timeit(fn, warmup=2, iters=5):
     return (time.perf_counter() - t0) / iters
 
 
-def client_batches(rs, n_clients=N_CLIENTS, n_batches=N_BATCHES):
-    x = rs.rand(n_clients, n_batches, BS, 32, 32, 3).astype(np.float32)
-    y = rs.randint(0, 10, (n_clients, n_batches, BS)).astype(np.int32)
-    m = np.ones((n_clients, n_batches, BS), np.float32)
+def client_batches(rs, n_clients=N_CLIENTS, n_batches=N_BATCHES, bs=BS,
+                   valid=None):
+    """Synthetic per-client batch stacks.  `valid` marks only the first
+    `valid` slots per client real (engine-style ragged padding); padded
+    slots still run full conv compute — masks gate the loss/update math,
+    not the FLOPs — so timing is slot-driven either way."""
+    x = rs.rand(n_clients, n_batches, bs, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 10, (n_clients, n_batches, bs)).astype(np.int32)
+    m = np.ones((n_clients, n_batches * bs), np.float32)
+    if valid is not None:
+        m[:, valid:] = 0.0
+    m = m.reshape(n_clients, n_batches, bs)
     return {"x": jnp.asarray(x), "y": jnp.asarray(y), "mask": jnp.asarray(m)}
 
 
@@ -150,11 +158,14 @@ def _cohort_scale_round(C: int, data_dtype=None):
                                             weights, rng)
         return m["train_loss"]
 
-    dt = timeit(round_once, warmup=1, iters=2)
+    dt = timeit(round_once, warmup=1, iters=4)
     gb = cohort["x"].nbytes / 1e9
-    print(f"C{C} cohort-scale: {dt:.3f}s/round  upload {t_up:.1f}s "
-          f"({gb:.2f} GB)  vs bench-128 "
-          f"{dt / BENCH_128_S * 128 / C:.2f}x/client", flush=True)
+    tag = "bf16-stack" if data_dtype is not None else "f32-stack"
+    print(f"C{C} cohort-scale ({tag}, 4 timed rounds): {dt:.3f}s/round  "
+          f"upload {t_up:.1f}s ({gb:.2f} GB)  vs bench-128 "
+          f"{dt / BENCH_128_S * 128 / C:.2f}x/client "
+          f"(denominator: standalone L2U8 {BENCH_128_S}s, "
+          f"chunk2/bf16-masters/unroll8)", flush=True)
 
 
 def exp_C512():
@@ -173,25 +184,37 @@ def exp_C1024H():
     _cohort_scale_round(1024, data_dtype=jnp.bfloat16)
 
 
-def exp_B(batch_unroll: int = 1):
-    """Centralized ceiling: shared weights, 13 steps of effective batch
-    4096.  `batch_unroll` must match the recipe of the round it anchors
-    (exp_BU8 for the committed unroll-8 recipe) — comparing a U8 round
-    against a U1 ceiling would conflate the unroll win with the
+def exp_C2048H():
+    """Extend the cohort curve past 1024: 2048 clients with bf16 cohort
+    storage (4.9 GB on device; f32 would be 9.8 GB and contend with the
+    model chunk) — where does the bf16 stack knee? (VERDICT r3 next-#5)."""
+    _cohort_scale_round(2048, data_dtype=jnp.bfloat16)
+
+
+def exp_B(batch_unroll: int = 1, bs: int = BS, n_batches: int = None,
+          tag: str = "B"):
+    """Centralized ceiling: shared weights, ceil(SPC/bs) steps (or an
+    explicit `n_batches` for slot-matched variants) of effective batch
+    bs*128.  `batch_unroll` must match the recipe of the round it
+    anchors (exp_BU8 for the committed unroll-8 recipe) — comparing a U8
+    round against a U1 ceiling would conflate the unroll win with the
     grouped-conv cost."""
+    if n_batches is None:
+        n_batches = (SPC + bs - 1) // bs
     model = create_model("resnet18_gn", output_dim=10)
     trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16,
                             batch_unroll=batch_unroll)
     rs = np.random.RandomState(0)
-    x = rs.rand(N_BATCHES, BS * N_CLIENTS, 32, 32, 3).astype(np.float32)
-    y = rs.randint(0, 10, (N_BATCHES, BS * N_CLIENTS)).astype(np.int32)
+    x = rs.rand(n_batches, bs * N_CLIENTS, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 10, (n_batches, bs * N_CLIENTS)).astype(np.int32)
     shard = {"x": jnp.asarray(x), "y": jnp.asarray(y),
-             "mask": jnp.ones((N_BATCHES, BS * N_CLIENTS), np.float32)}
+             "mask": jnp.ones((n_batches, bs * N_CLIENTS), np.float32)}
     variables = trainer.init(jax.random.PRNGKey(0), shard["x"][0, :1])
     fn = jax.jit(lambda v, s, r: trainer.local_train(v, s, r, 1)[1])
     rng = jax.random.PRNGKey(1)
     dt = timeit(lambda: fn(variables, shard, rng))
-    print(f"B centralized_ceiling(unroll={batch_unroll}): "
+    print(f"{tag} centralized_ceiling(unroll={batch_unroll},bs={bs},"
+          f"{n_batches}x{bs * N_CLIENTS} slots): "
           f"{dt:.3f}s/round-equivalent", flush=True)
 
 
@@ -200,7 +223,7 @@ def exp_BU8():
 
 
 def _chunked_round(chunk, data_dtype=None, master_dtype=None,
-                   model_fn=None, unroll=1):
+                   model_fn=None, unroll=1, bs=BS, valid=None):
     """THE chunked-round harness (every experiment row shares this exact
     accumulation + timing protocol):
       chunk        -- live client replicas per scan trip
@@ -209,12 +232,17 @@ def _chunked_round(chunk, data_dtype=None, master_dtype=None,
                       engine's local_dtype — aggregation stays f32)
       model_fn     -- alternative model constructor (G rows)
       unroll       -- lax.scan unroll depth for the batch loop (U rows)
+      bs/valid     -- per-step batch size and real-sample count (BS rows:
+                      same SPC real samples/client, ceil(SPC/bs) padded
+                      batches — the padding slots are part of the recipe's
+                      cost, exactly as the engine would pay them)
     """
+    n_batches = (SPC + bs - 1) // bs
     model = model_fn() if model_fn else create_model("resnet18_gn",
                                                      output_dim=10)
     trainer = ClientTrainer(model, lr=0.1, train_dtype=jnp.bfloat16)
     rs = np.random.RandomState(0)
-    shard = client_batches(rs)
+    shard = client_batches(rs, n_batches=n_batches, bs=bs, valid=valid)
     if data_dtype is not None:
         shard = {"x": shard["x"].astype(data_dtype), "y": shard["y"],
                  "mask": shard["mask"]}
@@ -331,6 +359,96 @@ def exp_L2U13():
     print(f"L2U13 chunked(2,bf16 masters,unroll=13 = full): "
           f"{_chunked_round(2, master_dtype=jnp.bfloat16, unroll=13):.3f}"
           f"s/round", flush=True)
+
+
+def _bs_variant_round(bs, unroll):
+    """The committed round recipe (chunk 2, bf16 masters) at an alternate
+    per-step batch size — VERDICT r3 next-#1: the reference's own CIFAR10
+    cross-silo recipe runs bs=64 (reference benchmark/README.md:102-105),
+    and the shared-weight ceiling is bandwidth-bound at bs-per-replica 32,
+    so a larger batch plausibly lifts both the round and the ceiling.
+    Same SPC=390 real samples/client; ceil(390/bs) padded batches."""
+    n_batches = (SPC + bs - 1) // bs
+    dt = _chunked_round(2, master_dtype=jnp.bfloat16, unroll=unroll,
+                        bs=bs, valid=SPC)
+    slots = n_batches * bs * N_CLIENTS
+    print(f"BS{bs} chunked(2,bf16 masters,unroll={unroll},"
+          f"{n_batches}x{bs}/client,{slots} slots): {dt:.3f}s/round",
+          flush=True)
+
+
+def exp_BS64():
+    _bs_variant_round(64, unroll=7)        # 7 batches -> full unroll
+
+
+def exp_BS64C():
+    exp_B(batch_unroll=7, bs=64)
+
+
+def exp_BS128():
+    _bs_variant_round(128, unroll=4)       # 4 batches -> full unroll
+
+
+def exp_BS128C():
+    exp_B(batch_unroll=4, bs=128)
+
+
+def exp_BS32():
+    """bs=32 control at valid=SPC masks, same session as the BS rows."""
+    _bs_variant_round(32, unroll=8)
+
+
+def exp_BS256():
+    """bs=256: 2 batches of 256/client — same 512 slots/client as bs=128
+    but per-step conv batch 512 (chunk 2 x 256)."""
+    _bs_variant_round(256, unroll=2)
+
+
+def exp_BS128K1():
+    """bs=128 at chunk 1: per-step conv batch 128 (vs 256 at chunk 2),
+    half the live-replica HBM — does the chunk L-curve move with bs?"""
+    n_batches = (SPC + 128 - 1) // 128
+    dt = _chunked_round(1, master_dtype=jnp.bfloat16, unroll=4,
+                        bs=128, valid=SPC)
+    print(f"BS128K1 chunked(1,bf16 masters,unroll=4,"
+          f"{n_batches}x128/client): {dt:.3f}s/round", flush=True)
+
+
+def exp_BS128K4():
+    """bs=128 at chunk 4: per-step conv batch 512."""
+    n_batches = (SPC + 128 - 1) // 128
+    dt = _chunked_round(4, master_dtype=jnp.bfloat16, unroll=4,
+                        bs=128, valid=SPC)
+    print(f"BS128K4 chunked(4,bf16 masters,unroll=4,"
+          f"{n_batches}x128/client): {dt:.3f}s/round", flush=True)
+
+
+def exp_BS390K1():
+    """bs=390 = the whole shard as ONE batch (zero padding slots, 49,920
+    total — fewer than bs=32's 53,248), conv batch 390 at chunk 1.
+    Statistically a different optimizer (1 step/epoch); measured to map
+    the envelope, not as a bench candidate."""
+    dt = _chunked_round(1, master_dtype=jnp.bfloat16, unroll=1,
+                        bs=390, valid=SPC)
+    print(f"BS390K1 chunked(1,bf16 masters,1x390/client,49920 slots): "
+          f"{dt:.3f}s/round", flush=True)
+
+
+def exp_BS128K1U2():
+    """chunk1/bs128 at unroll 2 — is the 1.611 optimum unroll-sensitive?"""
+    dt = _chunked_round(1, master_dtype=jnp.bfloat16, unroll=2,
+                        bs=128, valid=SPC)
+    print(f"BS128K1U2 chunked(1,bf16 masters,unroll=2,4x128/client): "
+          f"{dt:.3f}s/round", flush=True)
+
+
+def exp_BS128C8():
+    """Slot-matched shared-weight ceiling for the bs=128 round: the true
+    4x16384 geometry OOMs v5e HBM (measured 16.59G/15.75G — itself a
+    datum: the grouped round FITS where the monolithic batch does not),
+    so the ceiling is taken at 8 steps of 8192 = the same 65,536 slots,
+    at the round's unroll (4)."""
+    exp_B(batch_unroll=4, bs=64, n_batches=8, tag="BS128C8")
 
 
 def exp_L1U8():
