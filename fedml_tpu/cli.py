@@ -123,6 +123,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(measured knee 1.32x -> 1.06x at 1024; "
                         "PERF.md); inputs at bf16 precision is an "
                         "accuracy tradeoff")
+    p.add_argument("--stream_block", type=int, default=None,
+                   help="block-streamed rounds (FedAvg-family mesh "
+                        "engines): upload the cohort in blocks of this "
+                        "many clients WITHIN each round (double-"
+                        "buffered), accumulating the linear sums on "
+                        "device — device data memory becomes O(block), "
+                        "so the cohort axis is bounded by host RAM, not "
+                        "HBM; the cohort's bytes cross host->device "
+                        "every round (SCALING.md).  Implies --streaming")
     p.add_argument("--no_flat_stack", action="store_true",
                    help="disable flat image-cohort storage (mesh "
                         "engines store image inputs [C,B,bs,h*w*c] and "
@@ -318,6 +327,13 @@ def build_engine(args, cfg: FedConfig, data):
         logging.getLogger(__name__).warning(
             "--stack_dtype reaches only the FedAvg-family mesh engines; "
             "ignored by %s", algo)
+    if args.stream_block is not None and (
+            mesh is None or algo not in ("fedavg", "fedopt", "fedprox",
+                                         "fednova", "fedavg_robust")):
+        logging.getLogger(__name__).warning(
+            "--stream_block reaches only the FedAvg-family MESH engines "
+            "(needs --mesh); ignored by %s%s", algo,
+            "" if mesh is not None else " without --mesh")
     if args.batch_unroll is not None and algo in ("fednas", "fedgan",
                                                   "fedgkt", "splitnn",
                                                   "vfl"):
@@ -352,7 +368,8 @@ def build_engine(args, cfg: FedConfig, data):
                        streaming=args.streaming, chunk=args.cohort_chunk,
                        local_dtype=_local_dtype(args),
                        stack_dtype=_stack_dtype(args),
-                       flat_stack=not args.no_flat_stack, **kw)
+                       flat_stack=not args.no_flat_stack,
+                       stream_block=args.stream_block, **kw)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
             if mesh is not None and (args.streaming or args.cohort_chunk

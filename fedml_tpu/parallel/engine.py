@@ -225,6 +225,7 @@ class MeshFedAvgEngine(FedAvgEngine):
                  donate: bool = True, chunk: Optional[int] = None,
                  streaming: bool = False, local_dtype=None,
                  stack_dtype=None, flat_stack: bool = True,
+                 stream_block: Optional[int] = None,
                  allow_batch_stats: bool = False):
         self.allow_batch_stats = allow_batch_stats
         # flat_stack stores image cohorts as [C, B, bs, h*w*c] on device
@@ -272,6 +273,21 @@ class MeshFedAvgEngine(FedAvgEngine):
         if chunk is not None and chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.chunk = chunk if chunk is not None else default_chunk(local_dtype)
+        # stream_block: block-streamed rounds — the cohort is uploaded in
+        # blocks of `stream_block` clients WITHIN the round (double-
+        # buffered), the linear sums accumulating on device across block
+        # steps.  Device data memory becomes O(stream_block) instead of
+        # O(cohort): the cohort axis is bounded by host RAM and upload
+        # bandwidth only, not HBM (SCALING.md).  Implies streaming.
+        if stream_block is not None:
+            if not getattr(self, "_supports_block_stream", True):
+                raise ValueError(
+                    f"{type(self).__name__} does not support stream_block: "
+                    + getattr(self, "_block_stream_unsupported_reason",
+                              "its aggregation is not wired for block "
+                              "accumulation"))
+            streaming = True
+        self.stream_block = stream_block
         self.streaming = streaming
         self.local_dtype = local_dtype
         super().__init__(trainer, data, cfg, donate=donate)
@@ -288,6 +304,21 @@ class MeshFedAvgEngine(FedAvgEngine):
             donate_argnums=(0, 1) if donate else ())
         if streaming:
             self.round_fn = self.round_fn_streaming
+        if self.stream_block is not None:
+            if self.stream_block < 1 or self.stream_block % self.n_shards:
+                raise ValueError(
+                    f"stream_block ({self.stream_block}) must be a "
+                    f"positive multiple of the mesh's client-shard count "
+                    f"({self.n_shards})")
+            # block accumulation step + round finalize: two small jitted
+            # programs the host loop drives per round (the accumulators
+            # are donated — no copies as blocks stream through)
+            self._block_step = jax.jit(self._block_step_impl,
+                                       donate_argnums=(1, 2, 3))
+            self._block_finalize = jax.jit(
+                self._block_finalize_impl,
+                donate_argnums=(0, 1) if donate else ())
+            self.round_fn = self._round_blockstream
 
 
     # -- hooks ---------------------------------------------------------------
@@ -369,10 +400,12 @@ class MeshFedAvgEngine(FedAvgEngine):
         return shard_stack(self.mesh, shards)
 
     # -- the round program ----------------------------------------------------
-    def _shard_body(self, variables, cohort, weights, client_rngs):
+    def _shard_sums(self, variables, cohort, weights, client_rngs):
         """Per-shard cohort training (chunked_weighted_train) + one psum
-        pair over the mesh — the whole FedAvg aggregation is two
-        collectives (SURVEY.md §5)."""
+        tier over the mesh: returns the REPLICATED (Σ w·v, Σ w, Σ w·loss)
+        — the linear core shared by the whole-cohort round (_shard_body)
+        and the block-streamed round (_round_blockstream), which
+        accumulates these sums across blocks before dividing."""
         axes = self.mesh.axis_names
         # the global model arrives replicated; per-client training makes
         # it shard-varying, so cast up-front for the vma type system
@@ -383,12 +416,17 @@ class MeshFedAvgEngine(FedAvgEngine):
             self.cfg.epochs, vary_axes=axes, chunk_cap=self.chunk,
             client_transform=self.client_transform,
             restore_x=self._restore_chunk_x)
-        num = jax.lax.psum(num, axes)
-        den = jax.lax.psum(den, axes)
+        return (jax.lax.psum(num, axes), jax.lax.psum(den, axes),
+                jax.lax.psum(lsum, axes))
+
+    def _shard_body(self, variables, cohort, weights, client_rngs):
+        """Whole-cohort round body: the two-collective FedAvg aggregation
+        (SURVEY.md §5) — sums then the weighted mean."""
+        num, den, lsum = self._shard_sums(variables, cohort, weights,
+                                          client_rngs)
         avg = jax.tree.map(
             lambda s, ref: (s / den).astype(ref.dtype), num, variables)
-        loss = jax.lax.psum(lsum, axes) / den
-        return avg, loss
+        return avg, lsum / den
 
     def _train_and_update(self, variables, server_state, cohort, weights,
                           rng):
@@ -429,22 +467,105 @@ class MeshFedAvgEngine(FedAvgEngine):
         return self._train_and_update(variables, server_state, cohort,
                                       weights, rng)
 
+    def _host_gather_upload(self, ids) -> dict:
+        """THE host-gather upload pipeline (shared by stream_cohort and
+        _upload_block so the two streaming granularities can never
+        diverge): slice the host arrays, apply stack_dtype/flat_stack
+        (_cast_stack_x), async device_put with per-leaf sharding."""
+        host = self._cast_stack_x(
+            {k: np.take(np.asarray(v), ids, axis=0)
+             for k, v in self.data.client_shards.items()})
+        return {k: jax.device_put(v, stack_leaf_sharding(self.mesh, v))
+                for k, v in host.items()}
+
     def stream_cohort(self, round_idx: int):
         """Host-side cohort gather for the streaming path: the same padded
         sampling as the resident path, but slicing the HOST arrays and
         uploading only the cohort (chunk-multiple padding happens inside
         chunked_weighted_train)."""
         ids, wmask = self._sample_padded_np(round_idx)
-        host = self._cast_stack_x(
-            {k: np.take(np.asarray(v), ids, axis=0)
-             for k, v in self.data.client_shards.items()})
-        cohort = {k: jax.device_put(v, stack_leaf_sharding(self.mesh, v))
-                  for k, v in host.items()}
+        cohort = self._host_gather_upload(ids)
         weights = jax.device_put(
             np.take(np.asarray(self.data.client_num_samples,
                                np.float32), ids) * wmask,
             client_sharding(self.mesh))
         return cohort, weights
+
+    # -- block-streamed round (stream_block) ---------------------------------
+    def _block_step_impl(self, variables, num, den, lsum, block, weights,
+                         rngs):
+        """One block's contribution: shard_map the linear sums and fold
+        them into the round accumulators (donated)."""
+        specs = {k: stack_leaf_spec(self.mesh, v) for k, v in block.items()}
+        csh = P(self.client_axes)
+        bn, bd, bl = jax.shard_map(
+            self._shard_sums, mesh=self.mesh,
+            in_specs=(P(), specs, csh, csh), out_specs=(P(), P(), P()))(
+                variables, block, weights, rngs)
+        num = jax.tree.map(lambda a, b: a + b, num, bn)
+        return num, den + bd, lsum + bl
+
+    def _block_finalize_impl(self, variables, server_state, num, den, lsum,
+                             agg_rng):
+        avg = jax.tree.map(
+            lambda s, ref: (s / den).astype(ref.dtype), num, variables)
+        new_variables, server_state = self.server_update(
+            avg, variables, server_state, agg_rng)
+        return new_variables, server_state, {"train_loss": lsum / den}
+
+    def _upload_block(self, ids_blk, w_blk, rngs_blk):
+        """Host-gather + async device_put of one client block (the
+        double-buffer unit), via the shared _host_gather_upload pipeline."""
+        block = self._host_gather_upload(ids_blk)
+        weights = jax.device_put(w_blk, client_sharding(self.mesh))
+        rngs = jax.device_put(rngs_blk, client_sharding(self.mesh))
+        return block, weights, rngs
+
+    def _round_blockstream(self, variables, server_state, round_idx, rng):
+        """Block-streamed round: host loop uploads `stream_block`-client
+        blocks (next block's device_put overlaps the current block's
+        compute — jax dispatch is async) and the jitted block step
+        accumulates Σ w·v / Σ w / Σ w·loss on device; one finalize
+        divides and applies the server update.  Aggregation is linear,
+        so the result equals the whole-cohort streaming round up to
+        float summation order (oracle-pinned in tests/test_parallel.py);
+        the per-client rngs are the SAME (jax.random.split prefixes are
+        stable, and zero-weight pad lanes contribute exactly 0).
+
+        Device data memory is O(2 · stream_block · shard bytes) — the
+        cohort axis is unbounded by HBM.  The cost: the cohort's bytes
+        cross host→device EVERY round (the resident/streaming paths
+        upload once), so this path pays off when the cohort does not fit
+        HBM at all, and its round time is bounded below by upload
+        bandwidth."""
+        ids, wmask = self._sample_padded_np(round_idx)
+        B = self.stream_block
+        pad = (-len(ids)) % B
+        if pad:       # pad to a block multiple with zero-weight lanes
+            ids = np.concatenate([ids, np.repeat(ids[:1], pad)])
+            wmask = np.concatenate([wmask, np.zeros(pad, np.float32)])
+        K = len(ids)
+        w_all = (np.take(np.asarray(self.data.client_num_samples,
+                                    np.float32), ids) * wmask)
+        rng, agg_rng = jax.random.split(rng)
+        crngs = np.asarray(jax.random.split(rng, K))
+        num = jax.device_put(
+            jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                         variables), replicated_sharding(self.mesh))
+        den = jax.device_put(jnp.float32(0), replicated_sharding(self.mesh))
+        lsum = jax.device_put(jnp.float32(0),
+                              replicated_sharding(self.mesh))
+        nxt = self._upload_block(ids[:B], w_all[:B], crngs[:B])
+        for start in range(0, K, B):
+            cur = nxt
+            if start + B < K:
+                s2 = start + B
+                nxt = self._upload_block(ids[s2:s2 + B], w_all[s2:s2 + B],
+                                         crngs[s2:s2 + B])
+            num, den, lsum = self._block_step(variables, num, den, lsum,
+                                              *cur)
+        return self._block_finalize(variables, server_state, num, den,
+                                    lsum, agg_rng)
 
     # NOTE: a fully on-device multi-round path (`run_scanned`: whole blocks
     # of rounds as one lax.scan program, in-program fold-in sampling) was
@@ -481,6 +602,9 @@ class MeshFedAvgEngine(FedAvgEngine):
         return jax.device_put(variables, replicated_sharding(self.mesh))
 
     def _round_args(self, round_idx: int) -> tuple:
+        if self.stream_block is not None:
+            # block-streamed rounds gather their own blocks on the fly
+            return (round_idx,)
         if self.streaming:
             # double-buffered uploads: jax.device_put is asynchronous, so
             # kicking off round r+1's transfer now overlaps it with round
@@ -548,6 +672,13 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
     with τ_eff = Σᵢ pᵢτᵢ.  All three reductions are linear, so the whole
     aggregation stays two psum tiers like FedAvg; the only extra device
     state is one weighted τ accumulator in the chunk-scan carry."""
+
+    # its aggregation IS linear, but its _shard_body carries extra tau
+    # accumulators the block step does not thread through yet
+    _supports_block_stream = False
+    _block_stream_unsupported_reason = (
+        "FedNova's tau accumulators are not yet threaded through the "
+        "block step (its aggregation is linear — this could be added)")
 
     def _shard_body(self, variables, cohort, weights, client_rngs):
         axes = self.mesh.axis_names
@@ -629,6 +760,16 @@ class MeshRobustEngine(MeshFedAvgEngine):
     deliberately NOT the path for 128×ResNet cohorts.  Cohort size must
     divide evenly over the mesh (zero-weight pad lanes have no principled
     place in a median), enforced at construction."""
+
+    @property
+    def _supports_block_stream(self):
+        # order-statistic defenses need the whole cohort matrix at once;
+        # norm_clip is per-client (client_transform) and streams fine
+        return self.defense == "norm_clip"
+
+    _block_stream_unsupported_reason = (
+        "order-statistic defenses (krum/median/trimmed_mean) need the "
+        "whole cohort matrix at once; norm_clip streams fine")
 
     def __init__(self, trainer, data, cfg, defense: str = "norm_clip",
                  n_byzantine: int = 0, **kw):

@@ -148,6 +148,61 @@ def test_streaming_matches_resident():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_blockstream_matches_streaming():
+    """Block-streamed rounds (stream_block: the cohort crosses
+    host->device in blocks, linear sums accumulating on device) must
+    reproduce the whole-cohort streaming round — same sampling, same
+    per-client rngs (split prefixes are stable), zero-weight pad lanes
+    contribute exactly 0.  12 sampled clients in blocks of 8 on an
+    8-shard mesh exercises the final-block zero-weight padding."""
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=3)
+    trainer, data = _setup(cfg)
+    stream = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                              donate=False, streaming=True)
+    v0 = stream.init_variables()
+    v_str = stream.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    blk = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, stream_block=8)
+    assert blk.streaming        # stream_block implies streaming
+    v_blk = blk.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for a, b in zip(jax.tree.leaves(v_str), jax.tree.leaves(v_blk)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_blockstream_fedopt_and_gates():
+    """FedOpt server state threads through the block finalize; engines
+    whose aggregation needs the whole cohort refuse stream_block."""
+    cfg = _mnist_like_cfg(server_optimizer="adam", server_lr=0.05,
+                          comm_round=2)
+    trainer, data = _setup(cfg)
+    stream = MeshFedOptEngine(trainer, data, cfg, mesh=make_mesh(8),
+                              donate=False, streaming=True)
+    v0 = stream.init_variables()
+    v_str = stream.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    blk = MeshFedOptEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, stream_block=8)
+    v_blk = blk.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    for a, b in zip(jax.tree.leaves(v_str), jax.tree.leaves(v_blk)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+    from fedml_tpu.parallel import MeshFedNovaEngine
+    with pytest.raises(ValueError, match="stream_block"):
+        MeshFedNovaEngine(trainer, data, cfg, mesh=make_mesh(8),
+                          donate=False, stream_block=8)
+    r_cfg = FedConfig(**{**cfg.__dict__, "norm_bound": 0.5})
+    with pytest.raises(ValueError, match="stream_block"):
+        MeshRobustEngine(trainer, data, r_cfg, defense="krum",
+                         mesh=make_mesh(8), donate=False, stream_block=8)
+    # norm_clip is per-client and streams fine
+    MeshRobustEngine(trainer, data, r_cfg, defense="norm_clip",
+                     mesh=make_mesh(8), donate=False, stream_block=8)
+    with pytest.raises(ValueError, match="multiple"):
+        MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                         donate=False, stream_block=3)
+
+
 def test_prime_cohort_chunk_padding():
     """A 13-client cohort on a 1-shard mesh forces the in-program
     zero-weight chunk padding (13 -> 16 lanes at cap 8); results must match
@@ -491,6 +546,55 @@ def test_streaming_reference_scale_memory_bound():
     bound = baseline + 2 * cohort_bytes + eval_bytes + (8 << 20)
     assert max(peaks) <= bound, (max(peaks), bound)
     assert stack_bytes > 20 * cohort_bytes   # the bound is meaningful
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
+
+
+def test_blockstream_device_memory_is_o_block():
+    """stream_block's point: a round over a 64-client cohort in 8-client
+    blocks must never hold device bytes O(cohort) — only O(block)
+    (current + prefetched next + accumulators), even though the cohort
+    is 8x the block."""
+    n = 64
+    cfg = _mnist_like_cfg(client_num_in_total=n, client_num_per_round=n,
+                          comm_round=2, frequency_of_the_test=100)
+    data = load_data("femnist", client_num_in_total=n, batch_size=20,
+                     synthetic_scale=0.0, seed=0)
+    model = create_model("cnn", output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=0.05)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           stream_block=8)
+
+    def live_bytes():
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.live_arrays())
+
+    block = eng._upload_block(np.arange(8),
+                              np.ones(8, np.float32),
+                              np.asarray(jax.random.split(
+                                  jax.random.PRNGKey(0), 8)))
+    block_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                      for a in jax.tree.leaves(block))
+    del block
+    v = eng.init_variables()
+    v = eng._prepare_variables(v)
+    # num accumulator = one f32 copy of the variables
+    var_bytes = sum(int(np.prod(a.shape)) * 4
+                    for a in jax.tree.leaves(v))
+    baseline = live_bytes() + block_bytes
+
+    peaks = []
+    orig = eng._upload_block
+    eng._upload_block = lambda *a: (peaks.append(live_bytes()), orig(*a))[1]
+    v = eng.run(variables=v, rounds=2)
+    assert eng._stack is None
+    assert len(peaks) >= 2 * (n // 8)      # every block observed
+    eval_bytes = sum(np.asarray(x).nbytes
+                     for shard in (data.train_global, data.test_global)
+                     for x in shard.values())
+    bound = baseline + 2 * block_bytes + var_bytes + eval_bytes + (8 << 20)
+    assert max(peaks) <= bound, (max(peaks), bound)
+    cohort_bytes = 8 * block_bytes          # full participation, 64 clients
+    assert cohort_bytes > 4 * block_bytes   # the bound is meaningful
     assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
 
 

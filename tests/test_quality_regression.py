@@ -50,6 +50,26 @@ CAL_ACC_MNIST = 0.9100          # calibrated 2026-07-31, jax 0.9.0 XLA:CPU
 CAL_LOSS_FEMNIST_STEP = 4.4451  # calibrated 2026-07-31, jax 0.9.0 XLA:CPU
 
 
+def test_convergence_artifact_band():
+    """The chip-measured convergence artifact (tools/chip_convergence.py,
+    committed at benchmarks/convergence_r4.json) must stay consistent
+    with the band PERF.md pins: the committed bench recipe (chunk 2,
+    bf16 masters, unroll 8, bf16 stack) trained the learnable synthetic
+    CIFAR stand-in to >= 0.99 held-out accuracy in 300 rounds on the
+    v5e.  This guards the artifact/claim pair against silent edits —
+    re-measuring is a chip job, not a CI job."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "convergence_r4.json")
+    d = json.load(open(path))
+    assert d["recipe"] == "chunk2/bf16-masters/unroll8/bf16-stack"
+    assert d["rounds"] == 300
+    assert d["final_test_acc"] >= 0.99, d["final_test_acc"]
+    assert d["curve"][-1]["round"] == 300
+    assert d["curve"][-1]["test_acc"] == d["final_test_acc"]
+
+
 def test_mnist_row_pinned_accuracy():
     """benchmark/README.md:12 row shape — 1000 clients, 10/round, bs=10,
     lr=0.03, E=1 — accuracy pinned mid-curve on the synthetic stand-in
