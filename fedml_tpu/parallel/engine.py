@@ -314,10 +314,13 @@ class MeshFedAvgEngine(FedAvgEngine):
             # programs the host loop drives per round (the accumulators
             # are donated — no copies as blocks stream through)
             self._block_step = jax.jit(self._block_step_impl,
-                                       donate_argnums=(1, 2, 3))
+                                       donate_argnums=(1,))
+            # sums (argnum 2) is engine-internal and dead after finalize
+            # — always donated; variables/server_state follow the
+            # user-visible donate flag
             self._block_finalize = jax.jit(
                 self._block_finalize_impl,
-                donate_argnums=(0, 1) if donate else ())
+                donate_argnums=(0, 1, 2) if donate else (2,))
             self.round_fn = self._round_blockstream
 
 
@@ -419,14 +422,28 @@ class MeshFedAvgEngine(FedAvgEngine):
         return (jax.lax.psum(num, axes), jax.lax.psum(den, axes),
                 jax.lax.psum(lsum, axes))
 
-    def _shard_body(self, variables, cohort, weights, client_rngs):
-        """Whole-cohort round body: the two-collective FedAvg aggregation
-        (SURVEY.md §5) — sums then the weighted mean."""
-        num, den, lsum = self._shard_sums(variables, cohort, weights,
-                                          client_rngs)
+    def _zero_sums(self, variables):
+        """Zero accumulators matching _shard_sums' output structure (the
+        block-streamed round's carry; engines with extra linear sums —
+        FedNova's tau — override the triple together)."""
+        return (jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                             variables), jnp.float32(0), jnp.float32(0))
+
+    def _finalize_from_sums(self, variables, sums):
+        """(aggregated model, mean loss) from the accumulated linear sums
+        — pure math, shared verbatim by the whole-cohort shard body and
+        the block-streamed finalize."""
+        num, den, lsum = sums
         avg = jax.tree.map(
             lambda s, ref: (s / den).astype(ref.dtype), num, variables)
         return avg, lsum / den
+
+    def _shard_body(self, variables, cohort, weights, client_rngs):
+        """Whole-cohort round body: the two-collective FedAvg aggregation
+        (SURVEY.md §5) — sums then the weighted mean."""
+        return self._finalize_from_sums(
+            variables,
+            self._shard_sums(variables, cohort, weights, client_rngs))
 
     def _train_and_update(self, variables, server_state, cohort, weights,
                           rng):
@@ -492,26 +509,23 @@ class MeshFedAvgEngine(FedAvgEngine):
         return cohort, weights
 
     # -- block-streamed round (stream_block) ---------------------------------
-    def _block_step_impl(self, variables, num, den, lsum, block, weights,
-                         rngs):
-        """One block's contribution: shard_map the linear sums and fold
-        them into the round accumulators (donated)."""
+    def _block_step_impl(self, variables, sums, block, weights, rngs):
+        """One block's contribution: shard_map the engine's linear sums
+        (whatever pytree _shard_sums returns) and fold them into the
+        round accumulators (donated)."""
         specs = {k: stack_leaf_spec(self.mesh, v) for k, v in block.items()}
         csh = P(self.client_axes)
-        bn, bd, bl = jax.shard_map(
+        bsums = jax.shard_map(
             self._shard_sums, mesh=self.mesh,
-            in_specs=(P(), specs, csh, csh), out_specs=(P(), P(), P()))(
+            in_specs=(P(), specs, csh, csh), out_specs=P())(
                 variables, block, weights, rngs)
-        num = jax.tree.map(lambda a, b: a + b, num, bn)
-        return num, den + bd, lsum + bl
+        return jax.tree.map(lambda a, b: a + b, sums, bsums)
 
-    def _block_finalize_impl(self, variables, server_state, num, den, lsum,
-                             agg_rng):
-        avg = jax.tree.map(
-            lambda s, ref: (s / den).astype(ref.dtype), num, variables)
+    def _block_finalize_impl(self, variables, server_state, sums, agg_rng):
+        avg, loss = self._finalize_from_sums(variables, sums)
         new_variables, server_state = self.server_update(
             avg, variables, server_state, agg_rng)
-        return new_variables, server_state, {"train_loss": lsum / den}
+        return new_variables, server_state, {"train_loss": loss}
 
     def _upload_block(self, ids_blk, w_blk, rngs_blk):
         """Host-gather + async device_put of one client block (the
@@ -549,11 +563,7 @@ class MeshFedAvgEngine(FedAvgEngine):
                                     np.float32), ids) * wmask)
         rng, agg_rng = jax.random.split(rng)
         crngs = np.asarray(jax.random.split(rng, K))
-        num = jax.device_put(
-            jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
-                         variables), replicated_sharding(self.mesh))
-        den = jax.device_put(jnp.float32(0), replicated_sharding(self.mesh))
-        lsum = jax.device_put(jnp.float32(0),
+        sums = jax.device_put(self._zero_sums(variables),
                               replicated_sharding(self.mesh))
         nxt = self._upload_block(ids[:B], w_all[:B], crngs[:B])
         for start in range(0, K, B):
@@ -562,10 +572,8 @@ class MeshFedAvgEngine(FedAvgEngine):
                 s2 = start + B
                 nxt = self._upload_block(ids[s2:s2 + B], w_all[s2:s2 + B],
                                          crngs[s2:s2 + B])
-            num, den, lsum = self._block_step(variables, num, den, lsum,
-                                              *cur)
-        return self._block_finalize(variables, server_state, num, den,
-                                    lsum, agg_rng)
+            sums = self._block_step(variables, sums, *cur)
+        return self._block_finalize(variables, server_state, sums, agg_rng)
 
     # NOTE: a fully on-device multi-round path (`run_scanned`: whole blocks
     # of rounds as one lax.scan program, in-program fold-in sampling) was
@@ -673,16 +681,16 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
     aggregation stays two psum tiers like FedAvg; the only extra device
     state is one weighted τ accumulator in the chunk-scan carry."""
 
-    # its aggregation IS linear, but its _shard_body carries extra tau
-    # accumulators the block step does not thread through yet
-    _supports_block_stream = False
-    _block_stream_unsupported_reason = (
-        "FedNova's tau accumulators are not yet threaded through the "
-        "block step (its aggregation is linear — this could be added)")
+    @staticmethod
+    def _split(v):
+        return v["params"], {k: x for k, x in v.items() if k != "params"}
 
-    def _shard_body(self, variables, cohort, weights, client_rngs):
+    def _shard_sums(self, variables, cohort, weights, client_rngs):
+        """FedNova's linear sums: (Σ w·(g−v)/τ, Σ w·stats, Σ w, Σ w·τ,
+        Σ w·loss) — same structure contract as the FedAvg triple, so the
+        whole-cohort shard body AND the block-streamed round drive it
+        through the shared _finalize_from_sums."""
         axes = self.mesh.axis_names
-        rep_vars = variables              # replicated: the output's basis
         variables = pvary_tree(variables, axes)
         local_vars = cast_local(variables, self.local_dtype)
         epochs = self.cfg.epochs
@@ -697,17 +705,14 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
                                               epochs)
             return v, loss, fednova_tau(shard, epochs, self.batch_axes)
 
-        def split(v):
-            return v["params"], {k: x for k, x in v.items() if k != "params"}
-
-        g_params, _ = split(local_vars)
+        g_params, _ = self._split(local_vars)
 
         def chunk_body(carry, xs):
             dsum, rest_num, den, tsum, lsum = carry
             cs, cw, cr = xs
             cs = self._restore_chunk_x(cs)      # flat_stack (engine.py)
             vs, losses, taus = jax.vmap(one)(cs, cr)
-            v_params, v_rest = split(vs)
+            v_params, v_rest = self._split(vs)
             # params: Σ w·(g − v)/τ  (zero-weight pad lanes contribute 0)
             coef = cw / jnp.maximum(taus, 1.0)
             dsum = jax.tree.map(
@@ -721,25 +726,32 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
                     tsum + jnp.sum(cw * taus),
                     lsum + jnp.sum(losses * cw)), None
 
-        zp, zr = split(jax.tree.map(
+        zp, zr = self._split(jax.tree.map(
             lambda a: jnp.zeros(a.shape, jnp.float32), variables))
         zp, zr = pvary_tree(zp, axes), pvary_tree(zr, axes)
         zf = pvary_tree(jnp.float32(0), axes)
         (dsum, rest_num, den, tsum, lsum), _ = jax.lax.scan(
             chunk_body, (zp, zr, zf, zf, zf), (ch_cohort, ch_w, ch_r))
-        dsum = jax.lax.psum(dsum, axes)
-        rest_num = jax.lax.psum(rest_num, axes)
-        den = jax.lax.psum(den, axes)
-        tau_eff = jax.lax.psum(tsum, axes) / den
-        gp, grest = split(rep_vars)
+        return (jax.lax.psum(dsum, axes), jax.lax.psum(rest_num, axes),
+                jax.lax.psum(den, axes), jax.lax.psum(tsum, axes),
+                jax.lax.psum(lsum, axes))
+
+    def _zero_sums(self, variables):
+        zp, zr = self._split(jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), variables))
+        return (zp, zr, jnp.float32(0), jnp.float32(0), jnp.float32(0))
+
+    def _finalize_from_sums(self, variables, sums):
+        dsum, rest_num, den, tsum, lsum = sums
+        tau_eff = tsum / den
+        gp, grest = self._split(variables)
         new_params = jax.tree.map(
             lambda g, d: (g.astype(jnp.float32)
                           - tau_eff * d / den).astype(g.dtype), gp, dsum)
         new = {"params": new_params,
                **jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype),
                               rest_num, grest)}
-        loss = jax.lax.psum(lsum, axes) / den
-        return new, loss
+        return new, lsum / den
 
 
 class MeshRobustEngine(MeshFedAvgEngine):

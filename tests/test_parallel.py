@@ -148,26 +148,46 @@ def test_streaming_matches_resident():
                                    rtol=2e-4, atol=2e-5)
 
 
-def test_blockstream_matches_streaming():
-    """Block-streamed rounds (stream_block: the cohort crosses
-    host->device in blocks, linear sums accumulating on device) must
-    reproduce the whole-cohort streaming round — same sampling, same
-    per-client rngs (split prefixes are stable), zero-weight pad lanes
-    contribute exactly 0.  12 sampled clients in blocks of 8 on an
-    8-shard mesh exercises the final-block zero-weight padding."""
-    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=3)
-    trainer, data = _setup(cfg)
-    stream = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
-                              donate=False, streaming=True)
+def _assert_blockstream_matches(engine_cls, cfg, trainer, data,
+                                stream_block=8, rounds=2):
+    """Shared oracle body: block-streamed == whole-cohort streaming for
+    one engine class (same sampling, same per-client rngs — split
+    prefixes are stable — zero-weight pad lanes contribute exactly 0)."""
+    stream = engine_cls(trainer, data, cfg, mesh=make_mesh(8),
+                        donate=False, streaming=True)
     v0 = stream.init_variables()
-    v_str = stream.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
-    blk = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
-                           donate=False, stream_block=8)
+    v_str = stream.run(variables=jax.tree.map(jnp.copy, v0), rounds=rounds)
+    blk = engine_cls(trainer, data, cfg, mesh=make_mesh(8),
+                     donate=False, stream_block=stream_block)
     assert blk.streaming        # stream_block implies streaming
-    v_blk = blk.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    v_blk = blk.run(variables=jax.tree.map(jnp.copy, v0), rounds=rounds)
     for a, b in zip(jax.tree.leaves(v_str), jax.tree.leaves(v_blk)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_blockstream_matches_streaming():
+    """12 sampled clients in blocks of 8 on an 8-shard mesh exercises the
+    final block's shard-level zero-weight padding."""
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=3)
+    trainer, data = _setup(cfg)
+    _assert_blockstream_matches(MeshFedAvgEngine, cfg, trainer, data,
+                                rounds=3)
+
+
+def test_blockstream_block_multiple_padding():
+    """stream_block=16 on the 8-shard mesh with 12 sampled clients: ids
+    are shard-padded 12->16 by _sample_padded_np and the BLOCK padding
+    branch (pad to a stream_block multiple with zero-weight repeated-id
+    lanes) is a no-op at 16... so use 20 sampled of 24: shard-pad
+    20->24, block-pad 24->32 — the branch the block-equals-streaming
+    oracle must also survive (differing rng split counts are prefix-
+    stable; pad lanes carry weight 0)."""
+    cfg = _mnist_like_cfg(client_num_in_total=24, client_num_per_round=20,
+                          comm_round=2)
+    trainer, data = _setup(cfg)
+    _assert_blockstream_matches(MeshFedAvgEngine, cfg, trainer, data,
+                                stream_block=16)
 
 
 def test_blockstream_fedopt_and_gates():
@@ -176,21 +196,8 @@ def test_blockstream_fedopt_and_gates():
     cfg = _mnist_like_cfg(server_optimizer="adam", server_lr=0.05,
                           comm_round=2)
     trainer, data = _setup(cfg)
-    stream = MeshFedOptEngine(trainer, data, cfg, mesh=make_mesh(8),
-                              donate=False, streaming=True)
-    v0 = stream.init_variables()
-    v_str = stream.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
-    blk = MeshFedOptEngine(trainer, data, cfg, mesh=make_mesh(8),
-                           donate=False, stream_block=8)
-    v_blk = blk.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
-    for a, b in zip(jax.tree.leaves(v_str), jax.tree.leaves(v_blk)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-5)
+    _assert_blockstream_matches(MeshFedOptEngine, cfg, trainer, data)
 
-    from fedml_tpu.parallel import MeshFedNovaEngine
-    with pytest.raises(ValueError, match="stream_block"):
-        MeshFedNovaEngine(trainer, data, cfg, mesh=make_mesh(8),
-                          donate=False, stream_block=8)
     r_cfg = FedConfig(**{**cfg.__dict__, "norm_bound": 0.5})
     with pytest.raises(ValueError, match="stream_block"):
         MeshRobustEngine(trainer, data, r_cfg, defense="krum",
@@ -201,6 +208,16 @@ def test_blockstream_fedopt_and_gates():
     with pytest.raises(ValueError, match="multiple"):
         MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
                          donate=False, stream_block=3)
+
+
+def test_blockstream_fednova_matches_streaming():
+    """FedNova's extra linear sums (tau-normalized d, Σ w·τ) thread
+    through the generic block accumulators — block-streamed FedNova must
+    match the whole-cohort streaming round."""
+    from fedml_tpu.parallel import MeshFedNovaEngine
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=2)
+    trainer, data = _setup(cfg)
+    _assert_blockstream_matches(MeshFedNovaEngine, cfg, trainer, data)
 
 
 def test_prime_cohort_chunk_padding():
