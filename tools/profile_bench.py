@@ -191,6 +191,38 @@ def exp_C2048H():
     _cohort_scale_round(2048, data_dtype=jnp.bfloat16)
 
 
+def exp_C4096B():
+    """4096 bench-shaped clients on ONE chip via block-streamed rounds
+    (stream_block): the 10.5 GB bf16 cohort can never be device-resident
+    (HBM 15.75 GB minus working set), so the round streams 512-client
+    blocks (2 live blocks ≈ 2.7 GB device data) with sums accumulating
+    on device.  One timed round — an existence proof of the unbounded
+    cohort axis; through this image's ~7-35 MB/s tunnel the round is
+    upload-bound (a real chip's DMA is orders faster), so the wall time
+    here measures the tunnel, not the engine (SCALING.md)."""
+    import jax
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    C, BLOCK = 4096, 512
+    cfg, data, trainer = _bench_workload(C)
+    engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(), chunk=2,
+                              local_dtype=jnp.bfloat16,
+                              stack_dtype=jnp.bfloat16, stream_block=BLOCK,
+                              donate=False)
+    variables = engine.init_variables()
+    server_state = engine.server_init(variables)
+    t0 = time.perf_counter()
+    variables, server_state, m = engine.round_fn(
+        variables, server_state, 0, jax.random.PRNGKey(0))
+    loss = float(m["train_loss"])
+    dt = time.perf_counter() - t0
+    gb = C * N_BATCHES * BS * 32 * 32 * 3 * 2 / 1e9   # padded slots cross
+    print(f"C4096B block-stream({BLOCK}/block): one full round over "
+          f"{C} clients ({gb:.1f} GB bf16 crossed H2D) in {dt:.1f}s  "
+          f"train_loss {loss:.4f}", flush=True)
+
+
 def exp_B(batch_unroll: int = 1, bs: int = BS, n_batches: int = None,
           tag: str = "B"):
     """Centralized ceiling: shared weights, ceil(SPC/bs) steps (or an
