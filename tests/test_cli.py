@@ -126,6 +126,19 @@ def test_cli_fednova_mesh(tmp_path):
     assert s
 
 
+def test_cli_stream_block_mesh(tmp_path):
+    # block-streamed rounds: cohort crosses H2D in 8-client blocks,
+    # device data O(block) (SCALING.md).  20 sampled clients pad to 24
+    # lanes -> THREE block steps per round, so the multi-block
+    # accumulation loop genuinely runs (later duplicate flags override
+    # COMMON's 4-client counts)
+    s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",
+                "--model", "lr", "--mesh", "--stream_block", "8",
+                "--client_num_in_total", "20",
+                "--client_num_per_round", "20")
+    assert "test_acc" in s
+
+
 def test_cli_mesh_batch(tmp_path):
     # clients x batch mesh: 8 devices -> 4x2, per-step batch split 2 ways
     s = run_cli(tmp_path, "--algorithm", "fedavg", "--dataset", "mnist",

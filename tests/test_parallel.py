@@ -220,6 +220,16 @@ def test_blockstream_fednova_matches_streaming():
     _assert_blockstream_matches(MeshFedNovaEngine, cfg, trainer, data)
 
 
+def test_blockstream_fedprox_matches_streaming():
+    """The prox term (global_params anchor inside local_train) rides the
+    block path unchanged."""
+    from fedml_tpu.parallel import MeshFedProxEngine
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=2,
+                          prox_mu=0.1)
+    trainer, data = _setup(cfg, prox_mu=0.1)
+    _assert_blockstream_matches(MeshFedProxEngine, cfg, trainer, data)
+
+
 def test_prime_cohort_chunk_padding():
     """A 13-client cohort on a 1-shard mesh forces the in-program
     zero-weight chunk padding (13 -> 16 lanes at cap 8); results must match
