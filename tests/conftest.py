@@ -24,7 +24,10 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite compiles hundreds of XLA programs
 # (mesh round programs dominate wall-clock — VERDICT r2 weak #8); repeat
 # runs hit the disk cache instead of recompiling.  Safe to share across
-# processes; keyed on program + compile options.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.expanduser("~/.cache/fedml_tpu_jax_tests"))
+# processes; keyed on program + compile options.  The dir constant lives
+# in multihost_case so the multihost workers (fresh subprocesses) hit
+# the SAME cache.
+from multihost_case import JAX_TEST_CACHE_DIR  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", JAX_TEST_CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -28,6 +28,12 @@ os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={ndev}"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# same persistent compile cache as conftest.py — the workers are fresh
+# processes and would otherwise recompile every round program every run
+from tests.multihost_case import JAX_TEST_CACHE_DIR  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", JAX_TEST_CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # no explicit gloo config here: on current jaxlib the option already
 # defaults to "gloo"; init_multihost's fallback covers builds where it
 # doesn't (that branch is a no-op in this CI)
