@@ -421,7 +421,8 @@ def build_engine(args, cfg: FedConfig, data):
                     "--train_dtype bfloat16 for bf16 compute instead")
             from fedml_tpu.parallel import MeshGossipEngine
             return MeshGossipEngine(_trainer(cfg, data), data, cfg,
-                                    mesh=mesh)
+                                    mesh=mesh,
+                                    flat_stack=not args.no_flat_stack)
         from fedml_tpu.algorithms import DecentralizedGossipEngine
         from fedml_tpu.core.topology import (AsymmetricTopologyManager,
                                              SymmetricTopologyManager)

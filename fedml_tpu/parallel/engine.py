@@ -122,6 +122,17 @@ def restore_chunk_x(image_shape, chunk_shards: dict) -> dict:
     return {**chunk_shards, "x": x.reshape(x.shape[:3] + tuple(image_shape))}
 
 
+def restore_shard_x(image_shape, shard: dict) -> dict:
+    """Undo flatten_stack_x on ONE client's shard: [B, bs, F] ->
+    [B, bs, *image] (the per-worker/per-client variant of
+    restore_chunk_x — gossip's worker loop and the mesh local-eval hook
+    both restore at this granularity)."""
+    if image_shape is None or "x" not in shard:
+        return shard
+    x = shard["x"]
+    return {**shard, "x": x.reshape(x.shape[:2] + tuple(image_shape))}
+
+
 def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
                            epochs, vary_axes, chunk_cap: int = 8,
                            client_transform=None,
@@ -372,9 +383,7 @@ class MeshFedAvgEngine(FedAvgEngine):
         unflattened stacks pass through on the ndim check)."""
         if (self._x_image_shape is not None and "x" in shard
                 and shard["x"].ndim == 3):
-            x = shard["x"]
-            return {**shard, "x": x.reshape(x.shape[:2]
-                                            + tuple(self._x_image_shape))}
+            return restore_shard_x(self._x_image_shape, shard)
         return shard
 
     def _device_stack(self):

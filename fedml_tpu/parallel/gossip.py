@@ -43,7 +43,14 @@ class MeshGossipEngine(FedAvgEngine):
 
     def __init__(self, trainer: ClientTrainer, data: FederatedData,
                  cfg: FedConfig, mesh: Optional[Mesh] = None,
-                 self_weight: float = 1.0 / 3.0, donate: bool = True):
+                 self_weight: float = 1.0 / 3.0, donate: bool = True,
+                 flat_stack: bool = True):
+        # flat image-stack storage + per-worker restore, same rationale
+        # and helpers as MeshFedAvgEngine (engine.py flat_stack) — the
+        # gossip stack is the FULL client data, device-resident, so the
+        # padded-relayout cost it avoids is at its largest here
+        self.flat_stack = flat_stack
+        self._x_image_shape = None
         self.mesh = mesh if mesh is not None else make_mesh()
         if len(self.mesh.axis_names) != 1:
             raise ValueError("gossip requires a 1-D (ring) mesh; got axes "
@@ -64,8 +71,14 @@ class MeshGossipEngine(FedAvgEngine):
     def _device_stack(self):
         if self._stack is None:
             sh = NamedSharding(self.mesh, P(self.mesh.axis_names))
+            shards = dict(self.data.client_shards)
+            if self.flat_stack:
+                from fedml_tpu.parallel.engine import flatten_stack_x
+                shards, image_shape = flatten_stack_x(shards)
+                if image_shape is not None:
+                    self._x_image_shape = image_shape
             self._stack = {k: jax.device_put(np.asarray(v), sh)
-                           for k, v in self.data.client_shards.items()}
+                           for k, v in shards.items()}
             self._stack_w = jax.device_put(
                 np.asarray(self.data.client_num_samples, np.float32), sh)
         return self._stack, self._stack_w
@@ -87,9 +100,13 @@ class MeshGossipEngine(FedAvgEngine):
         w_nbr = (1.0 - w_self) / 2.0
         sc = P(axes)
 
+        img = self._x_image_shape
+
         def shard_body(worker_vars, cohort, weights, rngs):
             # this shard's workers: [w_loc, ...]; each trains on its clients
             def one(vars_i, shard, crng):
+                from fedml_tpu.parallel.engine import restore_shard_x
+                shard = restore_shard_x(img, shard)  # flat_stack
                 v, loss, _ = trainer.local_train(vars_i, shard, crng, epochs)
                 return v, loss
 
