@@ -66,18 +66,46 @@ def _probe_devices(timeout: float) -> tuple[bool, str]:
     return True, r.stdout.strip()
 
 
+def _probe_with_retry() -> tuple[bool, str]:
+    """Attach-probe with retry + backoff (VERDICT r4 #1: one transient
+    tunnel wedge zeroed the round-4 record).  Each attempt gets
+    BENCH_PROBE_TIMEOUT (default 300 s — a healthy attach is <60 s);
+    attempts repeat with growing sleeps until the BENCH_PROBE_BUDGET
+    (default 600 s — bounded so probe + timed bench stays inside the
+    driver's patience) wall-clock budget is spent, because the tunnel's
+    observed outage mode is minutes-long wedges that sometimes clear."""
+    per_try = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "600"))
+    t0 = time.monotonic()
+    attempt, backoff = 0, 20.0
+    while True:
+        attempt += 1
+        remaining = budget - (time.monotonic() - t0)
+        ok, detail = _probe_devices(min(per_try, max(remaining, 30.0)))
+        if ok and detail == "cpu" and os.environ.get(
+                "JAX_PLATFORMS") != "cpu":
+            # the tunnel backend failed FAST and jax fell through to the
+            # sitecustomize's cpu fallback: without an explicit
+            # JAX_PLATFORMS=cpu opt-in, a cpu bench would record a ~100x
+            # "regression" that is really a chip outage
+            ok, detail = False, "tunnel backend fell back to cpu"
+        if ok:
+            return ok, detail
+        remaining = budget - (time.monotonic() - t0)
+        if remaining <= backoff + 30.0:
+            return False, f"{detail} (after {attempt} attempts)"
+        print(f"attach attempt {attempt} failed ({detail}); retrying in "
+              f"{backoff:.0f}s, {remaining:.0f}s of budget left",
+              file=sys.stderr)
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 120.0)
+
+
 def main() -> None:
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
     # artifact distinguishes "no chip" from a perf regression
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "420"))
-    ok, detail = _probe_devices(probe_timeout)
-    if ok and detail == "cpu" and os.environ.get("JAX_PLATFORMS") != "cpu":
-        # the tunnel backend failed FAST and jax fell through to the
-        # sitecustomize's cpu fallback: without an explicit
-        # JAX_PLATFORMS=cpu opt-in, a cpu bench would record a ~100x
-        # "regression" that is really a chip outage
-        ok, detail = False, "tunnel backend fell back to cpu"
+    ok, detail = _probe_with_retry()
     if not ok:
         print(f"chip unavailable: {detail}", file=sys.stderr)
         print(json.dumps({

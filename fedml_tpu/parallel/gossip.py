@@ -141,6 +141,13 @@ class MeshGossipEngine(FedAvgEngine):
             out_specs=(sc, P()))(worker_vars, stack, stack_w, stack_rngs)
         return new_vars, {"train_loss": train_loss}
 
+    def _local_eval_transform(self, shard: dict) -> dict:
+        """evaluate_local(split="train") reuses the resident gossip
+        stack, which stores x FLAT under flat_stack (shared restore
+        guard — restore_flat_eval_shard; ADVICE r4)."""
+        from fedml_tpu.parallel.engine import restore_flat_eval_shard
+        return restore_flat_eval_shard(self._x_image_shape, shard)
+
     def consensus_variables(self, worker_vars):
         """Uniform average of all worker models (for evaluation)."""
         return jax.tree.map(lambda a: jnp.mean(a.astype(jnp.float32),

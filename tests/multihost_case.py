@@ -100,6 +100,25 @@ def build_fedopt_streaming_case():
                             donate=False)
 
 
+def build_ckpt_case():
+    """Checkpoint/resume across the process boundary (VERDICT r4 #5):
+    FedOpt so a NONTRIVIAL server_state (adam moments) must round-trip
+    through orbax in the multiprocess cluster — resume correctness shows
+    up in the continued rounds' digests, not just the restored
+    variables."""
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel import MeshFedOptEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    data, cfg = _case_data_cfg(comm_round=4)
+    cfg = type(cfg)(**{**cfg.__dict__, "server_optimizer": "adam",
+                       "server_lr": 0.05})
+    model = create_model("lr", output_dim=10)
+    return MeshFedOptEngine(ClientTrainer(model, lr=cfg.lr), data, cfg,
+                            mesh=make_mesh(8), donate=False)
+
+
 def digest(variables):
     """Order-stable scalar digest of a params tree (sum of |params|)."""
     import jax

@@ -47,14 +47,16 @@ def _parse(out: str):
             "sd": float(s.group(1)), "sa": float(s.group(2))}
 
 
-def _run_cluster(nprocs: int, ndev: int):
-    """Launch nprocs workers with ndev virtual devices each; return the
-    per-worker parsed digest dicts."""
+def _run_cluster_raw(nprocs: int, ndev: int, worker: str = WORKER,
+                     extra_args: tuple = ()):
+    """Launch nprocs worker processes with ndev virtual devices each;
+    return the per-worker stdout strings."""
     port = _free_port()
     env = {**os.environ,
            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
     procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(i), str(port), str(nprocs), str(ndev)],
+        [sys.executable, worker, str(i), str(port), str(nprocs), str(ndev),
+         *extra_args],
         env=env, text=True, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         cwd=REPO) for i in range(nprocs)]
     # drain all workers CONCURRENTLY: if one crashes at init, its peers
@@ -83,7 +85,12 @@ def _run_cluster(nprocs: int, ndev: int):
         out, err = results[i]
         assert p.returncode == 0, \
             f"worker {i}/{nprocs} failed (rc={p.returncode}):\n{err[-3000:]}"
-    return [_parse(results[i][0]) for i in range(nprocs)]
+    return [results[i][0] for i in range(nprocs)]
+
+
+def _run_cluster(nprocs: int, ndev: int):
+    """Launch the standard oracle worker; return parsed digest dicts."""
+    return [_parse(out) for out in _run_cluster_raw(nprocs, ndev)]
 
 
 @functools.cache
@@ -142,6 +149,29 @@ def _check_against_oracle(workers, silos: int):
 
 def test_two_process_mesh_matches_single_process():
     _check_against_oracle(_run_cluster(nprocs=2, ndev=4), silos=2)
+
+
+def test_multihost_checkpoint_resume(tmp_path):
+    """save → kill → resume across a 2-process cluster (VERDICT r4 #5):
+    cluster A runs rounds 0-1 of 4 with per-round orbax checkpointing
+    and exits; a FRESH cluster B restores (variables + FedOpt adam
+    server state) and continues rounds 2-3.  B also runs the
+    uninterrupted 4-round oracle in the same topology — the resumed
+    continuation must be bitwise-identical (per-round rngs are
+    fold_in(round_idx), the sampler reseeds per round, and same-topology
+    gloo reductions are deterministic)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    worker = os.path.join(REPO, "tests", "multihost_ckpt_worker.py")
+    outs = _run_cluster_raw(2, 4, worker=worker,
+                            extra_args=("interrupt", ckpt_dir))
+    assert all(re.search(r"SAVED 1\b", o) for o in outs), outs
+    outs = _run_cluster_raw(2, 4, worker=worker,
+                            extra_args=("resume", ckpt_dir))
+    for out in outs:
+        full = re.search(r"CKFULL ([\d.e+-]+)", out)
+        res = re.search(r"CKRES ([\d.e+-]+)", out)
+        assert full and res, f"missing digests:\n{out[-2000:]}"
+        assert float(res.group(1)) == float(full.group(1))
 
 
 def test_four_process_mesh_matches_single_process():

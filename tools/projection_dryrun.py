@@ -69,12 +69,20 @@ def _child(n_devices: int, batch_axis: int) -> None:
         client_shards = n_devices
     per_shard = N_CLIENTS // client_shards
 
-    cfg = FedConfig(model="resnet18_gn", client_num_in_total=N_CLIENTS,
+    # PROJECTION_MODEL=lr swaps the flagship ResNet for the tiny LR model
+    # (the 64-device clients x batch workaround experiments)
+    model_name = os.environ.get("PROJECTION_MODEL", "resnet18_gn")
+    cfg = FedConfig(model=model_name, client_num_in_total=N_CLIENTS,
                     client_num_per_round=N_CLIENTS, comm_round=ROUNDS,
                     epochs=1, batch_size=2, lr=0.1,
                     frequency_of_the_test=10_000)
     data = _tiny_data(N_CLIENTS, batch_size=2, hw=16)
-    trainer = ClientTrainer(_flagship(), lr=cfg.lr)
+    if model_name == "resnet18_gn":
+        model = _flagship()
+    else:
+        from fedml_tpu.models import create_model
+        model = create_model(model_name, output_dim=10)
+    trainer = ClientTrainer(model, lr=cfg.lr)
     # chunk 2 = the committed recipe's granularity; shards with fewer
     # local clients (the 128-device row) run the chunk-1 path via
     # pad_and_chunk's balanced sizing.  f32 end-to-end: the oracle
