@@ -34,9 +34,10 @@ def _so_path() -> str:
     cache = os.path.join(os.path.expanduser("~"), ".cache", "fedml_tpu")
     try:
         os.makedirs(cache, exist_ok=True)
-        return os.path.join(cache, f"libfedml_host-{tag}.so")
     except OSError:
-        return os.path.join(_DIR, "libfedml_host.so")
+        cache = _DIR       # unwritable cache: build beside the source
+    # the content tag rides BOTH paths — staleness is impossible by name
+    return os.path.join(cache, f"libfedml_host-{tag}.so")
 
 
 _SO = _so_path()

@@ -1,0 +1,27 @@
+"""Shared setup for the mesh-engine test files (test_parallel.py and
+test_parallel_stream.py — split so pytest-xdist's per-file scheduling
+can run the resident-mesh and streaming/block-stream groups in
+parallel workers)."""
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.loaders import load_data
+from fedml_tpu.models import create_model
+from fedml_tpu.utils.config import FedConfig
+
+
+def _mnist_like_cfg(**kw):
+    base = dict(model="lr", dataset="mnist",
+                client_num_in_total=16, client_num_per_round=16,
+                comm_round=4, epochs=1, batch_size=16, lr=0.1,
+                partition_method="homo", frequency_of_the_test=100)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _setup(cfg, prox_mu=0.0):
+    data = load_data(cfg.dataset, client_num_in_total=cfg.client_num_in_total,
+                     batch_size=cfg.batch_size, synthetic_scale=0.02,
+                     seed=cfg.seed)
+    model = create_model(cfg.model, output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=cfg.lr, optimizer=cfg.client_optimizer,
+                            prox_mu=prox_mu)
+    return trainer, data

@@ -19,14 +19,19 @@ Reference model being compared: fedml_api/model/nlp/rnn.py:39-70
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-N_CLIENTS = 128
+# scale knobs env-overridable so a CPU wiring smoke can shrink them
+# (NWP_VOCAB=404 NWP_CLIENTS=8 NWP_SEQS=800); chip runs use the defaults
+N_CLIENTS = int(os.environ.get("NWP_CLIENTS", "128"))
 BS = 16
-SEQ_LEN, VOCAB = 20, 10_004
+SEQ_LEN = 20
+VOCAB = int(os.environ.get("NWP_VOCAB", "10004"))
+N_SEQS = int(os.environ.get("NWP_SEQS", "16000"))
 EVAL_EVERY = 10
 
 
@@ -37,8 +42,8 @@ def _build_data():
 
     # the loaders.py stackoverflow_nwp synthetic branch at its default
     # scale: 16,000 Markov sequences, 1/8 held out
-    x, y = synthetic_sequences(16_000, SEQ_LEN, VOCAB, seed=0)
-    n_te = 16_000 // 8
+    x, y = synthetic_sequences(N_SEQS, SEQ_LEN, VOCAB, seed=0)
+    n_te = N_SEQS // 8
     x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
     idx_map = partition_homo(len(y_tr), N_CLIENTS, 0)
     return _make(x_tr, y_tr, xt, yt, idx_map, BS, VOCAB,
@@ -109,7 +114,7 @@ def main() -> None:
     results = [_train("rnn_stackoverflow", data, rounds),
                _train("transformer", data, rounds)]
     out = {"recipe": "mesh/bf16-compute/bf16-masters, bs16 lr10^-0.5 E1",
-           "data": f"synthetic_sequences(16000, {SEQ_LEN}, {VOCAB})",
+           "data": f"synthetic_sequences({N_SEQS}, {SEQ_LEN}, {VOCAB})",
            "results": results}
     print(json.dumps({r["model"]: {"acc": r["final_test_acc"],
                                    "wall_s": r["wall_s"]}
