@@ -101,6 +101,18 @@ def test_blockstream_fedopt_and_gates():
                          donate=False, stream_block=3)
 
 
+def test_blockstream_orderstat_refuses_multiprocess(monkeypatch):
+    """The two-phase path offloads client-sharded flats with np.asarray,
+    which a multi-process mesh cannot address — refusal must land at
+    CONSTRUCTION, not mid-round after training work."""
+    cfg = _mnist_like_cfg(comm_round=2, norm_bound=0.5)
+    trainer, data = _setup(cfg)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="single-process"):
+        MeshRobustEngine(trainer, data, cfg, defense="median",
+                         mesh=make_mesh(8), donate=False, stream_block=8)
+
+
 @pytest.mark.parametrize("defense", ["median", "trimmed_mean", "krum"])
 def test_blockstream_orderstat_matches_resident(defense):
     """VERDICT r4 #3: the two-phase block-streamed order-stat defenses

@@ -34,6 +34,7 @@ regressions VERDICT r2 flagged as undetectable.
 """
 import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.trainer import ClientTrainer
@@ -68,6 +69,30 @@ def test_convergence_artifact_band():
     assert d["final_test_acc"] >= 0.99, d["final_test_acc"]
     assert d["curve"][-1]["round"] == 300
     assert d["curve"][-1]["test_acc"] == d["final_test_acc"]
+
+
+def test_nwp_convergence_artifact_band():
+    """The chip-measured NWP family artifact (tools/nwp_convergence.py,
+    benchmarks/nwp_convergence_r5.json): reference LSTM vs
+    beyond-reference TransformerLM trained through the committed
+    mesh/bf16 recipe on the vocab-10,004 synthetic NWP stand-in.  The
+    PERF.md claim under guard: the transformer is FASTER wall-clock AND
+    at-least-as-good per round.  Skips until a chip window lands the
+    artifact; guards it against silent edits after."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks",
+        "nwp_convergence_r5.json")
+    if not os.path.exists(path):
+        pytest.skip("chip artifact not landed yet (tunnel-gated)")
+    d = json.load(open(path))
+    by = {r["model"]: r for r in d["results"]}
+    lstm, tfm = by["rnn_stackoverflow"], by["transformer"]
+    assert tfm["params"] > lstm["params"]          # 2x params...
+    assert tfm["wall_s"] < lstm["wall_s"]          # ...still faster
+    assert tfm["final_test_acc"] >= lstm["final_test_acc"] - 0.005
+    assert tfm["final_test_loss"] <= lstm["final_test_loss"] + 0.01
 
 
 def test_mnist_row_pinned_accuracy():
