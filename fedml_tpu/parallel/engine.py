@@ -1056,6 +1056,10 @@ class MeshRobustEngine(MeshFedAvgEngine):
             if X is None:
                 X = np.empty((K, flats.shape[1]), np.float32)
             X[start:start + B] = np.asarray(flats)
+            # np.asarray forced completion; drop the device buffer NOW —
+            # holding it across the next block step would stack [B, P]
+            # generations and break the O(block) device bound
+            flats.delete()
         # phase 2: parameter-major slices, Pb sized to param_block_bytes
         # of device footprint and mesh-divisible.  Only the FINAL short
         # slice is zero-padded (into its own [K, pb] buffer at upload

@@ -20,6 +20,20 @@ from fedml_tpu.utils.config import FedConfig
 from parallel_case import _mnist_like_cfg, _setup
 
 
+def _live_bytes():
+    """Total bytes across all live device arrays — the one accounting
+    every memory-bound test in this file shares."""
+    return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for a in jax.live_arrays())
+
+
+def _spy_live_bytes(obj, attr, peaks):
+    """Wrap obj.attr so each call first appends _live_bytes() to peaks."""
+    orig = getattr(obj, attr)
+    setattr(obj, attr,
+            lambda *a: (peaks.append(_live_bytes()), orig(*a))[1])
+
+
 def test_streaming_matches_resident():
     """Streaming cohort upload (host-gather, VERDICT r1 #5) must reproduce
     the HBM-resident path exactly — same sampling, same chunked round."""
@@ -99,6 +113,64 @@ def test_blockstream_fedopt_and_gates():
     with pytest.raises(ValueError, match="multiple"):
         MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
                          donate=False, stream_block=3)
+
+
+def test_blockstream_orderstat_device_memory_is_bounded():
+    """SCALING.md "Order statistics beyond HBM": a 64-client median
+    round in 8-client blocks must hold device data O(block) in phase 1
+    and O(K x Pb) in phase 2 — never the O(K x P) cohort matrix, which
+    stays in host RAM.  Same live-bytes harness as the linear-path
+    bound test."""
+    n = 64
+    cfg = _mnist_like_cfg(client_num_in_total=n, client_num_per_round=n,
+                          comm_round=2, frequency_of_the_test=100,
+                          norm_bound=0.5)
+    data = load_data("femnist", client_num_in_total=n, batch_size=20,
+                     synthetic_scale=0.0, seed=0)
+    model = create_model("cnn", output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=0.05)
+    # param_block_bytes small enough that phase 2 runs MANY slices
+    eng = MeshRobustEngine(trainer, data, cfg, defense="median",
+                           n_byzantine=1, mesh=make_mesh(8),
+                           stream_block=8, param_block_bytes=64 << 10)
+
+    block = eng._upload_block(np.arange(8), np.ones(8, np.float32),
+                              np.asarray(jax.random.split(
+                                  jax.random.PRNGKey(0), 8)))
+    block_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                      for a in jax.tree.leaves(block))
+    del block
+    v = eng.init_variables()
+    v = eng._prepare_variables(v)
+    var_bytes = sum(int(np.prod(a.shape)) * 4 for a in jax.tree.leaves(v))
+    # flats [B, P] per block-step + the phase-2 [K, Pb] slice + result
+    P_flat = var_bytes // 4    # f32 leaves -> element count upper bound
+    flats_bytes = 8 * P_flat * 4
+    slice_bytes = 2 * (64 << 10)
+    baseline = _live_bytes() + block_bytes
+
+    peaks = []
+    # sample BOTH phases: phase 1 at every block upload, phase 2 at
+    # every param-slice colstat call (a regression that materializes the
+    # whole [K, P] matrix on device in either phase must land in peaks)
+    _spy_live_bytes(eng, "_upload_block", peaks)
+    _spy_live_bytes(eng, "_colstat", peaks)
+    v = eng.run(variables=v, rounds=2)
+    assert eng._stack is None
+    assert len(peaks) >= 2 * (n // 8)
+    eval_bytes = sum(np.asarray(x).nbytes
+                     for shard in (data.train_global, data.test_global)
+                     for x in shard.values())
+    # new_flat [P] + host->device result assembly ride the var_bytes term
+    bound = (baseline + 2 * block_bytes + 2 * var_bytes + flats_bytes
+             + slice_bytes + eval_bytes + (8 << 20))
+    assert max(peaks) <= bound, (max(peaks), bound)
+    # the bound must itself sit well below resident-cohort scale, or the
+    # test guards nothing
+    cohort_matrix_bytes = n * P_flat * 4     # what the resident path holds
+    assert bound < baseline + cohort_matrix_bytes // 2, (
+        bound, cohort_matrix_bytes)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
 
 
 def test_blockstream_orderstat_refuses_multiprocess(monkeypatch):
@@ -213,21 +285,16 @@ def test_streaming_reference_scale_memory_bound():
     eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
                            streaming=True)
 
-    def live_bytes():
-        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                   for a in jax.live_arrays())
-
     cohort, w = eng.stream_cohort(0)
     cohort_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                        for a in jax.tree.leaves(cohort)) + w.nbytes
     del cohort, w
     v = eng.init_variables()
     v = eng._prepare_variables(v)
-    baseline = live_bytes() + cohort_bytes  # v + anything engine init left
+    baseline = _live_bytes() + cohort_bytes  # v + anything engine init left
 
     peaks = []
-    orig = eng.stream_cohort
-    eng.stream_cohort = lambda r: (peaks.append(live_bytes()), orig(r))[1]
+    _spy_live_bytes(eng, "stream_cohort", peaks)
     v = eng.run(variables=v, rounds=3)
     assert eng._stack is None          # resident stack never built
     assert len(peaks) >= 3
@@ -258,10 +325,6 @@ def test_blockstream_device_memory_is_o_block():
     eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
                            stream_block=8)
 
-    def live_bytes():
-        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                   for a in jax.live_arrays())
-
     block = eng._upload_block(np.arange(8),
                               np.ones(8, np.float32),
                               np.asarray(jax.random.split(
@@ -274,11 +337,10 @@ def test_blockstream_device_memory_is_o_block():
     # num accumulator = one f32 copy of the variables
     var_bytes = sum(int(np.prod(a.shape)) * 4
                     for a in jax.tree.leaves(v))
-    baseline = live_bytes() + block_bytes
+    baseline = _live_bytes() + block_bytes
 
     peaks = []
-    orig = eng._upload_block
-    eng._upload_block = lambda *a: (peaks.append(live_bytes()), orig(*a))[1]
+    _spy_live_bytes(eng, "_upload_block", peaks)
     v = eng.run(variables=v, rounds=2)
     assert eng._stack is None
     assert len(peaks) >= 2 * (n // 8)      # every block observed
