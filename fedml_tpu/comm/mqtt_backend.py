@@ -5,8 +5,9 @@ Parity: fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14-126
 `fedml_<client>`; clients the mirror image).  Payloads are the Message
 mobile-parity JSON (brokered devices won't speak the binary frame).
 
-paho-mqtt is optional in this image; the backend raises a clear error at
-construction when it (or a broker) is unavailable.
+paho-mqtt is optional; when absent the backend falls back to the in-repo
+MQTT 3.1.1 wire client (comm/mqtt_wire.py — same frames a real broker
+speaks, tested against the in-repo MiniMqttBroker over TCP sockets).
 """
 from __future__ import annotations
 
@@ -26,18 +27,20 @@ class MqttBackend(BaseCommManager):
                  port: int = 1883, keepalive: int = 180,
                  client_factory=None):
         """client_factory(client_id=...) -> paho-compatible client; defaults
-        to paho.mqtt.Client.  Tests inject an in-memory broker's factory so
-        the topic scheme is verifiable without a broker daemon."""
+        to paho.mqtt.Client, falling back to the in-repo wire client
+        (mqtt_wire.MiniMqttClient) when paho is absent.  Tests use both:
+        an in-memory fake for topic-scheme checks and MiniMqttBroker for
+        wire-level round-trips."""
         super().__init__()
         if client_factory is None:
             try:
                 import paho.mqtt.client as mqtt
-            except ImportError as e:      # pragma: no cover - env-dependent
-                raise RuntimeError(
-                    "MQTT backend requires paho-mqtt, which is not installed "
-                    "in this image; use GRPC or TCP for remote participants, "
-                    "or inject a client_factory") from e
-            client_factory = mqtt.Client
+                client_factory = mqtt.Client
+            except ImportError:           # pragma: no cover - env-dependent
+                from fedml_tpu.comm.mqtt_wire import MiniMqttClient
+                log.info("paho-mqtt not installed; using the in-repo "
+                         "MQTT 3.1.1 wire client")
+                client_factory = MiniMqttClient
         self.rank = rank
         self.size = size
         self._mqtt = client_factory(client_id=f"fedml_tpu_{rank}")
