@@ -35,7 +35,15 @@ class MqttBackend(BaseCommManager):
         if client_factory is None:
             try:
                 import paho.mqtt.client as mqtt
-                client_factory = mqtt.Client
+                if hasattr(mqtt, "CallbackAPIVersion"):
+                    # paho >= 2.0 requires the callback API version as
+                    # the first argument; VERSION1 keeps the v1
+                    # on_message signature this backend uses
+                    import functools
+                    client_factory = functools.partial(
+                        mqtt.Client, mqtt.CallbackAPIVersion.VERSION1)
+                else:                     # pragma: no cover - env-dependent
+                    client_factory = mqtt.Client
             except ImportError:           # pragma: no cover - env-dependent
                 from fedml_tpu.comm.mqtt_wire import MiniMqttClient
                 log.info("paho-mqtt not installed; using the in-repo "

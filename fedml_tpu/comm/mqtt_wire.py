@@ -113,18 +113,32 @@ class MiniMqttBroker:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    # a stalled subscriber (full TCP buffer, process paused) must not
+    # wedge the publisher's serve thread forever: sends time out and the
+    # dead connection is dropped (its serve loop then cleans up)
+    SEND_TIMEOUT_S = 30.0
+
     def _send(self, conn: socket.socket, data: bytes) -> None:
         wlock = self._wlocks.get(conn)
         if wlock is None:
             return                   # connection already torn down
-        with wlock:
-            conn.sendall(data)
+        try:
+            with wlock:
+                conn.sendall(data)
+        except (socket.timeout, OSError):
+            log.warning("broker: dropping stalled/dead subscriber")
+            conn.close()
 
     def _serve(self, conn: socket.socket) -> None:
         try:
             h, _ = _read_frame(conn)
             if h & 0xF0 != CONNECT:
                 return
+            # send-direction timeout ONLY (SO_SNDTIMEO): reads stay
+            # blocking — a settimeout() would fire mid-frame on recv
+            conn.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", int(self.SEND_TIMEOUT_S), 0))
             with self._lock:
                 self._subs[conn] = []
                 self._wlocks[conn] = threading.Lock()

@@ -100,6 +100,23 @@ def build_fedopt_streaming_case():
                             donate=False)
 
 
+def build_blockstream_case():
+    """Block-streamed FedAvg (stream_block) across the process boundary:
+    every block upload is a global device_put and the accumulated linear
+    sums psum across processes each block step — the round-5 cohort
+    machinery on the DCN layout."""
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    data, cfg = _case_data_cfg(comm_round=2)
+    model = create_model("lr", output_dim=10)
+    return MeshFedAvgEngine(ClientTrainer(model, lr=cfg.lr), data, cfg,
+                            mesh=make_mesh(8), donate=False,
+                            stream_block=8)
+
+
 def build_ckpt_case():
     """Checkpoint/resume across the process boundary (VERDICT r4 #5):
     FedOpt so a NONTRIVIAL server_state (adam moments) must round-trip

@@ -44,7 +44,8 @@ init_multihost(coordinator_address=f"localhost:{port}",
                num_processes=nprocs, process_id=pid, required=True)
 
 
-from tests.multihost_case import (build_case, build_fedopt_streaming_case,  # noqa: E402
+from tests.multihost_case import (build_blockstream_case, build_case,  # noqa: E402
+                                  build_fedopt_streaming_case,
                                   build_hier_case, digest)
 
 assert jax.device_count() == nprocs * ndev
@@ -66,3 +67,10 @@ s = build_fedopt_streaming_case()
 sv = s.run()
 sm = s.evaluate(sv)
 print(f"SDIGEST {digest(sv):.10e} SACC {sm['test_acc']:.6f}", flush=True)
+
+# block-streamed round: per-block global device_put + per-block psum of
+# the accumulated linear sums, crossing the process boundary
+b = build_blockstream_case()
+bv = b.run()
+bm = b.evaluate(bv)
+print(f"BDIGEST {digest(bv):.10e} BACC {bm['test_acc']:.6f}", flush=True)

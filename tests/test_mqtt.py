@@ -189,14 +189,20 @@ def test_mqtt_wire_large_payload_with_pings():
 
 
 def test_mqtt_backend_wire_roundtrip():
-    """MqttBackend with its DEFAULT client factory (paho absent -> the
-    in-repo wire client) against MiniMqttBroker: the reference topic
-    scheme rides real frames end-to-end."""
+    """MqttBackend over the wire client against MiniMqttBroker: the
+    reference topic scheme rides real frames end-to-end.  With paho
+    absent (this image) the DEFAULT factory is exercised — proving the
+    fallback; with paho installed the wire factory is passed explicitly
+    so the test stays wire-level either way."""
+    import importlib.util
+    factory = (None if importlib.util.find_spec("paho") is None
+               else MiniMqttClient)
     broker = MiniMqttBroker()
-    server = MqttBackend(0, 3, host=broker.host, port=broker.port)
-    c1 = MqttBackend(1, 3, host=broker.host, port=broker.port)
-    c2 = MqttBackend(2, 3, host=broker.host, port=broker.port)
-    assert isinstance(server._mqtt, MiniMqttClient)   # the fallback path
+    kw = dict(host=broker.host, port=broker.port, client_factory=factory)
+    server = MqttBackend(0, 3, **kw)
+    c1 = MqttBackend(1, 3, **kw)
+    c2 = MqttBackend(2, 3, **kw)
+    assert isinstance(server._mqtt, MiniMqttClient)   # wire client in use
 
     got = {}
     for name, b in (("server", server), ("c1", c1), ("c2", c2)):

@@ -8,10 +8,11 @@ collectives).  The trained results must match the single-process
 8-device runs of the identical cases (tests/multihost_case.py), proving
 the engines are genuinely global-view: scaling to multiple hosts
 changes the runtime bootstrap (parallel/multihost.py), not the training
-code.  Topologies (VERDICT r3 weak-#6):
+code.  Topologies (VERDICT r3 weak-#6), each running flat + N-silo
+hierarchical + streaming FedOpt + block-streamed rounds:
 
-  2 processes x 4 devices — flat + 2-silo hierarchical + streaming FedOpt
-  4 processes x 2 devices — flat + 4-silo hierarchical + streaming FedOpt
+  2 processes x 4 devices   (plus orbax checkpoint/resume across
+  4 processes x 2 devices    cluster death — see the ckpt test below)
 
 The reference's equivalent capability is mpirun over a hostfile with
 one process per client rank (run_fedavg_distributed_pytorch.sh:16-35);
@@ -41,10 +42,12 @@ def _parse(out: str):
     m = re.search(r"DIGEST ([\d.e+-]+) ACC ([\d.]+)", out)
     h = re.search(r"HDIGEST ([\d.e+-]+) HACC ([\d.]+)", out)
     s = re.search(r"SDIGEST ([\d.e+-]+) SACC ([\d.]+)", out)
-    assert m and h and s, f"worker produced no digest:\n{out[-2000:]}"
+    b = re.search(r"BDIGEST ([\d.e+-]+) BACC ([\d.]+)", out)
+    assert m and h and s and b, f"worker produced no digest:\n{out[-2000:]}"
     return {"d": float(m.group(1)), "a": float(m.group(2)),
             "hd": float(h.group(1)), "ha": float(h.group(2)),
-            "sd": float(s.group(1)), "sa": float(s.group(2))}
+            "sd": float(s.group(1)), "sa": float(s.group(2)),
+            "bd": float(b.group(1)), "ba": float(b.group(2))}
 
 
 def _run_cluster_raw(nprocs: int, ndev: int, worker: str = WORKER,
@@ -117,13 +120,21 @@ def _fedopt_streaming_oracle():
     return digest(sv), s.evaluate(sv)["test_acc"]
 
 
+@functools.cache
+def _blockstream_oracle():
+    from tests.multihost_case import build_blockstream_case, digest
+    b = build_blockstream_case()
+    bv = b.run()
+    return digest(bv), b.evaluate(bv)["test_acc"]
+
+
 def _check_against_oracle(workers, silos: int):
     # all SPMD replicas hold the identical replicated result
     w0 = workers[0]
     for w in workers[1:]:
-        for k in ("d", "hd", "sd"):
+        for k in ("d", "hd", "sd", "bd"):
             assert w0[k] == pytest.approx(w[k], rel=1e-7)
-        for k in ("a", "ha", "sa"):
+        for k in ("a", "ha", "sa", "ba"):
             assert w0[k] == w[k]
 
     # single-process oracles on the same 8 (virtual) devices, cached —
@@ -145,6 +156,11 @@ def _check_against_oracle(workers, silos: int):
     sd, sa = _fedopt_streaming_oracle()
     assert w0["sd"] == pytest.approx(sd, rel=1e-5)
     assert w0["sa"] == pytest.approx(sa, abs=1e-6)
+
+    # block-streamed round (stream_block) across the process boundary
+    bd, ba = _blockstream_oracle()
+    assert w0["bd"] == pytest.approx(bd, rel=1e-5)
+    assert w0["ba"] == pytest.approx(ba, abs=1e-6)
 
 
 def test_two_process_mesh_matches_single_process():
