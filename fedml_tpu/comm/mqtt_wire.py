@@ -127,6 +127,13 @@ class MiniMqttBroker:
                 conn.sendall(data)
         except (socket.timeout, OSError):
             log.warning("broker: dropping stalled/dead subscriber")
+            try:
+                # shutdown (not just close) so the connection's _serve
+                # thread blocked in recv wakes up and runs its cleanup —
+                # close() alone does not interrupt an in-flight recv
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             conn.close()
 
     def _serve(self, conn: socket.socket) -> None:
@@ -135,10 +142,15 @@ class MiniMqttBroker:
             if h & 0xF0 != CONNECT:
                 return
             # send-direction timeout ONLY (SO_SNDTIMEO): reads stay
-            # blocking — a settimeout() would fire mid-frame on recv
+            # blocking — a settimeout() would fire mid-frame on recv.
+            # The payload is a struct timeval on POSIX but a DWORD of
+            # milliseconds on Windows.
+            import sys as _sys
             conn.setsockopt(
                 socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                struct.pack("ll", int(self.SEND_TIMEOUT_S), 0))
+                struct.pack("<L", int(self.SEND_TIMEOUT_S * 1000))
+                if _sys.platform == "win32"
+                else struct.pack("ll", int(self.SEND_TIMEOUT_S), 0))
             with self._lock:
                 self._subs[conn] = []
                 self._wlocks[conn] = threading.Lock()
@@ -179,10 +191,7 @@ class MiniMqttBroker:
             targets = [c for c, filts in self._subs.items()
                        if any(topic_matches(f, topic) for f in filts)]
         for c in targets:
-            try:
-                self._send(c, pub)
-            except OSError:          # receiver died; its serve loop cleans up
-                pass
+            self._send(c, pub)       # _send drops dead receivers itself
 
     def close(self) -> None:
         self._running = False

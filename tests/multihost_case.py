@@ -104,13 +104,16 @@ def build_blockstream_case():
     """Block-streamed FedAvg (stream_block) across the process boundary:
     every block upload is a global device_put and the accumulated linear
     sums psum across processes each block step — the round-5 cohort
-    machinery on the DCN layout."""
+    machinery on the DCN layout.  Cohort 16 in blocks of 8 = TWO real
+    block steps per round, so cross-block accumulation and the
+    double-buffer prefetch both cross the boundary."""
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.models import create_model
     from fedml_tpu.parallel import MeshFedAvgEngine
     from fedml_tpu.parallel.mesh import make_mesh
 
     data, cfg = _case_data_cfg(comm_round=2)
+    cfg = type(cfg)(**{**cfg.__dict__, "client_num_per_round": 16})
     model = create_model("lr", output_dim=10)
     return MeshFedAvgEngine(ClientTrainer(model, lr=cfg.lr), data, cfg,
                             mesh=make_mesh(8), donate=False,

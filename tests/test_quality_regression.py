@@ -87,6 +87,8 @@ def test_nwp_convergence_artifact_band():
     if not os.path.exists(path):
         pytest.skip("chip artifact not landed yet (tunnel-gated)")
     d = json.load(open(path))
+    if d.get("partial"):
+        pytest.skip("artifact is partial (tunnel wedged mid-run)")
     by = {r["model"]: r for r in d["results"]}
     lstm, tfm = by["rnn_stackoverflow"], by["transformer"]
     assert tfm["params"] > lstm["params"]          # 2x params...
