@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import socket
 import struct
+import sys
 import threading
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -145,11 +146,10 @@ class MiniMqttBroker:
             # blocking — a settimeout() would fire mid-frame on recv.
             # The payload is a struct timeval on POSIX but a DWORD of
             # milliseconds on Windows.
-            import sys as _sys
             conn.setsockopt(
                 socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                 struct.pack("<L", int(self.SEND_TIMEOUT_S * 1000))
-                if _sys.platform == "win32"
+                if sys.platform == "win32"
                 else struct.pack("ll", int(self.SEND_TIMEOUT_S), 0))
             with self._lock:
                 self._subs[conn] = []

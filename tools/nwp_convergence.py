@@ -118,9 +118,10 @@ def main() -> None:
     # mode can wedge mid-run, and a one-model artifact (marked partial)
     # beats losing the completed training.  The band test requires both
     # models, so a partial artifact stays skipped, never asserted.
-    for name in ("rnn_stackoverflow", "transformer"):
+    models = ("rnn_stackoverflow", "transformer")
+    for name in models:
         out["results"].append(_train(name, data, rounds))
-        out["partial"] = len(out["results"]) < 2
+        out["partial"] = len(out["results"]) < len(models)
         if out_path:
             # atomic: a kill mid-dump must not leave truncated JSON
             with open(out_path + ".tmp", "w") as f:

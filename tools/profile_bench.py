@@ -223,6 +223,72 @@ def exp_C4096B():
           f"train_loss {loss:.4f}", flush=True)
 
 
+def _robust_workload(C: int):
+    """CNN-femnist-shaped workload for the order-stat experiments (the
+    model class these defenses are used with — MeshRobustEngine
+    docstring): ~1.7M params, so a 256-client flats matrix is ~1.7 GB,
+    tunnel-feasible for the two-phase D2H/H2D traversal."""
+    from fedml_tpu.data.loaders import load_data
+    from fedml_tpu.utils.config import FedConfig
+
+    cfg = FedConfig(model="cnn", dataset="femnist",
+                    client_num_in_total=C, client_num_per_round=C,
+                    epochs=1, batch_size=20, lr=0.05, norm_bound=0.5,
+                    frequency_of_the_test=10_000)
+    data = load_data("femnist", client_num_in_total=C, batch_size=20,
+                     synthetic_scale=0.0, seed=0)
+    model = create_model("cnn", output_dim=data.class_num)
+    trainer = ClientTrainer(model, lr=cfg.lr, train_dtype=jnp.bfloat16)
+    return cfg, data, trainer
+
+
+def _orderstat_round(C: int, stream_block=None, defense="median"):
+    from fedml_tpu.parallel import MeshRobustEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    cfg, data, trainer = _robust_workload(C)
+    engine = MeshRobustEngine(trainer, data, cfg, defense=defense,
+                              n_byzantine=max(1, C // 8),
+                              mesh=make_mesh(), chunk=2,
+                              local_dtype=jnp.bfloat16,
+                              stream_block=stream_block, donate=False)
+    variables = engine.init_variables()
+    server_state = engine.server_init(variables)
+    if stream_block is None:
+        stack, stack_w = engine._device_stack()
+        ids, wmask = engine.sample_padded(0)
+        args = (stack, stack_w, ids, wmask)
+    else:
+        args = (0,)
+    rng = jax.random.PRNGKey(0)
+
+    def round_once():
+        v, s, m = engine.round_fn(variables, server_state, *args, rng)
+        return m["train_loss"]
+
+    dt = timeit(round_once, warmup=1, iters=3)
+    mode = ("resident" if stream_block is None
+            else f"blockstream({stream_block})")
+    print(f"OS {defense} C={C} {mode}: {dt:.3f}s/round", flush=True)
+    return dt
+
+
+def exp_OS256():
+    """Resident order-stat defenses at a 256-client CNN cohort (the
+    replicated [K, P] matrix path): median and krum, 3 timed rounds."""
+    _orderstat_round(256, defense="median")
+    _orderstat_round(256, defense="krum")
+
+
+def exp_OSB256():
+    """The SAME 256-client rounds via the two-phase block stream
+    (host [K, P] matrix, param-major slices): the resident-vs-streamed
+    overhead is the chip cost of the beyond-HBM path (SCALING.md
+    'Order statistics beyond HBM')."""
+    _orderstat_round(256, stream_block=32, defense="median")
+    _orderstat_round(256, stream_block=32, defense="krum")
+
+
 def exp_B(batch_unroll: int = 1, bs: int = BS, n_batches: int = None,
           tag: str = "B"):
     """Centralized ceiling: shared weights, ceil(SPC/bs) steps (or an
