@@ -4,7 +4,7 @@ Reference (fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py:
 176-206 + fedml_core/robustness/robust_aggregation.py): per-client norm
 -difference clipping before the weighted average, plus optional weak-DP
 Gaussian noise on the aggregate.  Additional aggregation rules beyond the
-reference (krum, coordinate-median, trimmed-mean) are provided since they
+reference (krum, multi-krum, coordinate-median, trimmed-mean) are provided since they
 are pure pytree ops on the stacked client axis.
 
 Attack simulation parity: the reference schedules Byzantine clients every
@@ -22,17 +22,23 @@ import jax.numpy as jnp
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.core.pytree import tree_weighted_mean
 from fedml_tpu.core.robust import (add_weak_dp_noise, coordinate_median,
-                                   krum_select, norm_diff_clip, trimmed_mean)
+                                   default_multi_krum_m, krum_select,
+                                   multi_krum_select, norm_diff_clip,
+                                   trimmed_mean)
 
 
 class FedAvgRobustEngine(FedAvgEngine):
-    """defense: "norm_clip" (reference), "krum", "median", "trimmed_mean"."""
+    """defense: "norm_clip" (reference), "krum", "multi_krum", "median",
+    "trimmed_mean"."""
 
     def __init__(self, trainer, data, cfg, defense: str = "norm_clip",
-                 n_byzantine: int = 0,
+                 n_byzantine: int = 0, multi_krum_m: Optional[int] = None,
                  attack_fn: Optional[Callable] = None, **kw):
         self.defense = defense
         self.n_byzantine = n_byzantine
+        self.multi_krum_m = default_multi_krum_m(
+            min(cfg.client_num_per_round, data.client_num), n_byzantine,
+            multi_krum_m)
         self.attack_fn = attack_fn
         super().__init__(trainer, data, cfg, **kw)
 
@@ -56,6 +62,12 @@ class FedAvgRobustEngine(FedAvgEngine):
         elif self.defense == "krum":
             i = krum_select(params, self.n_byzantine)
             new_params = jax.tree.map(lambda x: x[i], params)
+        elif self.defense == "multi_krum":
+            idx = multi_krum_select(params, self.n_byzantine,
+                                    self.multi_krum_m)
+            new_params = jax.tree.map(
+                lambda x: jnp.mean(x[idx].astype(jnp.float32),
+                                   axis=0).astype(x.dtype), params)
         elif self.defense == "median":
             new_params = coordinate_median(params)
         elif self.defense == "trimmed_mean":

@@ -156,10 +156,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="hierarchical: silo count")
     p.add_argument("--group_comm_round", type=int, default=2)
     p.add_argument("--defense", type=str, default="norm_clip",
-                   choices=("norm_clip", "krum", "median", "trimmed_mean"))
+                   choices=("norm_clip", "krum", "multi_krum", "median",
+                            "trimmed_mean"))
     p.add_argument("--n_byzantine", type=int, default=0,
                    help="assumed Byzantine count (krum neighbor count, "
                         "trimmed-mean trim width)")
+    p.add_argument("--multi_krum_m", type=int, default=None,
+                   help="multi-krum selection size (default K - f - 2)")
     p.add_argument("--topology", type=str, default="ring",
                    choices=("ring", "ws", "asymmetric"),
                    help="decentralized graph: ring = symmetric ring "
@@ -360,10 +363,12 @@ def build_engine(args, cfg: FedConfig, data):
                    "fedavg_robust": MeshRobustEngine}[algo]
             kw = {}
             if algo == "fedavg_robust":
-                # all four defenses run on the mesh now (order-statistic
-                # ones via the replicated cohort matrix, MeshRobustEngine)
+                # all five defenses run on the mesh (order-statistic
+                # ones via the replicated cohort matrix — or the
+                # two-phase block stream with --stream_block)
                 kw = dict(defense=args.defense,
-                          n_byzantine=args.n_byzantine)
+                          n_byzantine=args.n_byzantine,
+                          multi_krum_m=args.multi_krum_m)
             return cls(trainer, data, cfg, mesh=mesh,
                        streaming=args.streaming, chunk=args.cohort_chunk,
                        local_dtype=_local_dtype(args),
@@ -387,7 +392,8 @@ def build_engine(args, cfg: FedConfig, data):
         if algo == "fedavg_robust":
             return A.FedAvgRobustEngine(trainer, data, cfg,
                                         defense=args.defense,
-                                        n_byzantine=args.n_byzantine)
+                                        n_byzantine=args.n_byzantine,
+                                        multi_krum_m=args.multi_krum_m)
         from fedml_tpu.algorithms.turboaggregate import TurboAggregateEngine
         return TurboAggregateEngine(trainer, data, cfg)
 

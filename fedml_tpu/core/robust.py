@@ -42,17 +42,42 @@ def add_weak_dp_noise(params: Pytree, rng: jax.Array, stddev: float) -> Pytree:
 def krum_select_flat(flat: jax.Array, n_byzantine: int) -> jax.Array:
     """Krum on a [K, P] client-update matrix: index of the client whose
     update has the smallest sum of squared distances to its n-f-2 nearest
-    neighbors."""
-    # gram-matrix form: O(K·P + K²) memory, and the K×P matmul runs on the
-    # MXU — never materialize the [K,K,P] broadcast.
+    neighbors.  Gram-matrix form (krum_scores_flat): O(K·P + K²) memory,
+    and the K×P matmul runs on the MXU — never materialize the [K,K,P]
+    broadcast."""
+    return jnp.argmin(krum_scores_flat(flat, n_byzantine))
+
+
+def krum_scores_flat(flat: jax.Array, n_byzantine: int) -> jax.Array:
+    """Per-client krum scores on a [K, P] matrix: Σ of squared distances
+    to the n-f-2 nearest neighbors (the quantity krum argmins and
+    multi-krum top-m's — one definition for both)."""
     sq = jnp.sum(flat * flat, axis=1)
     d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T), 0.0)
     n = flat.shape[0]
     k = max(n - n_byzantine - 2, 1)
     d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
-    nearest = jnp.sort(d2, axis=1)[:, :k]
-    scores = jnp.sum(nearest, axis=1)
-    return jnp.argmin(scores)
+    return jnp.sum(jnp.sort(d2, axis=1)[:, :k], axis=1)
+
+
+def default_multi_krum_m(K: int, n_byzantine: int,
+                         m: "int | None" = None) -> int:
+    """Multi-krum selection size: the Blanchard et al. 2017 default
+    m = K - f - 2 when unset, clamped to [1, K] either way — THE one
+    definition both the single-device and mesh engines share."""
+    if m is None:
+        m = K - n_byzantine - 2
+    return max(1, min(m, K))
+
+
+def multi_krum_select_flat(flat: jax.Array, n_byzantine: int,
+                           m: int) -> jax.Array:
+    """Multi-krum on a [K, P] matrix: indices of the m clients with the
+    LOWEST krum scores (Blanchard et al. 2017 §4 — m=1 degenerates to
+    krum; the aggregate is the plain mean of the selected updates)."""
+    scores = krum_scores_flat(flat, n_byzantine)
+    m = max(1, min(m, flat.shape[0]))
+    return jnp.argsort(scores)[:m]
 
 
 def krum_select(stacked_params: Pytree, n_byzantine: int) -> jax.Array:
@@ -61,6 +86,15 @@ def krum_select(stacked_params: Pytree, n_byzantine: int) -> jax.Array:
     flat = jnp.concatenate(
         [x.reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked_params)], axis=1)
     return krum_select_flat(flat, n_byzantine)
+
+
+def multi_krum_select(stacked_params: Pytree, n_byzantine: int,
+                      m: int) -> jax.Array:
+    """Multi-krum over a stacked pytree: indices of the m best-scored
+    clients (their plain mean is the aggregate)."""
+    flat = jnp.concatenate(
+        [x.reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked_params)], axis=1)
+    return multi_krum_select_flat(flat, n_byzantine, m)
 
 
 def coordinate_median(stacked_params: Pytree) -> Pytree:

@@ -794,12 +794,16 @@ class MeshRobustEngine(MeshFedAvgEngine):
     in a median), enforced at construction."""
 
     def __init__(self, trainer, data, cfg, defense: str = "norm_clip",
-                 n_byzantine: int = 0, param_block_bytes: int = 128 << 20,
-                 **kw):
-        if defense not in ("norm_clip", "krum", "median", "trimmed_mean"):
+                 n_byzantine: int = 0, multi_krum_m: Optional[int] = None,
+                 param_block_bytes: int = 128 << 20, **kw):
+        if defense not in ("norm_clip", "krum", "multi_krum", "median",
+                           "trimmed_mean"):
             raise ValueError(f"unknown defense {defense!r}")
         self.defense = defense
         self.n_byzantine = n_byzantine
+        self.multi_krum_m = robust_ops.default_multi_krum_m(
+            min(cfg.client_num_per_round, data.client_num), n_byzantine,
+            multi_krum_m)
         self.param_block_bytes = param_block_bytes
         super().__init__(trainer, data, cfg, **kw)
         if defense != "norm_clip" and self.batch_axes:
@@ -896,6 +900,10 @@ class MeshRobustEngine(MeshFedAvgEngine):
         if self.defense == "krum":
             i = robust_ops.krum_select_flat(flats, self.n_byzantine)
             new_flat = flats[i]
+        elif self.defense == "multi_krum":
+            idx = robust_ops.multi_krum_select_flat(
+                flats, self.n_byzantine, self.multi_krum_m)
+            new_flat = jnp.mean(flats[idx], axis=0)
         elif self.defense == "median":
             new_flat = jnp.median(flats, axis=0)
         else:                                 # trimmed_mean
@@ -1013,16 +1021,15 @@ class MeshRobustEngine(MeshFedAvgEngine):
                                                server_state, agg_rng)
         return new, server_state, {"train_loss": lsum / den}
 
-    def _krum_from_gram(self, G: np.ndarray) -> int:
-        """core/robust.py::krum_select_flat's scoring, from the Gram
-        matrix (numpy: G is [K, K] — host-trivial next to the matmuls)."""
+    def _krum_scores_from_gram(self, G: np.ndarray) -> np.ndarray:
+        """core/robust.py::krum_scores_flat, from the Gram matrix
+        (numpy: G is [K, K] — host-trivial next to the matmuls)."""
         sq = np.diag(G)
         d2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * G, 0.0)
         n = G.shape[0]
         k = max(n - self.n_byzantine - 2, 1)
         np.fill_diagonal(d2, np.inf)
-        nearest = np.sort(d2, axis=1)[:, :k]
-        return int(np.argmin(nearest.sum(axis=1)))
+        return np.sort(d2, axis=1)[:, :k].sum(axis=1)
 
     def _round_blockstream_orderstat(self, variables, server_state,
                                      round_idx, rng):
@@ -1080,11 +1087,17 @@ class MeshRobustEngine(MeshFedAvgEngine):
                 xb = buf
             return jax.device_put(xb, self._param_sharding())
 
-        if self.defense == "krum":
+        if self.defense in ("krum", "multi_krum"):
             G = np.zeros((K, K), np.float32)
             for s in range(n_slices):
                 G += np.asarray(self._gram(slice_padded(s)))
-            new_flat = jnp.asarray(X[self._krum_from_gram(G)])
+            scores = self._krum_scores_from_gram(G)
+            if self.defense == "krum":
+                new_flat = jnp.asarray(X[int(np.argmin(scores))])
+            else:
+                idx = np.argsort(scores)[:self.multi_krum_m]
+                new_flat = jnp.asarray(
+                    np.mean(X[idx], axis=0, dtype=np.float32))
         else:
             out = np.empty(n_slices * pb, np.float32)
             for s in range(n_slices):

@@ -180,6 +180,16 @@ class TestRobust:
         stacked = tree_stack([bad] + good)
         assert int(krum_select(stacked, n_byzantine=1)) != 0
 
+    def test_multi_krum_m1_is_krum_and_rejects_outlier(self):
+        from fedml_tpu.core.robust import multi_krum_select
+        good = [_tree(i, scale=0.01) for i in range(4)]
+        bad = jax.tree.map(lambda x: x + 50.0, _tree(9, scale=0.01))
+        stacked = tree_stack(good + [bad])
+        idx1 = multi_krum_select(stacked, n_byzantine=1, m=1)
+        assert int(idx1[0]) == int(krum_select(stacked, n_byzantine=1))
+        idx3 = multi_krum_select(stacked, n_byzantine=1, m=3)
+        assert idx3.shape == (3,) and 4 not in np.asarray(idx3)
+
     def test_median_and_trimmed_mean_reject_outlier(self):
         good = [_tree(0, scale=0.0) for _ in range(4)]
         bad = jax.tree.map(lambda x: x + 1000.0, _tree(0, scale=0.0))

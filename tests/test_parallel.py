@@ -353,10 +353,12 @@ def test_stack_dtype_bf16_close_to_f32():
     assert cohort["x"].dtype == jnp.int32
 
 
-@pytest.mark.parametrize("defense", ["median", "krum", "trimmed_mean"])
+@pytest.mark.parametrize("defense", ["median", "krum", "trimmed_mean",
+                                     "multi_krum"])
 def test_mesh_orderstat_defense_matches_single_device(defense):
-    """krum/median/trimmed-mean on the mesh (flatten + all_gather + order
-    statistic) must reproduce the single-device FedAvgRobustEngine."""
+    """krum/multi-krum/median/trimmed-mean on the mesh (flatten +
+    all_gather + order statistic) must reproduce the single-device
+    FedAvgRobustEngine."""
     from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustEngine
     cfg = _mnist_like_cfg(comm_round=2)
     trainer, data = _setup(cfg)

@@ -185,7 +185,8 @@ def test_blockstream_orderstat_refuses_multiprocess(monkeypatch):
                          mesh=make_mesh(8), donate=False, stream_block=8)
 
 
-@pytest.mark.parametrize("defense", ["median", "trimmed_mean", "krum"])
+@pytest.mark.parametrize("defense", ["median", "trimmed_mean", "krum",
+                                     "multi_krum"])
 def test_blockstream_orderstat_matches_resident(defense):
     """VERDICT r4 #3: the two-phase block-streamed order-stat defenses
     (client-major training blocks -> host [K, P] matrix -> param-major
