@@ -80,21 +80,26 @@ def multi_krum_select_flat(flat: jax.Array, n_byzantine: int,
     return jnp.argsort(scores)[:m]
 
 
+def _flatten_clients(stacked_params: Pytree) -> jax.Array:
+    """[K, ...] stacked pytree -> the [K, P] matrix the krum family
+    scores (ONE definition of the flattening convention)."""
+    return jnp.concatenate(
+        [x.reshape(x.shape[0], -1)
+         for x in jax.tree.leaves(stacked_params)], axis=1)
+
+
 def krum_select(stacked_params: Pytree, n_byzantine: int) -> jax.Array:
     """Krum over a stacked pytree.  (An addition beyond the reference's
     clip+noise, standard in the robust-FL literature.)"""
-    flat = jnp.concatenate(
-        [x.reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked_params)], axis=1)
-    return krum_select_flat(flat, n_byzantine)
+    return krum_select_flat(_flatten_clients(stacked_params), n_byzantine)
 
 
 def multi_krum_select(stacked_params: Pytree, n_byzantine: int,
                       m: int) -> jax.Array:
     """Multi-krum over a stacked pytree: indices of the m best-scored
     clients (their plain mean is the aggregate)."""
-    flat = jnp.concatenate(
-        [x.reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked_params)], axis=1)
-    return multi_krum_select_flat(flat, n_byzantine, m)
+    return multi_krum_select_flat(_flatten_clients(stacked_params),
+                                  n_byzantine, m)
 
 
 def coordinate_median(stacked_params: Pytree) -> Pytree:

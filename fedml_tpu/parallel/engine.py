@@ -780,9 +780,9 @@ class MeshRobustEngine(MeshFedAvgEngine):
     robust_aggregation.py:38-55, FedAvgRobustAggregator.py:176-206) stays
     collective-only: per-client clipping inside the shard, then the psum.
 
-    defense in {"krum", "median", "trimmed_mean"} needs ORDER STATISTICS
-    over the whole cohort's parameter vectors, which a weighted psum
-    cannot express: each shard flattens its clients' trained params to a
+    defense in {"krum", "multi_krum", "median", "trimmed_mean"} needs
+    ORDER STATISTICS over the whole cohort's parameter vectors, which a
+    weighted psum cannot express: each shard flattens its clients' trained params to a
     [k_local, P] f32 matrix (P padded to the ops/aggregate tile),
     all_gathers it over ICI into the replicated [K, P] cohort matrix, and
     applies the defense there (krum = one MXU gram matrix, median/trimmed

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-5 chip-window queue: run the tunnel-gated measurements in
+# priority order the moment a TPU window opens.  Each step is
+# independently time-boxed so a re-wedge mid-queue still banks the
+# earlier artifacts (bench JSON, convergence artifact, SCALING rows).
+#
+#   bash scripts/run_chip_queue.sh [outdir]
+#
+# Priority (VERDICT r4 next-round #1/#4 + SCALING backlog):
+#   1. bench.py              — re-land the driver-verified rounds/sec
+#   2. nwp_convergence       — LSTM vs TransformerLM chip training
+#   3. profile_bench C4096B  — 4096-client block-streamed round
+#   4. profile_bench OS256/OSB256 — order-stat resident vs streamed
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-runs/chip_queue_$(date +%m%d_%H%M)}"
+mkdir -p "$OUT"
+export PYTHONPATH=/root/repo:/root/.axon_site
+
+echo "== probe"
+if ! timeout 180 python -c "import jax; assert jax.devices()[0].platform=='axon'"; then
+  echo "chip unavailable; aborting queue"; exit 1
+fi
+
+echo "== 1/4 bench.py"
+timeout 1500 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.json"
+
+echo "== 2/4 nwp_convergence (120 rounds, vocab 10004)"
+timeout 3600 python tools/nwp_convergence.py 120 \
+    --out benchmarks/nwp_convergence_r5.json 2>"$OUT/nwp.err" \
+    | tee "$OUT/nwp.log"
+
+echo "== 3/4 profile_bench C4096B (block-streamed 4096 clients)"
+timeout 5400 python tools/profile_bench.py C4096B 2>&1 | tee "$OUT/c4096b.log"
+
+echo "== 4/4 profile_bench OS256 OSB256 (order-stat timing)"
+timeout 3600 python tools/profile_bench.py OS256 OSB256 2>&1 | tee "$OUT/os.log"
+
+echo "== queue complete; artifacts in $OUT + benchmarks/"
