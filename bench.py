@@ -119,8 +119,9 @@ def main() -> None:
         return
 
     import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from fedml_tpu.utils.profiling import repin_jax_platforms
+    repin_jax_platforms()
     import jax.numpy as jnp
 
     from fedml_tpu.core.trainer import ClientTrainer

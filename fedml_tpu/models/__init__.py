@@ -29,7 +29,11 @@ def create_model(model_name: str, output_dim: int, input_dim: int | None = None,
     if name == "rnn":
         return RNNOriginalFedAvg(vocab_size=kw.pop("vocab_size", 90), **kw)
     if name == "rnn_stackoverflow":
-        return RNNStackOverflow(**kw)
+        # vocab follows output_dim (callers pass the dataset's class
+        # count, 10,004 for real stackoverflow) — ignoring it built a
+        # 10,004-way softmax under reduced-vocab smokes
+        return RNNStackOverflow(vocab_size=kw.pop("vocab_size",
+                                                  output_dim), **kw)
     if name == "transformer":
         # beyond-reference: causal decoder LM for the next-token tasks
         # (models/transformer.py) — vocab from the dataset's class count

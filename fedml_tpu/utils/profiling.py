@@ -17,6 +17,19 @@ from typing import Iterator
 import jax
 
 
+def repin_jax_platforms() -> None:
+    """Re-assert an explicit JAX_PLATFORMS env choice over the image's
+    sitecustomize, which force-sets jax_platforms to "axon,cpu"
+    regardless of the env var (see tests/conftest.py): without this, a
+    JAX_PLATFORMS=cpu dev/CI run still attaches (or blocks on) the
+    tunnel TPU backend.  No-op when the env var is unset — the normal
+    chip path keeps the sitecustomize default.  Call before the first
+    device use (bench.py, tools/)."""
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
     """jax.profiler trace of everything inside the block (device + host)."""

@@ -112,3 +112,44 @@ def test_synthetic_sequences_bit_identical_to_row_formulation():
 
     np.testing.assert_array_equal(x, seqs[:, :-1])
     np.testing.assert_array_equal(y, seqs[:, 1:])
+
+
+def test_synthetic_sequences_classed_is_low_rank_and_learnable():
+    """synthetic_sequences_classed: the transition law depends only on
+    the current token's CLASS (rank-n_classes by construction — the
+    property that makes it learnable at large vocab where the full-rank
+    generator flat-lines, tools/nwp_convergence.py), and the reported
+    oracle_top1 is a real ceiling well above chance."""
+    from fedml_tpu.data.synthetic import synthetic_sequences_classed
+
+    n, seq_len, vocab, C = 4000, 8, 251, 16
+    x, y, oracle = synthetic_sequences_classed(n, seq_len, vocab,
+                                               n_classes=C, seed=5)
+    assert x.shape == (n, seq_len) and y.shape == (n, seq_len)
+    assert x.dtype == np.int32 and y.dtype == np.int64
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])  # shifted view
+    # determinism
+    x2, y2, o2 = synthetic_sequences_classed(n, seq_len, vocab,
+                                             n_classes=C, seed=5)
+    np.testing.assert_array_equal(x, x2)
+    assert oracle == o2
+    # the ceiling is far above chance (dirichlet 0.05 rows concentrate)
+    assert 10.0 / vocab < oracle <= 1.0
+    # low-rank law: the empirical modal next-token of every class's
+    # states must be among that class row's top tokens (top-5, not
+    # exactly argmax: near-tied top probabilities flip the empirical
+    # mode by sampling noise), and the re-derived oracle must agree —
+    # which pins that the law depends on class alone
+    rng = np.random.RandomState(5)
+    cls = rng.randint(0, C, vocab)
+    rows = rng.dirichlet(np.full(vocab, 10.0 / vocab), size=C)
+    freq = np.bincount(cls[x].ravel(), minlength=C)
+    assert abs((rows.max(1) * freq).sum() / freq.sum() - oracle) < 1e-12
+    cur, nxt = x.ravel(), y.ravel()
+    for c in range(C):
+        sel = cls[cur] == c
+        if sel.sum() < 200:
+            continue
+        counts = np.bincount(nxt[sel], minlength=vocab)
+        top5 = set(np.argsort(rows[c])[-5:].tolist())
+        assert int(counts.argmax()) in top5

@@ -74,11 +74,18 @@ def test_convergence_artifact_band():
 def test_nwp_convergence_artifact_band():
     """The chip-measured NWP family artifact (tools/nwp_convergence.py,
     benchmarks/nwp_convergence_r5.json): reference LSTM vs
-    beyond-reference TransformerLM trained through the committed
-    mesh/bf16 recipe on the vocab-10,004 synthetic NWP stand-in.  The
-    PERF.md claim under guard: the transformer is FASTER wall-clock AND
-    at-least-as-good per round.  Skips until a chip window lands the
-    artifact; guards it against silent edits after."""
+    beyond-reference TransformerLM, 600 rounds each through the
+    committed mesh/bf16 recipe on the learnable vocab-10,004 stand-in
+    (rank-64 classed chain, oracle_top1 ~0.19).  Claims under guard
+    (PERF.md round-5 chip session): the transformer converges to
+    substantially HIGHER accuracy at equal rounds, and reaches the
+    LSTM's own final accuracy in well under half the LSTM's total
+    wall-clock (measured: round 50 of 600, 29 s vs 233 s — the honest
+    end-to-end metric; raw per-round wall favors the LSTM at full
+    cohort, where its small matmuls batch wide and the transformer
+    pays 2x params in aggregation, so per-round wall is NOT asserted).
+    Skips until a chip window lands the artifact; guards it against
+    silent edits after."""
     import json
     import os
     path = os.path.join(os.path.dirname(os.path.dirname(
@@ -89,12 +96,21 @@ def test_nwp_convergence_artifact_band():
     d = json.load(open(path))
     if d.get("partial"):
         pytest.skip("artifact is partial (tunnel wedged mid-run)")
+    assert 0.1 < d["oracle_top1"] < 0.35           # learnable ceiling
     by = {r["model"]: r for r in d["results"]}
     lstm, tfm = by["rnn_stackoverflow"], by["transformer"]
-    assert tfm["params"] > lstm["params"]          # 2x params...
-    assert tfm["wall_s"] < lstm["wall_s"]          # ...still faster
-    assert tfm["final_test_acc"] >= lstm["final_test_acc"] - 0.005
-    assert tfm["final_test_loss"] <= lstm["final_test_loss"] + 0.01
+    assert tfm["params"] > lstm["params"]          # 2x params
+    # both genuinely learned (chance = 1e-4; ceiling ~0.19)
+    assert lstm["final_test_acc"] >= 0.05, lstm["final_test_acc"]
+    # quality at equal rounds: transformer clearly ahead
+    assert tfm["final_test_acc"] >= lstm["final_test_acc"] + 0.03
+    # time-to-quality: first transformer round at >= the LSTM's FINAL
+    # accuracy, in wall-clock, is under half the LSTM's total wall
+    cross = next(r["round"] for r in tfm["curve"]
+                 if r["test_acc"] >= lstm["final_test_acc"])
+    tfm_sec_per_round = tfm["wall_s"] / tfm["rounds"]
+    assert cross * tfm_sec_per_round < 0.5 * lstm["wall_s"], \
+        (cross, tfm_sec_per_round, lstm["wall_s"])
 
 
 def test_mnist_row_pinned_accuracy():
