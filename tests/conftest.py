@@ -30,4 +30,7 @@ jax.config.update("jax_platforms", "cpu")
 from multihost_case import JAX_TEST_CACHE_DIR  # noqa: E402
 
 jax.config.update("jax_compilation_cache_dir", JAX_TEST_CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# 0.1 (was 0.5): the suite compiles many hundreds of 0.1-0.5 s
+# programs across 8 xdist workers + the subprocess-spawning tests;
+# caching them too trades ~ms of disk lookup for their compile CPU
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

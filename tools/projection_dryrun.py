@@ -69,8 +69,9 @@ def _child(n_devices: int, batch_axis: int) -> None:
         client_shards = n_devices
     per_shard = N_CLIENTS // client_shards
 
-    # PROJECTION_MODEL=lr swaps the flagship ResNet for the tiny LR model
-    # (the 64-device clients x batch workaround experiments)
+    # PROJECTION_MODEL swaps the flagship ResNet for a smaller model
+    # ("lr"/"cnn" — the >=64-device clients x batch cases that bracket
+    # the XLA:CPU AllReduceThunk SIGSEGV to buffer size)
     model_name = os.environ.get("PROJECTION_MODEL", "resnet18_gn")
     cfg = FedConfig(model=model_name, client_num_in_total=N_CLIENTS,
                     client_num_per_round=N_CLIENTS, comm_round=ROUNDS,
@@ -132,9 +133,15 @@ def main() -> None:
     # 64 and 128 devices, and the SAME (64, 2) topology executes with the
     # LR model — the "lr" group below, the executed >=64-device
     # clients x batch data point VERDICT r4 weak-#3 asked for).
+    # The "cnn" pair upgrades that data point from the linear LR model
+    # to a REAL conv stack (the FedAvg CNN, ~0.4M params at the tiny
+    # shapes): (64, 2) executes the per-step batch-axis grad psum with
+    # conv gradients, bracketing the SIGSEGV boundary to buffer size
+    # (LR ok, CNN ok, 11M-param ResNet crashes the host runtime).
     cases = [(8, 1, "resnet18_gn"), (64, 1, "resnet18_gn"),
              (128, 1, "resnet18_gn"), (32, 2, "resnet18_gn"),
-             (8, 1, "lr"), (64, 2, "lr")]
+             (8, 1, "lr"), (64, 2, "lr"),
+             (8, 1, "cnn"), (64, 2, "cnn")]
     results, params = [], {}
     for n_devices, batch_axis, model in cases:
         out = f"/tmp/projection_dryrun_{n_devices}_{batch_axis}_{model}.npy"
@@ -158,7 +165,7 @@ def main() -> None:
         print(row, flush=True)
 
     import numpy as np
-    for model in ("resnet18_gn", "lr"):
+    for model in dict.fromkeys(k[2] for k in params):
         group = {k: p for k, p in params.items() if k[2] == model}
         ref = group[(8, 1, model)]
         for key, p in group.items():
