@@ -305,8 +305,16 @@ def load_data(dataset: str,
             synth = False
         except FileNotFoundError:
             synth, te_map = True, None
-            x, y = synthetic.synthetic_sequences(sc(20000), seq_len, vocab_len,
-                                                 seed=seed)
+            # classed (rank-64) chain, NOT synthetic_sequences: a
+            # full-rank random [V, V] chain at vocab 10,004 is
+            # unlearnable by embedding models AND near-noise even for
+            # an oracle (measured oracle_top1 = 0.0102 — see
+            # synthetic_sequences_classed's docstring), which broke the
+            # "learnable stand-in" contract this module documents.
+            # Also ~150x lighter to generate (64 rows vs a [V, V]
+            # matrix).
+            x, y, _ = synthetic.synthetic_sequences_classed(
+                sc(20000), seq_len, vocab_len, seed=seed)
             n_te = sc(20000) // 8
             x_tr, y_tr, xt, yt = x[n_te:], y[n_te:], x[:n_te], y[:n_te]
             idx_map = partition_homo(len(y_tr), C, seed)

@@ -377,11 +377,10 @@ def test_smoke_shakespeare_rnn():
 def test_smoke_stackoverflow_nwp_streaming():
     # same sequence shapes + shard-building path as the loader's
     # synthetic branch (loaders.py stackoverflow_nwp), but at a 1004-word
-    # vocab: the full 10,004² Markov transition build plus the
-    # vocab-wide softmax compile cost ~2 min of CPU (measured) and the
-    # vocab SIZE is data scale, not wiring — the wiring under test
-    # (rnn_stackoverflow + has_time_axis + eval_ignore_id=0 + streaming
-    # MeshFedAvgEngine) is identical
+    # vocab: the vocab-wide softmax compile costs minutes of CPU at
+    # 10,004 and the vocab SIZE is data scale, not wiring — the wiring
+    # under test (rnn_stackoverflow + has_time_axis + eval_ignore_id=0
+    # + streaming MeshFedAvgEngine) is identical
     from fedml_tpu.core.partition import partition_homo
     from fedml_tpu.data.loaders import _make
     from fedml_tpu.data.synthetic import synthetic_sequences
