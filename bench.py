@@ -113,6 +113,10 @@ def main() -> None:
             "value": 0.0,
             "unit": "rounds/sec",
             "vs_baseline": 0.0,
+            # null, not a number: nothing ran, so neither the 1.0
+            # no-uploads convention nor the 0.0 transfer-bound reading
+            # applies — consumers must not fold this row into trends
+            "overlap_fraction": None,
             "error": "chip_unavailable",
             "detail": detail,
         }))
@@ -190,6 +194,13 @@ def main() -> None:
         variables, server_state, rng, m = one_round(
             variables, server_state, rng)
     force_completion(variables, m)
+    # overlap accounting covers the TIMED window only (the one-time
+    # cohort upload above is setup): on this resident-cohort bench the
+    # timed rounds do no uploads, so overlap_fraction is 1.0 by
+    # definition — the field exists so streaming/block-stream bench
+    # variants land in the same BENCH_*.json schema (PERF.md §"Prefetch
+    # pipeline")
+    engine.transfer_stats.reset()
 
     import contextlib
     from fedml_tpu.utils.profiling import trace
@@ -211,6 +222,8 @@ def main() -> None:
         "value": round(rps, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rps / ESTIMATED_REFERENCE_ROUNDS_PER_SEC, 4),
+        "overlap_fraction": round(
+            engine.transfer_stats.overlap_fraction(), 4),
     }))
 
 

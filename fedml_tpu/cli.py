@@ -132,6 +132,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "so the cohort axis is bounded by host RAM, not "
                         "HBM; the cohort's bytes cross host->device "
                         "every round (SCALING.md).  Implies --streaming")
+    p.add_argument("--no_prefetch", action="store_true",
+                   help="disable the background host->device prefetch "
+                        "pipeline on the streaming/block-stream mesh "
+                        "paths (strictly synchronous gather->upload->"
+                        "compute — the escape hatch for bitwise "
+                        "comparison against the pipelined rounds; "
+                        "PERF.md 'Prefetch pipeline')")
     p.add_argument("--no_flat_stack", action="store_true",
                    help="disable flat image-cohort storage (mesh "
                         "engines store image inputs [C,B,bs,h*w*c] and "
@@ -374,7 +381,8 @@ def build_engine(args, cfg: FedConfig, data):
                        local_dtype=_local_dtype(args),
                        stack_dtype=_stack_dtype(args),
                        flat_stack=not args.no_flat_stack,
-                       stream_block=args.stream_block, **kw)
+                       stream_block=args.stream_block,
+                       prefetch=not args.no_prefetch, **kw)
         if algo == "centralized":
             from fedml_tpu.algorithms.centralized import CentralizedTrainer
             if mesh is not None and (args.streaming or args.cohort_chunk
@@ -472,7 +480,8 @@ def build_engine(args, cfg: FedConfig, data):
         if mesh is not None:
             return make_mesh_fedseg_engine(
                 trainer, data, cfg, mesh=mesh, streaming=args.streaming,
-                chunk=args.cohort_chunk, local_dtype=_local_dtype(args))
+                chunk=args.cohort_chunk, local_dtype=_local_dtype(args),
+                prefetch=not args.no_prefetch)
         return FedSegEngine(trainer, data, cfg)
 
     if algo == "fedgan":

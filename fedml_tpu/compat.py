@@ -1,0 +1,61 @@
+"""jax version compatibility shims.
+
+The framework targets current jax, where `shard_map` is a top-level API
+(`jax.shard_map`) and replicated→varying casts ride the vma type system
+(`jax.lax.pcast(..., to="varying")`).  The container's baked toolchain
+can lag (jax 0.4.37 ships `shard_map` under `jax.experimental.shard_map`
+and predates vma types entirely), which would fail every mesh-engine
+path at attribute lookup.  `install()` patches the gap once, at package
+import (fedml_tpu/__init__.py); it is a strict no-op on jax versions
+that already expose the real APIs.
+
+Shim semantics on old jax:
+
+* `jax.shard_map` → `jax.experimental.shard_map.shard_map` with
+  `check_rep=False`: the old replication tracker predates pvary/pcast
+  and rejects the engines' scan carries (a replicated zero carry updated
+  with shard-varying values), exactly the pattern the vma type system
+  was built to express.  The engines' outputs marked `P()` really are
+  replicated — every reduction is a psum over the full mesh — so
+  disabling the tracker changes nothing but the type check.
+* `jax.lax.pcast` → identity: with no vma types there is nothing to
+  cast; `pvary_tree` becomes a no-op, which is the correct degenerate.
+* `jax.lax.axis_size` → `psum(1, axis)`: on a non-traced literal psum
+  specializes statically, so the result is a concrete Python int usable
+  in trace-time branches (the batch-axis rng fold-in guard in
+  core/trainer.py and gossip's shard count) — verified under shard_map
+  on this jaxlib.
+
+Design note: this mutates the global jax namespace, which co-resident
+code could observe via hasattr feature-detection.  Accepted tradeoff:
+fedml_tpu owns the process at every entry point in this repo (cli,
+bench, tools, tests), the patch only ADDS attributes that the target
+jax version defines anyway, and the alternative — a wrapper module
+imported at every one of the ~20 call sites across 8 modules — keeps
+the same degraded semantics while diverging the source from the
+current-jax spelling it targets.  The shims disappear (install() is a
+no-op) the moment the toolchain jax catches up.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    """Idempotently patch missing jax APIs (see module docstring)."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+        def shard_map(f, mesh, in_specs, out_specs, **kw):
+            kw.setdefault("check_rep", False)
+            return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, **kw)
+
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "pcast"):
+        jax.lax.pcast = lambda x, axis_names, to="varying": x
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+
+install()

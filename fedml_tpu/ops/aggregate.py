@@ -48,11 +48,21 @@ def _interpret_default() -> bool:
 # ---------------------------------------------------------------------------
 
 def flatten_stacked_tree(stacked: Pytree):
-    """[C, ...] leaves → float32 [C, N] (N padded to TILE) + unflatten spec."""
+    """[C, ...] leaves → float32 [C, N] (N padded to TILE) + unflatten spec.
+
+    Donation-safe: builds one fresh [C, N] buffer and never aliases the
+    input leaves into the returned spec, so callers may donate `stacked`
+    at their jit boundary (the mesh engines' block steps donate their
+    whole block inputs — parallel/engine.py); a single-leaf tree skips
+    the concatenate (reshape only), letting XLA alias a donated f32
+    input straight into the flat buffer."""
     leaves, treedef = jax.tree.flatten(stacked)
     C = leaves[0].shape[0]
-    flat = jnp.concatenate(
-        [l.reshape(C, -1).astype(jnp.float32) for l in leaves], axis=1)
+    if len(leaves) == 1:
+        flat = leaves[0].reshape(C, -1).astype(jnp.float32)
+    else:
+        flat = jnp.concatenate(
+            [l.reshape(C, -1).astype(jnp.float32) for l in leaves], axis=1)
     n = flat.shape[1]
     pad = (-n) % TILE
     if pad:

@@ -34,7 +34,10 @@ from fedml_tpu.parallel.mesh import (BATCH_AXIS, client_axes,
                                      client_sharding, make_mesh, pvary_tree,
                                      replicated_sharding, shard_stack,
                                      stack_leaf_sharding, stack_leaf_spec)
+from fedml_tpu.parallel.prefetch import (AsyncValue, InlineFetcher,
+                                         Prefetcher)
 from fedml_tpu.utils.config import FedConfig
+from fedml_tpu.utils.profiling import TransferOverlapStats
 
 log = logging.getLogger(__name__)
 Pytree = Any
@@ -248,8 +251,22 @@ class MeshFedAvgEngine(FedAvgEngine):
                  streaming: bool = False, local_dtype=None,
                  stack_dtype=None, flat_stack: bool = True,
                  stream_block: Optional[int] = None,
-                 allow_batch_stats: bool = False):
+                 allow_batch_stats: bool = False,
+                 prefetch: bool = True):
         self.allow_batch_stats = allow_batch_stats
+        # prefetch: background-thread host→device upload pipeline on the
+        # streaming/block-stream paths (parallel/prefetch.py): the host
+        # gather+cast+device_put of block/cohort k+1 runs while the
+        # device trains on k — double-buffered, so device data memory
+        # keeps the synchronous path's O(2·block) bound.  False is the
+        # --no_prefetch escape hatch: strictly synchronous
+        # upload→compute, bitwise-identical results (same jitted
+        # programs, same inputs — pinned by tests/test_prefetch.py).
+        self.prefetch = prefetch
+        # upload/compute overlap accounting, always on (two perf_counter
+        # calls per event); bench.py and tools/profile_bench.py surface
+        # overlap_fraction from here (PERF.md §"Prefetch pipeline")
+        self.transfer_stats = TransferOverlapStats()
         # flat_stack stores image cohorts as [C, B, bs, h*w*c] on device
         # and restores [h, w, c] per chunk INSIDE the scan: XLA assigns
         # the big input a tiled layout padded on small minor dims —
@@ -327,10 +344,14 @@ class MeshFedAvgEngine(FedAvgEngine):
                     f"positive multiple of the mesh's client-shard count "
                     f"({self.n_shards})")
             # block accumulation step + round finalize: two small jitted
-            # programs the host loop drives per round (the accumulators
-            # are donated — no copies as blocks stream through)
+            # programs the host loop drives per round.  The accumulators
+            # (argnum 1) are donated so the sums carry through without
+            # copies; the block inputs (2-4) are donated too — each is
+            # consumed exactly once, and without donation a retired
+            # block would stay resident in HBM next to the prefetched
+            # one, breaking the O(2·block) device-data bound
             self._block_step = jax.jit(self._block_step_impl,
-                                       donate_argnums=(1,))
+                                       donate_argnums=(1, 2, 3, 4))
             # sums (argnum 2) is engine-internal and dead after finalize
             # — always donated; variables/server_state follow the
             # user-visible donate flag
@@ -509,12 +530,21 @@ class MeshFedAvgEngine(FedAvgEngine):
         sampling as the resident path, but slicing the HOST arrays and
         uploading only the cohort (chunk-multiple padding happens inside
         chunked_weighted_train)."""
-        ids, wmask = self._sample_padded_np(round_idx)
-        cohort = self._host_gather_upload(ids)
-        weights = jax.device_put(
-            np.take(np.asarray(self.data.client_num_samples,
-                               np.float32), ids) * wmask,
-            client_sharding(self.mesh))
+        return self._stream_gather(*self._sample_padded_np(round_idx))
+
+    def _stream_gather(self, ids, wmask):
+        """The upload half of stream_cohort, split from the sampling:
+        this part is what runs on the prefetch thread (_round_args) —
+        the SAMPLER must stay on the caller thread because it reseeds
+        the process-global numpy RNG (core/sampling.py), which a
+        background thread would race.  The wall lands in transfer_stats
+        from whichever thread runs it."""
+        with self.transfer_stats.uploading():
+            cohort = self._host_gather_upload(ids)
+            weights = jax.device_put(
+                np.take(np.asarray(self.data.client_num_samples,
+                                   np.float32), ids) * wmask,
+                client_sharding(self.mesh))
         return cohort, weights
 
     # -- block-streamed round (stream_block) ---------------------------------
@@ -538,51 +568,81 @@ class MeshFedAvgEngine(FedAvgEngine):
 
     def _upload_block(self, ids_blk, w_blk, rngs_blk):
         """Host-gather + async device_put of one client block (the
-        double-buffer unit), via the shared _host_gather_upload pipeline."""
-        block = self._host_gather_upload(ids_blk)
-        weights = jax.device_put(w_blk, client_sharding(self.mesh))
-        rngs = jax.device_put(rngs_blk, client_sharding(self.mesh))
+        double-buffer unit), via the shared _host_gather_upload pipeline.
+        Runs on the prefetch thread when the pipeline is on; the wall
+        lands in transfer_stats either way."""
+        with self.transfer_stats.uploading():
+            block = self._host_gather_upload(ids_blk)
+            weights = jax.device_put(w_blk, client_sharding(self.mesh))
+            rngs = jax.device_put(rngs_blk, client_sharding(self.mesh))
         return block, weights, rngs
 
-    def _round_blockstream(self, variables, server_state, round_idx, rng):
-        """Block-streamed round: host loop uploads `stream_block`-client
-        blocks (next block's device_put overlaps the current block's
-        compute — jax dispatch is async) and the jitted block step
-        accumulates Σ w·v / Σ w / Σ w·loss on device; one finalize
-        divides and applies the server update.  Aggregation is linear,
-        so the result equals the whole-cohort streaming round up to
-        float summation order (oracle-pinned in tests/test_parallel.py);
-        the per-client rngs are the SAME (jax.random.split prefixes are
-        stable, and zero-weight pad lanes contribute exactly 0).
-
-        Device data memory is O(2 · stream_block · shard bytes) — the
-        cohort axis is unbounded by HBM.  The cost: the cohort's bytes
-        cross host→device EVERY round (the resident/streaming paths
-        upload once), so this path pays off when the cohort does not fit
-        HBM at all, and its round time is bounded below by upload
-        bandwidth."""
-        ids, wmask = self._sample_padded_np(round_idx)
+    def _pad_to_block(self, ids, wmask):
+        """Pad the shard-padded cohort to a stream_block multiple with
+        zero-weight repeated-id lanes, and return the per-round block
+        spans [(start, stop), ...]."""
         B = self.stream_block
         pad = (-len(ids)) % B
         if pad:       # pad to a block multiple with zero-weight lanes
             ids = np.concatenate([ids, np.repeat(ids[:1], pad)])
             wmask = np.concatenate([wmask, np.zeros(pad, np.float32)])
-        K = len(ids)
+        spans = [(s, s + B) for s in range(0, len(ids), B)]
+        return ids, wmask, spans
+
+    def _block_fetcher(self, ids, w_all, crngs, spans):
+        """Block iterator for the streamed rounds: the background
+        double-buffered upload pipeline (prefetch.py), or the strictly
+        synchronous inline path under prefetch=False (--no_prefetch).
+        Both deliver blocks in span order via get(); use as a context
+        manager so an aborted round joins the worker and drops
+        undelivered buffers."""
+        def produce(span):
+            s, e = span
+            return self._upload_block(ids[s:e], w_all[s:e], crngs[s:e])
+
+        cls = Prefetcher if self.prefetch else InlineFetcher
+        return cls(produce, spans, stats=self.transfer_stats)
+
+    def _round_blockstream(self, variables, server_state, round_idx, rng):
+        """Block-streamed round: `stream_block`-client blocks cross
+        host→device while the jitted block step accumulates
+        Σ w·v / Σ w / Σ w·loss on device; one finalize divides and
+        applies the server update.  Uploads are double-buffered on a
+        background thread (_block_fetcher): the host gather + cast +
+        device_put of block k+1 runs while the device trains on block k,
+        so round wall approaches max(upload, compute) instead of their
+        sum — transfer_stats records the per-round upload/compute walls
+        and overlap_fraction.  Aggregation is linear, so the result
+        equals the whole-cohort streaming round up to float summation
+        order (oracle-pinned in tests/test_parallel.py) and is BITWISE
+        prefetch-knob-independent (tests/test_prefetch.py); the
+        per-client rngs are the SAME (jax.random.split prefixes are
+        stable, and zero-weight pad lanes contribute exactly 0).
+
+        Device data memory is O(2 · stream_block · shard bytes) — the
+        cohort axis is unbounded by HBM (block inputs are donated to the
+        block step, so retired blocks never stack).  The cost: the
+        cohort's bytes cross host→device EVERY round (the resident/
+        streaming paths upload once), so this path pays off when the
+        cohort does not fit HBM at all, and its round time is bounded
+        below by upload bandwidth."""
+        ids, wmask = self._sample_padded_np(round_idx)
+        ids, wmask, spans = self._pad_to_block(ids, wmask)
         w_all = (np.take(np.asarray(self.data.client_num_samples,
                                     np.float32), ids) * wmask)
         rng, agg_rng = jax.random.split(rng)
-        crngs = np.asarray(jax.random.split(rng, K))
-        sums = jax.device_put(self._zero_sums(variables),
-                              replicated_sharding(self.mesh))
-        nxt = self._upload_block(ids[:B], w_all[:B], crngs[:B])
-        for start in range(0, K, B):
-            cur = nxt
-            if start + B < K:
-                s2 = start + B
-                nxt = self._upload_block(ids[s2:s2 + B], w_all[s2:s2 + B],
-                                         crngs[s2:s2 + B])
-            sums = self._block_step(variables, sums, *cur)
-        return self._block_finalize(variables, server_state, sums, agg_rng)
+        crngs = np.asarray(jax.random.split(rng, len(ids)))
+        self.transfer_stats.round_start()
+        try:
+            sums = jax.device_put(self._zero_sums(variables),
+                                  replicated_sharding(self.mesh))
+            with self._block_fetcher(ids, w_all, crngs, spans) as fetch:
+                for _ in spans:
+                    sums = self._block_step(variables, sums, *fetch.get())
+            return self._block_finalize(variables, server_state, sums,
+                                        agg_rng)
+        finally:
+            self.transfer_stats.round_end()
 
     # NOTE: a fully on-device multi-round path (`run_scanned`: whole blocks
     # of rounds as one lax.scan program, in-program fold-in sampling) was
@@ -633,18 +693,67 @@ class MeshFedAvgEngine(FedAvgEngine):
             # block-streamed rounds gather their own blocks on the fly
             return (round_idx,)
         if self.streaming:
-            # double-buffered uploads: jax.device_put is asynchronous, so
-            # kicking off round r+1's transfer now overlaps it with round
-            # r's compute (two cohorts live on device, bounded).  The base
-            # run() exposes its round budget via _rounds_limit — no gather
+            # double-buffered round uploads: round r+1's host gather +
+            # cast + device_put (_stream_gather) runs on a background
+            # thread (AsyncValue) while round r computes — the HOST side
+            # of the upload no longer serializes with the round loop.
+            # SAMPLING stays on THIS thread either way: the sampler
+            # reseeds the process-global numpy RNG, which a background
+            # thread would race (and the knob must not change cohorts).
+            # With prefetch=False the gather runs inline here, the old
+            # synchronous path, recorded as consumer wait (unhidden).
+            # Two cohorts live on device, bounded.  The base run()
+            # exposes its round budget via _rounds_limit — no gather
             # past the final round, and the last buffer is released.
+            # No per-round stats windows here (the round body runs in
+            # the caller's loop, out of this hook's sight; a window
+            # opened here would span into the NEXT round) — the
+            # streaming path reports cumulative walls only; per-round
+            # records are a block-stream feature.
             pre = getattr(self, "_prefetched", None)
-            args = (pre[1] if pre is not None and pre[0] == round_idx
-                    else self.stream_cohort(round_idx))
+            if pre is not None and pre[0] != round_idx:
+                # stale prefetch (an aborted run retried, or rounds
+                # replayed out of order): JOIN the in-flight upload
+                # before gathering anew — letting it run unobserved
+                # would put a third cohort on device (the documented
+                # bound is two).  Its error is logged and dropped
+                # (superseded — a fresh gather follows); Exception
+                # only, so a Ctrl-C during the join still aborts.
+                if isinstance(pre[1], AsyncValue):
+                    try:
+                        pre[1].result()
+                    except Exception:
+                        log.warning("discarding failed stale prefetch "
+                                    "for round %d", pre[0], exc_info=True)
+                pre = None
+                self._prefetched = None
+            if pre is not None:
+                if isinstance(pre[1], AsyncValue):
+                    try:
+                        args = pre[1].result()
+                    except BaseException:
+                        # never cache a failed gather: a resumed run
+                        # hitting this round again must re-gather
+                        # fresh, not re-raise the stale exception
+                        self._prefetched = None
+                        raise
+                else:
+                    args = pre[1]
+            else:
+                with self.transfer_stats.waiting():   # unhidden gather
+                    args = self.stream_cohort(round_idx)
             limit = getattr(self, "_rounds_limit", None)
             if limit is None or round_idx + 1 < limit:
-                self._prefetched = (round_idx + 1,
-                                    self.stream_cohort(round_idx + 1))
+                nxt = round_idx + 1
+                if self.prefetch:
+                    nxt_ids, nxt_wmask = self._sample_padded_np(nxt)
+                    self._prefetched = (
+                        nxt, AsyncValue(self._stream_gather, nxt_ids,
+                                        nxt_wmask,
+                                        stats=self.transfer_stats))
+                else:
+                    with self.transfer_stats.waiting():
+                        self._prefetched = (nxt, self.stream_cohort(nxt))
             else:
                 self._prefetched = None
             return args
@@ -838,8 +947,11 @@ class MeshRobustEngine(MeshFedAvgEngine):
                 # trains client blocks and lands each block's flattened
                 # params on HOST; phase 2 re-streams the [K, P] matrix
                 # PARAMETER-major through the mesh for exact order stats
+                # accumulators AND block inputs donated, same rationale
+                # as the linear _block_step (O(2·block) device bound)
                 self._block_step_flats = jax.jit(
-                    self._block_step_flats_impl, donate_argnums=(1,))
+                    self._block_step_flats_impl,
+                    donate_argnums=(1, 2, 3, 4))
                 self._colstat = jax.jit(self._colstat_impl)
                 self._gram = jax.jit(self._gram_impl)
                 self._orderstat_finalize = jax.jit(
@@ -1042,31 +1154,41 @@ class MeshRobustEngine(MeshFedAvgEngine):
                                               round_idx, rng)
         ids, wmask = self._sample_padded_np(round_idx)
         assert wmask.all(), "order statistics cannot ignore padded lanes"
-        B, K = self.stream_block, len(ids)
+        K = len(ids)
         w_all = np.take(np.asarray(self.data.client_num_samples,
                                    np.float32), ids) * wmask
         rng, agg_rng = jax.random.split(rng)
         crngs = np.asarray(jax.random.split(rng, K))
+        self.transfer_stats.round_start()
+        try:
+            return self._blockstream_orderstat_body(
+                variables, server_state, ids, w_all, crngs, agg_rng)
+        finally:
+            self.transfer_stats.round_end()
+
+    def _blockstream_orderstat_body(self, variables, server_state, ids,
+                                    w_all, crngs, agg_rng):
+        B, K = self.stream_block, len(ids)
         sums = jax.device_put(self._zero_rest_sums(variables),
                               replicated_sharding(self.mesh))
-        # phase 1: client-major blocks; double-buffered uploads, each
-        # block's flats pulled to the host matrix as compute proceeds
+        # phase 1: client-major blocks through the prefetch pipeline
+        # (double-buffered background uploads — the np.asarray pull of
+        # block k's flats overlaps block k+1's gather+upload), each
+        # block's flats landing in the host matrix as compute proceeds
         X = None
-        nxt = self._upload_block(ids[:B], w_all[:B], crngs[:B])
-        for start in range(0, K, B):
-            cur = nxt
-            if start + B < K:
-                s2 = start + B
-                nxt = self._upload_block(ids[s2:s2 + B], w_all[s2:s2 + B],
-                                         crngs[s2:s2 + B])
-            sums, flats = self._block_step_flats(variables, sums, *cur)
-            if X is None:
-                X = np.empty((K, flats.shape[1]), np.float32)
-            X[start:start + B] = np.asarray(flats)
-            # np.asarray forced completion; drop the device buffer NOW —
-            # holding it across the next block step would stack [B, P]
-            # generations and break the O(block) device bound
-            flats.delete()
+        spans = [(s, s + B) for s in range(0, K, B)]
+        with self._block_fetcher(ids, w_all, crngs, spans) as fetch:
+            for start, stop in spans:
+                sums, flats = self._block_step_flats(variables, sums,
+                                                     *fetch.get())
+                if X is None:
+                    X = np.empty((K, flats.shape[1]), np.float32)
+                X[start:stop] = np.asarray(flats)
+                # np.asarray forced completion; drop the device buffer
+                # NOW — holding it across the next block step would
+                # stack [B, P] generations and break the O(block)
+                # device bound
+                flats.delete()
         # phase 2: parameter-major slices, Pb sized to param_block_bytes
         # of device footprint and mesh-divisible.  Only the FINAL short
         # slice is zero-padded (into its own [K, pb] buffer at upload
@@ -1080,12 +1202,19 @@ class MeshRobustEngine(MeshFedAvgEngine):
         n_slices = -(-P_flat // pb)
 
         def slice_padded(s):
-            xb = X[:, s * pb:(s + 1) * pb]
-            if xb.shape[1] < pb:
-                buf = np.zeros((K, pb), np.float32)
-                buf[:, :xb.shape[1]] = xb
-                xb = buf
-            return jax.device_put(xb, self._param_sharding())
+            # phase-2 H2D is upload wall too (the [K, P] matrix crosses
+            # back slice by slice), and it runs INLINE on the round
+            # loop, so it is simultaneously consumer wait — recording
+            # both keeps overlap_fraction honest: this traversal is
+            # unhidden transfer, not compute (the OSB256 metric)
+            with self.transfer_stats.uploading(), \
+                    self.transfer_stats.waiting():
+                xb = X[:, s * pb:(s + 1) * pb]
+                if xb.shape[1] < pb:
+                    buf = np.zeros((K, pb), np.float32)
+                    buf[:, :xb.shape[1]] = xb
+                    xb = buf
+                return jax.device_put(xb, self._param_sharding())
 
         if self.defense in ("krum", "multi_krum"):
             G = np.zeros((K, K), np.float32)

@@ -19,6 +19,17 @@ mesh.make_mesh_2d.
 IMPORTANT: init_multihost() must run before ANY jax call that initializes
 the XLA backend (so: first thing in main) — jax.distributed.initialize
 refuses to run afterwards.
+
+Streaming/prefetch note (parallel/prefetch.py): the streaming and
+block-stream paths' background upload thread is PER PROCESS, and every
+process runs the same round loop, so the prefetchers issue their
+`jax.device_put(..., NamedSharding)` calls in the same order on every
+host — each process materializes only its addressable shards, and the
+upload/compute overlap composes across hosts (each host hides its own
+gather+DMA behind its chips' compute).  The block-streamed
+order-statistic defenses remain single-process (enforced at engine
+construction): their host [K, P] offload needs every client shard
+addressable.
 """
 from __future__ import annotations
 

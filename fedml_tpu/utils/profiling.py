@@ -10,9 +10,10 @@ without any profiler overhead.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
-from typing import Iterator
+from typing import Iterator, Optional
 
 import jax
 
@@ -68,3 +69,108 @@ class StepTimer:
 
     def report(self) -> dict[str, float]:
         return {f"{k}_mean_s": self.mean(k) for k in self.totals}
+
+
+def _overlap_fraction(upload_wall: float, wait_wall: float) -> float:
+    """Fraction of the upload wall that hid behind compute.  1.0 when
+    there were no uploads (nothing left unhidden — bench's resident
+    cohort path reports this by definition)."""
+    if upload_wall <= 0.0:
+        return 1.0
+    return max(0.0, min(1.0, (upload_wall - wait_wall) / upload_wall))
+
+
+class TransferOverlapStats:
+    """Host→device transfer vs compute overlap accounting for the
+    streaming/block-stream engine paths (the PR-1 prefetch pipeline).
+
+    Producers — whichever thread runs the host gather + cast +
+    `jax.device_put` — time each upload with `uploading()`; the round
+    loop times its blocking prefetch waits with `waiting()` and
+    brackets each round with `round_start()`/`round_end()`.  Per round
+    (and cumulatively since `reset()`):
+
+        upload_wall_s     Σ wall of upload calls, any thread
+        wait_wall_s       wall the round loop spent blocked on uploads
+        round_wall_s      wall of the whole round
+        compute_wall_s    round_wall_s − wait_wall_s (dispatch + device)
+        overlap_fraction  (upload_wall − wait_wall)/upload_wall ∈ [0, 1]
+
+    With perfect overlap the loop never waits for a transfer
+    (overlap 1.0); a fully transfer-bound round waits out almost every
+    upload (overlap ≈ compute/upload).  Uploads are attributed to the
+    round window they occur in by wall time (a next-round prefetch that
+    starts during round r lands in r's window); the cumulative numbers
+    are window-free.  Thread-safe; overhead is two perf_counter calls
+    per event, so it stays on for every streaming round
+    (PERF.md §"Prefetch pipeline" has the measurement recipe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._upload_wall = 0.0
+            self._wait_wall = 0.0
+            self._round_t0: Optional[float] = None
+            self._snap = (0.0, 0.0)
+            self.rounds: list[dict] = []
+
+    @contextlib.contextmanager
+    def uploading(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._upload_wall += time.perf_counter() - t0
+
+    @contextlib.contextmanager
+    def waiting(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._wait_wall += time.perf_counter() - t0
+
+    def round_start(self) -> None:
+        """Open a round window (auto-closes a window left open).  The
+        block-stream rounds bracket themselves with round_start/
+        round_end (try/finally); the per-round streaming path records
+        cumulative walls only — its round body runs in the base run()
+        loop, outside the engine hooks' sight."""
+        if self._round_t0 is not None:
+            self.round_end()
+        with self._lock:
+            self._snap = (self._upload_wall, self._wait_wall)
+        self._round_t0 = time.perf_counter()
+
+    def round_end(self) -> Optional[dict]:
+        """Close the open round window and record it; no-op when none
+        is open."""
+        if self._round_t0 is None:
+            return None
+        wall = time.perf_counter() - self._round_t0
+        self._round_t0 = None
+        with self._lock:
+            up = self._upload_wall - self._snap[0]
+            wait = self._wait_wall - self._snap[1]
+        rec = {"round_wall_s": wall, "upload_wall_s": up,
+               "wait_wall_s": wait,
+               "compute_wall_s": max(wall - wait, 0.0),
+               "overlap_fraction": _overlap_fraction(up, wait)}
+        self.rounds.append(rec)
+        return rec
+
+    def overlap_fraction(self) -> float:
+        with self._lock:
+            return _overlap_fraction(self._upload_wall, self._wait_wall)
+
+    def report(self) -> dict:
+        with self._lock:
+            up, wait = self._upload_wall, self._wait_wall
+        return {"upload_wall_s": up, "wait_wall_s": wait,
+                "overlap_fraction": _overlap_fraction(up, wait),
+                "rounds": len(self.rounds)}

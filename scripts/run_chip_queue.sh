@@ -26,7 +26,7 @@ echo "== 1/4 bench.py"
 timeout 1500 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.json"
 
 echo "== 2/4 nwp_convergence (600 rounds, vocab 10004 — must match the"
-echo "   600-round band pinned in test_quality_regression.py"
+echo "   600-round band pinned in test_quality_regression.py)"
 timeout 3600 python tools/nwp_convergence.py 600 \
     --out benchmarks/nwp_convergence_r5.json 2>"$OUT/nwp.err" \
     | tee "$OUT/nwp.log"

@@ -355,6 +355,9 @@ def test_smoke_femnist_cnn():
     _smoke_metrics_ok(_wire_femnist_cnn(data, cfg))
 
 
+@pytest.mark.slow   # the heaviest acceptance smoke (~47 s XLA:CPU):
+#                     slow-marked so tier-1 (-m 'not slow') fits its
+#                     870 s budget; the 10-class ResNet smokes stay
 def test_smoke_fed_cifar100_resnet18gn():
     data = _tiny_image_data(n_clients=4, bs=8, classes=100)
     assert data.synthetic
@@ -397,6 +400,7 @@ def test_smoke_stackoverflow_nwp_streaming():
     _smoke_metrics_ok(_wire_stackoverflow_nwp(data, cfg))
 
 
+@pytest.mark.slow   # ~32 s resnet56 smoke (tier-1 budget); the resnet18_gn + cross-silo rows keep conv coverage
 def test_smoke_cifar10_resnet56():
     data = _tiny_image_data(n_clients=4, bs=8, classes=10,
                             partition="hetero", alpha=0.5)

@@ -9,6 +9,12 @@ dual-tree aggregation) at test-friendly compile cost.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+# the 2nd-order/GDAS/mesh searches are the suite's heaviest XLA:CPU
+# programs (30-170 s each): marked slow so the serial tier-1 selection
+# (-m 'not slow') fits its 870 s budget; `pytest tests/test_fednas.py`
+# runs them all
 
 from fedml_tpu.algorithms.fednas import FedNASSearchEngine, make_train_engine
 from fedml_tpu.data.federated import (FederatedData, build_client_shards,
@@ -72,6 +78,7 @@ def test_genotype_derivation():
     assert list(g.normal_concat) == [2, 3, 4, 5]
 
 
+@pytest.mark.slow
 def test_unrolled_arch_grad():
     """The exact 2nd-order architect: grad through the unrolled w-step."""
     data = tiny_data()
@@ -126,6 +133,7 @@ def test_fixed_network_from_published_genotype():
     assert jnp.all(jnp.isfinite(logits))
 
 
+@pytest.mark.slow
 def test_gdas_single_path_search():
     """GDAS mode (model_search_gdas.py): straight-through gumbel samples
     one op per edge; search still moves both trees and eval works."""
@@ -146,6 +154,7 @@ def test_gdas_single_path_search():
                            np.asarray(a0["reduce"]))
 
 
+@pytest.mark.slow
 def test_mesh_fednas_matches_single_device():
     """Mesh FedNAS search (sharded bilevel searches, psum'd w+alpha
     averages) == the vmap engine."""

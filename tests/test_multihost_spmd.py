@@ -26,7 +26,20 @@ import subprocess
 import sys
 import threading
 
+import jax
 import pytest
+
+# The gloo-backed CPU cross-process collectives these tests run over
+# landed after jaxlib 0.4: on the 0.4.x CI image every cross-process
+# device_put dies in the runtime with "Multiprocess computations aren't
+# implemented on the CPU backend" — a backend capability gap, not a
+# framework bug (the same programs run the single-process 8-device
+# oracle in multihost_case.py).  Skip, like the chip-gated tests.
+pytestmark = pytest.mark.skipif(
+    jax.__version_info__ < (0, 5),
+    reason="jaxlib < 0.5: multiprocess computations not implemented on "
+           "the CPU backend (cross-process gloo collectives landed "
+           "later)")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")

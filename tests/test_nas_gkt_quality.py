@@ -11,6 +11,7 @@ possible on the 1-core CPU test platform.
 """
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from fedml_tpu.algorithms.fednas import FedNASSearchEngine
@@ -80,6 +81,7 @@ def _random_genotype(rs, steps):
                     reduce=gene(), reduce_concat=cc)
 
 
+@pytest.mark.slow   # ~40 s NAS search+retrain on XLA:CPU (tier-1 budget)
 def test_derived_genotype_beats_random():
     data = separable_data()
     cfg = FedConfig(client_num_in_total=2, client_num_per_round=2,

@@ -1,0 +1,212 @@
+"""Prefetch-pipeline tests (the PR-1 tentpole, parallel/prefetch.py).
+
+Two invariants:
+
+* Knob-independence: the pipelined (background double-buffered upload)
+  rounds must produce BITWISE the same aggregated variables as the
+  --no_prefetch synchronous path — same jitted programs, same inputs,
+  same per-client rngs — for the linear block stream, the two-phase
+  order-statistic block stream, and the per-round streaming path.
+* Clean teardown: a round that raises mid-stream must join the upload
+  worker and drop undelivered buffers — no leaked thread, no stale
+  uploaded block reaching the next round.
+
+Shapes mirror test_parallel_stream.py so the persistent compile cache
+is shared.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.parallel import MeshFedAvgEngine, MeshRobustEngine
+from fedml_tpu.parallel.mesh import make_mesh
+from fedml_tpu.parallel.prefetch import InlineFetcher, Prefetcher
+
+from parallel_case import _mnist_like_cfg, _setup
+
+
+def _assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("h2d-prefetch") and t.is_alive()]
+
+
+# -- Prefetcher unit behavior (no jax) --------------------------------------
+
+def test_prefetcher_order_and_depth_bound():
+    """Results arrive in order; the producer never runs more than one
+    item ahead of the consumer (depth=2 double buffer — the device-
+    memory bound the engine tests pin depends on exactly this)."""
+    produced = []          # (item, items consumed when production began)
+    consumed = [0]
+
+    def produce(i):
+        produced.append((i, consumed[0]))
+        return i * 10
+
+    with Prefetcher(produce, range(6)) as pf:
+        for i in range(6):
+            assert pf.get() == i * 10
+            consumed[0] += 1
+    assert [p[0] for p in produced] == list(range(6))
+    assert all(i - c <= 1 for i, c in produced), produced
+
+
+def test_prefetcher_producer_error_propagates_and_joins():
+    def produce(i):
+        if i == 2:
+            raise ValueError("boom-upload")
+        return i
+
+    pf = Prefetcher(produce, range(5))
+    assert pf.get() == 0
+    assert pf.get() == 1
+    with pytest.raises(ValueError, match="boom-upload"):
+        pf.get()
+    pf.close()
+    assert not _prefetch_threads()
+
+
+def test_prefetcher_close_mid_stream_joins_and_drops():
+    """Abandoning the iteration (the consumer raised) must join the
+    worker and stop producing — at most the in-flight item beyond what
+    was consumed."""
+    produced = []
+
+    def produce(i):
+        produced.append(i)
+        return i
+
+    pf = Prefetcher(produce, range(100))
+    assert pf.get() == 0
+    pf.close()
+    assert not _prefetch_threads()
+    assert len(produced) <= 3, produced
+
+
+def test_inline_fetcher_is_strictly_synchronous():
+    produced = []
+    f = InlineFetcher(lambda i: produced.append(i) or i, range(3))
+    assert produced == []            # nothing until asked
+    assert f.get() == 0 and produced == [0]
+    assert f.get() == 1 and produced == [0, 1]
+    f.close()
+
+
+# -- bitwise knob-independence on the CPU mesh ------------------------------
+
+def _run(engine_cls, cfg, trainer, data, v0, rounds, **kw):
+    eng = engine_cls(trainer, data, cfg, mesh=make_mesh(8), donate=False,
+                     **kw)
+    v = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=rounds)
+    return v, eng
+
+
+def test_blockstream_prefetch_bitwise_matches_no_prefetch():
+    """Linear block stream (FedAvg): pipelined == synchronous, bitwise,
+    with fixed rngs (acceptance criterion #3).  Also pins that the
+    overlap accounting actually recorded the rounds' uploads."""
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=2)
+    trainer, data = _setup(cfg)
+    ref = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, stream_block=8, prefetch=False)
+    v0 = ref.init_variables()
+    v_sync = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    v_pipe, pipe = _run(MeshFedAvgEngine, cfg, trainer, data, v0, 2,
+                        stream_block=8)
+    assert pipe.prefetch            # pipelined is the default
+    _assert_trees_bitwise(v_sync, v_pipe)
+    assert len(pipe.transfer_stats.rounds) == 2
+    rec = pipe.transfer_stats.rounds[-1]
+    assert rec["upload_wall_s"] > 0.0
+    assert 0.0 <= rec["overlap_fraction"] <= 1.0
+    assert not _prefetch_threads()  # per-round workers all joined
+
+
+def test_blockstream_orderstat_prefetch_bitwise_matches_no_prefetch():
+    """The two-phase order-statistic block stream (robust median) rides
+    the same pipeline in phase 1 — bitwise prefetch-knob-independent."""
+    cfg = _mnist_like_cfg(comm_round=2, norm_bound=0.5)
+    trainer, data = _setup(cfg)
+    kw = dict(defense="median", n_byzantine=1, stream_block=8,
+              param_block_bytes=16 * 64)
+    ref = MeshRobustEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, prefetch=False, **kw)
+    v0 = ref.init_variables()
+    v_sync = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    v_pipe, pipe = _run(MeshRobustEngine, cfg, trainer, data, v0, 2, **kw)
+    assert pipe.round_fn == pipe._round_blockstream_orderstat
+    _assert_trees_bitwise(v_sync, v_pipe)
+    assert len(pipe.transfer_stats.rounds) == 2
+
+
+def test_streaming_prefetch_bitwise_matches_no_prefetch():
+    """Per-round streaming (whole-cohort uploads): the background
+    next-round gather must not change sampling or results — bitwise."""
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=3)
+    trainer, data = _setup(cfg)
+    v_sync, _ = _run(MeshFedAvgEngine, cfg, trainer, data,
+                     MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                                      donate=False).init_variables(),
+                     3, streaming=True, prefetch=False)
+    # same v0 derivation: init_variables is deterministic in cfg.seed
+    v0 = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                          donate=False).init_variables()
+    v_pipe, pipe = _run(MeshFedAvgEngine, cfg, trainer, data, v0, 3,
+                        streaming=True)
+    _assert_trees_bitwise(v_sync, v_pipe)
+    assert pipe._prefetched is None     # last round released its buffer
+
+
+# -- clean teardown on mid-round failure ------------------------------------
+
+def test_blockstream_prefetcher_drains_on_midround_error():
+    """A block step that raises mid-stream must leave no worker thread
+    and no stale uploaded block: the engine's try/finally closes the
+    Prefetcher (joining the worker, dropping undelivered buffers), and
+    the NEXT round must be bitwise what a fresh synchronous engine
+    computes."""
+    cfg = _mnist_like_cfg(comm_round=2)
+    trainer, data = _setup(cfg)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, stream_block=8)
+    v = eng._prepare_variables(eng.init_variables())
+    ss = eng.server_init(v)
+    rng = jax.random.PRNGKey(7)
+
+    calls = {"n": 0}
+    orig = eng._block_step
+
+    def boom(*a):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("mid-stream failure")
+        return orig(*a)
+
+    eng._block_step = boom
+    with pytest.raises(RuntimeError, match="mid-stream failure"):
+        eng._round_blockstream(v, ss, 0, rng)
+    eng._block_step = orig
+    assert calls["n"] == 2              # it really died mid-stream
+    assert not _prefetch_threads()      # worker joined by the finally
+    # the aborted round still closed its stats window
+    assert len(eng.transfer_stats.rounds) == 1
+
+    # retry the SAME round: any stale buffer from the aborted prefetch
+    # would shift the block sequence and change the result
+    v1, s1, m1 = eng._round_blockstream(v, ss, 0, rng)
+    ref = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, stream_block=8, prefetch=False)
+    v2, s2, m2 = ref._round_blockstream(v, ss, 0, rng)
+    _assert_trees_bitwise(v1, v2)
+    np.testing.assert_array_equal(np.asarray(m1["train_loss"]),
+                                  np.asarray(m2["train_loss"]))

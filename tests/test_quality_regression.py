@@ -44,11 +44,17 @@ from fedml_tpu.utils.config import FedConfig
 
 # Calibration environment: jax/jaxlib 0.9.0, XLA:CPU, 2026-07-31.  The
 # bands are backend/version-sensitive by design (seeded + deterministic
-# per backend): if one trips right after a jax/XLA upgrade with no
-# training-code change, recalibrate the constant on the new build and
-# record the new version here.
+# per backend): if one trips right after a jax/XLA version change with
+# no training-code change, recalibrate the constant on the new build
+# and record the new version here.  Version-keyed where the builds
+# disagree: the CI image ships jax 0.4.37 (flax 0.10 initializer +
+# XLA:CPU fusion numerics differ), measured stable across repeat runs
+# on 2026-08-03.
 CAL_ACC_MNIST = 0.9100          # calibrated 2026-07-31, jax 0.9.0 XLA:CPU
-CAL_LOSS_FEMNIST_STEP = 4.4451  # calibrated 2026-07-31, jax 0.9.0 XLA:CPU
+CAL_LOSS_FEMNIST_STEP = (
+    4.4451                      # calibrated 2026-07-31, jax 0.9.0 XLA:CPU
+    if jax.__version_info__ >= (0, 9)
+    else 4.3375)                # calibrated 2026-08-03, jax 0.4.37 XLA:CPU
 
 
 def test_convergence_artifact_band():
@@ -120,8 +126,13 @@ def test_nwp_convergence_artifact_band():
     assert tfm["final_test_acc"] >= lstm["final_test_acc"] + 0.03
     # time-to-quality: first transformer round at >= the LSTM's FINAL
     # accuracy, in wall-clock, is under half the LSTM's total wall
-    cross = next(r["round"] for r in tfm["curve"]
-                 if r["test_acc"] >= lstm["final_test_acc"])
+    # default None: a regressed artifact whose transformer curve never
+    # reaches the LSTM's final accuracy must FAIL the assert, not ERROR
+    # with a bare StopIteration out of next()
+    cross = next((r["round"] for r in tfm["curve"]
+                  if r["test_acc"] >= lstm["final_test_acc"]), None)
+    assert cross is not None, \
+        "transformer curve never reached the LSTM's final accuracy"
     tfm_sec_per_round = tfm["wall_s"] / tfm["rounds"]
     assert cross * tfm_sec_per_round < 0.5 * lstm["wall_s"], \
         (cross, tfm_sec_per_round, lstm["wall_s"])

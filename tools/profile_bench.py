@@ -191,6 +191,14 @@ def exp_C2048H():
     _cohort_scale_round(2048, data_dtype=jnp.bfloat16)
 
 
+def _overlap_line(engine) -> str:
+    """One-line upload/compute overlap summary from the engine's
+    TransferOverlapStats (the PR-1 prefetch pipeline metric)."""
+    r = engine.transfer_stats.report()
+    return (f"upload {r['upload_wall_s']:.1f}s wait {r['wait_wall_s']:.1f}s "
+            f"overlap_fraction {r['overlap_fraction']:.2f}")
+
+
 def exp_C4096B():
     """4096 bench-shaped clients on ONE chip via block-streamed rounds
     (stream_block): the 10.5 GB bf16 cohort can never be device-resident
@@ -199,7 +207,9 @@ def exp_C4096B():
     on device.  One timed round — an existence proof of the unbounded
     cohort axis; through this image's ~7-35 MB/s tunnel the round is
     upload-bound (a real chip's DMA is orders faster), so the wall time
-    here measures the tunnel, not the engine (SCALING.md)."""
+    here measures the tunnel, not the engine (SCALING.md) — the printed
+    overlap_fraction says how much of that upload wall the prefetch
+    pipeline hid behind compute."""
     import jax
     from fedml_tpu.parallel import MeshFedAvgEngine
     from fedml_tpu.parallel.mesh import make_mesh
@@ -220,7 +230,42 @@ def exp_C4096B():
     gb = C * N_BATCHES * BS * 32 * 32 * 3 * 2 / 1e9   # padded slots cross
     print(f"C4096B block-stream({BLOCK}/block): one full round over "
           f"{C} clients ({gb:.1f} GB bf16 crossed H2D) in {dt:.1f}s  "
-          f"train_loss {loss:.4f}", flush=True)
+          f"{_overlap_line(engine)}  train_loss {loss:.4f}", flush=True)
+
+
+def exp_PF512():
+    """Prefetch pipeline A/B (the PR-1 tentpole acceptance): the SAME
+    512-client block-streamed round (block 64, bf16 stack, bench
+    recipe) with the background double-buffered uploader vs the
+    --no_prefetch synchronous path.  The pipelined round must be no
+    slower, and overlap_fraction reports how much of the upload wall
+    hid behind compute (PERF.md §"Prefetch pipeline" records the
+    measurement recipe)."""
+    import jax
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    C, BLOCK, ROUNDS = 512, 64, 2
+    for prefetch in (False, True):
+        cfg, data, trainer = _bench_workload(C)
+        engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(),
+                                  chunk=2, local_dtype=jnp.bfloat16,
+                                  stack_dtype=jnp.bfloat16,
+                                  stream_block=BLOCK, donate=False,
+                                  prefetch=prefetch)
+        variables = engine.init_variables()
+        server_state = engine.server_init(variables)
+        rng = jax.random.PRNGKey(0)
+        engine.round_fn(variables, server_state, 0, rng)   # compile
+        engine.transfer_stats.reset()
+        t0 = time.perf_counter()
+        for r in range(ROUNDS):
+            v, s, m = engine.round_fn(variables, server_state, r, rng)
+        loss = float(m["train_loss"])                      # sync barrier
+        dt = (time.perf_counter() - t0) / ROUNDS
+        tag = "prefetch" if prefetch else "no_prefetch"
+        print(f"PF512 {tag} block-stream({BLOCK}/block): {dt:.3f}s/round  "
+              f"{_overlap_line(engine)}  loss {loss:.4f}", flush=True)
 
 
 def _robust_workload(C: int):
@@ -266,10 +311,19 @@ def _orderstat_round(C: int, stream_block=None, defense="median"):
         v, s, m = engine.round_fn(variables, server_state, *args, rng)
         return m["train_loss"]
 
+    if stream_block is not None:
+        # compile outside the overlap window, then reset: a compile-
+        # round upload never waits, which would inflate the printed
+        # steady-state overlap_fraction.  Resident rounds record no
+        # uploads — skip the extra round there
+        round_once()
+        engine.transfer_stats.reset()
     dt = timeit(round_once, warmup=1, iters=3)
     mode = ("resident" if stream_block is None
             else f"blockstream({stream_block})")
-    print(f"OS {defense} C={C} {mode}: {dt:.3f}s/round", flush=True)
+    extra = ("" if stream_block is None
+             else f"  {_overlap_line(engine)}")
+    print(f"OS {defense} C={C} {mode}: {dt:.3f}s/round{extra}", flush=True)
     return dt
 
 

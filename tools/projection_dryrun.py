@@ -134,8 +134,10 @@ def main() -> None:
     # LR model — the "lr" group below, the executed >=64-device
     # clients x batch data point VERDICT r4 weak-#3 asked for).
     # The "cnn" pair upgrades that data point from the linear LR model
-    # to a REAL conv stack (the FedAvg CNN, ~0.4M params at the tiny
-    # shapes): (64, 2) executes the per-step batch-axis grad psum with
+    # to a REAL conv stack (the FedAvg CNN at the dryrun's 16x16x3/10
+    # shapes: 583,626 params — the length of the flat params the child
+    # saves, and PERF.md/SCALING.md's "0.58M-param conv stack"):
+    # (64, 2) executes the per-step batch-axis grad psum with
     # conv gradients, bracketing the SIGSEGV boundary to buffer size
     # (LR ok, CNN ok, 11M-param ResNet crashes the host runtime).
     cases = [(8, 1, "resnet18_gn"), (64, 1, "resnet18_gn"),
