@@ -32,6 +32,34 @@ import numpy as np
 
 ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 
+# bump when the JSON line's keys change meaning; BENCH_*.json trajectory
+# consumers key on this instead of guessing from key presence.
+# v2: + schema_version, git_sha, rounds (per-round transfer records),
+#     obs (observability rollup, present only under FEDML_OBS_DIR)
+SCHEMA_VERSION = 2
+
+
+def _git_sha() -> str:
+    """Short sha of the bench's code state, best-effort ("unknown" when
+    git is absent) — BENCH_*.json rows stay attributable across PRs."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        if r.returncode == 0:
+            return r.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
+
+
+def _stamp(doc: dict) -> dict:
+    doc["schema_version"] = SCHEMA_VERSION
+    doc["git_sha"] = _git_sha()
+    return doc
+
 N_CLIENTS = 128
 BATCH_SIZE = 32
 SAMPLES_PER_CLIENT = 50_000 // N_CLIENTS      # ≈ CIFAR10 over 128 clients
@@ -108,7 +136,7 @@ def main() -> None:
     ok, detail = _probe_with_retry()
     if not ok:
         print(f"chip unavailable: {detail}", file=sys.stderr)
-        print(json.dumps({
+        print(json.dumps(_stamp({
             "metric": "fedavg_cifar10_resnet18gn_128clients_rounds_per_sec",
             "value": 0.0,
             "unit": "rounds/sec",
@@ -119,13 +147,18 @@ def main() -> None:
             "overlap_fraction": None,
             "error": "chip_unavailable",
             "detail": detail,
-        }))
+        })))
         return
 
     import jax
 
+    from fedml_tpu import obs
     from fedml_tpu.utils.profiling import repin_jax_platforms
     repin_jax_platforms()
+    # FEDML_OBS_DIR enables the span tracer/flight recorder for this
+    # bench run (Chrome trace + Prometheus snapshot land there); the
+    # default-off path adds nothing to the timed loop
+    obs.configure_from_env()
     import jax.numpy as jnp
 
     from fedml_tpu.core.trainer import ClientTrainer
@@ -217,14 +250,25 @@ def main() -> None:
     rps = TIMED_ROUNDS / dt
     print(f"train_loss={last_loss:.4f} "
           f"{dt / TIMED_ROUNDS:.3f}s/round", file=sys.stderr)
-    print(json.dumps({
+    doc = _stamp({
         "metric": "fedavg_cifar10_resnet18gn_128clients_rounds_per_sec",
         "value": round(rps, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rps / ESTIMATED_REFERENCE_ROUNDS_PER_SEC, 4),
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
-    }))
+        # per-round transfer records (upload/wait/compute walls +
+        # overlap, one dict per bracketed round): empty on this
+        # resident-cohort path by design — streaming/block-stream bench
+        # variants fill it, and the key keeps one schema across them
+        "rounds": [
+            {k: round(v, 4) for k, v in r.items()}
+            for r in engine.transfer_stats.rounds],
+    })
+    if obs.enabled():
+        obs.export()                   # trace + metrics into FEDML_OBS_DIR
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedml_tpu import obs
 from fedml_tpu.core.pytree import tree_weighted_mean
 from fedml_tpu.core.sampling import ClientSampler
 from fedml_tpu.core.trainer import ClientTrainer
@@ -147,25 +148,43 @@ class FedAvgEngine:
             # the mixed placement outright)
             server_state = self._prepare_server_state(server_state)
             log.info("resumed from round %d", start - 1)
-        for round_idx in range(start, rounds):
-            t0 = time.time()
-            round_rng = jax.random.fold_in(rng_base, round_idx)
-            variables, server_state, m = self.round_fn(
-                variables, server_state, *self._round_args(round_idx),
-                round_rng)
-            if (round_idx % cfg.frequency_of_the_test == 0
-                    or round_idx == rounds - 1):
-                stats = self.evaluate(variables)
-                stats.update(round=round_idx,
-                             train_loss=float(m["train_loss"]),
-                             round_time=time.time() - t0)
-                self.metrics_history.append(stats)
-                if logger is not None:
-                    logger.log(stats, step=round_idx)
-                log.info("round %d: %s", round_idx, stats)
-            if ckpt is not None and ckpt_every and \
-                    (round_idx + 1) % ckpt_every == 0:
-                ckpt.save(round_idx, variables, server_state)
+        # observability (fedml_tpu/obs; all no-ops unless --obs_dir):
+        # each round gets a span + an optional deadline watchdog (a
+        # flight-recorder dump fires if the round overruns
+        # cfg.round_deadline_s — the artifact tools/isolate_hang.py
+        # collects); an unhandled error dumps the ring before re-raising
+        deadline_s = getattr(cfg, "round_deadline_s", None)
+        engine_name = type(self).__name__
+        try:
+            for round_idx in range(start, rounds):
+                t0 = time.time()
+                round_rng = jax.random.fold_in(rng_base, round_idx)
+                with obs.deadline(f"round{round_idx}", deadline_s), \
+                        obs.span("round", round=round_idx,
+                                 engine=engine_name):
+                    variables, server_state, m = self.round_fn(
+                        variables, server_state,
+                        *self._round_args(round_idx), round_rng)
+                if (round_idx % cfg.frequency_of_the_test == 0
+                        or round_idx == rounds - 1):
+                    with obs.span("eval", round=round_idx):
+                        stats = self.evaluate(variables)
+                    stats.update(round=round_idx,
+                                 train_loss=float(m["train_loss"]),
+                                 round_time=time.time() - t0)
+                    self.metrics_history.append(stats)
+                    if logger is not None:
+                        logger.log(stats, step=round_idx)
+                    log.info("round %d: %s", round_idx, stats)
+                    if obs.enabled():       # live/peak HBM per eval round
+                        obs.sample_device_memory()
+                if ckpt is not None and ckpt_every and \
+                        (round_idx + 1) % ckpt_every == 0:
+                    with obs.span("checkpoint", round=round_idx):
+                        ckpt.save(round_idx, variables, server_state)
+        except Exception as e:
+            obs.dump_flight(f"engine_error:{engine_name}: {e!r}")
+            raise
         return variables
 
     def evaluate(self, variables: Pytree) -> dict:

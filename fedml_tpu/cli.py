@@ -191,6 +191,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nas_steps", type=int, default=4)
     p.add_argument("--nas_multiplier", type=int, default=4)
     # observability / checkpointing (SURVEY.md §5 gaps the build fills)
+    p.add_argument("--obs_dir", type=str, default=None,
+                   help="enable the unified observability layer "
+                        "(fedml_tpu/obs): span tracer (Chrome-trace + "
+                        "JSONL exports), metrics registry (Prometheus "
+                        "text + JSON snapshots — comm bytes per "
+                        "backend, retries, round/upload walls, jit "
+                        "compiles, HBM gauges), and a flight recorder "
+                        "that dumps recent events + thread stacks on "
+                        "SIGUSR1, engine errors, or round-deadline "
+                        "overruns.  Artifacts land in this directory; "
+                        "defaults off (zero overhead).  PERF.md "
+                        "'Observability' has the triage recipes")
+    p.add_argument("--round_deadline_s", type=float, default=None,
+                   help="with --obs_dir: flight-recorder dump when one "
+                        "round exceeds this wall-clock (the hang/"
+                        "straggler tripwire; the run is NOT killed)")
     p.add_argument("--run_dir", type=str, default="./runs")
     p.add_argument("--run_name", type=str, default=None)
     p.add_argument("--ckpt_dir", type=str, default=None)
@@ -637,6 +653,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             f"--batch_unroll must be >= 1, got {args.batch_unroll}")
     cfg = FedConfig.from_args(args)
     cfg.ci = bool(args.ci)
+    from fedml_tpu import obs
+    if args.obs_dir:
+        obs.configure(args.obs_dir)
+    else:
+        obs.configure_from_env()     # FEDML_OBS_DIR (tools/isolate_hang)
     if args.multihost:
         from fedml_tpu.parallel.multihost import init_multihost
         init_multihost(required=True)
@@ -645,9 +666,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     logger = RunLogger(root=args.run_dir, project="fedml_tpu",
                        name=args.run_name, config=vars(args))
 
+    def _finish_obs():
+        # explicit export (atexit also fires, but in-process callers —
+        # tests, sweep drivers — want artifacts before main() returns)
+        if obs.enabled():
+            obs.export()
+
     if args.deploy:
         rc = _run_deployment(args, cfg, logger)
         logger.finish()
+        _finish_obs()
         _notify_sweep(args)
         return rc
     ckpt = None
@@ -661,6 +689,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         params = eng.fit(x, y, epochs=cfg.comm_round)
         logger.log({"train_acc": eng.score(params, x, y)})
         logger.finish()
+        _finish_obs()
         _notify_sweep(args)
         return 0
 
@@ -689,6 +718,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     if eng.metrics_history and not engine_logs:
         logger.log(eng.metrics_history[-1])
     logger.finish()
+    _finish_obs()
     _notify_sweep(args)
     return 0
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import abc
 import queue
 
+from fedml_tpu import obs
 from fedml_tpu.comm.message import Message
 
 
@@ -21,12 +22,43 @@ class Observer(abc.ABC):
 
 class BaseCommManager(abc.ABC):
     """Backend interface. Concrete backends implement `send_message` and
-    arrange for inbound messages to reach `_on_message` (thread-safe)."""
+    arrange for inbound messages to reach `_on_message` (thread-safe).
+
+    Observability hooks: every backend carries byte/message counters in
+    the process metrics registry, labeled by `backend_name` (a class
+    attr each concrete backend sets).  Concrete send/recv paths call
+    `_obs_sent(nbytes)` / `_obs_received(nbytes)` where the wire size
+    is known, and `_obs_retry()` on reconnect/resend attempts — so
+    "where did the round's bytes go" is answerable per backend from
+    one Prometheus snapshot (fedml_tpu/obs)."""
+
+    backend_name = "base"
 
     def __init__(self):
         self._observers: list[Observer] = []
         self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
         self._running = False
+        b = self.backend_name
+        self._m_sent_msgs = obs.counter("comm_sent_messages_total",
+                                        backend=b)
+        self._m_sent_bytes = obs.counter("comm_sent_bytes_total", backend=b)
+        self._m_recv_msgs = obs.counter("comm_received_messages_total",
+                                        backend=b)
+        self._m_recv_bytes = obs.counter("comm_received_bytes_total",
+                                         backend=b)
+        self._m_retries = obs.counter("comm_retries_total", backend=b)
+
+    # -- observability hooks -------------------------------------------------
+    def _obs_sent(self, nbytes: int) -> None:
+        self._m_sent_msgs.inc()
+        self._m_sent_bytes.inc(nbytes)
+
+    def _obs_received(self, nbytes: int) -> None:
+        self._m_recv_msgs.inc()
+        self._m_recv_bytes.inc(nbytes)
+
+    def _obs_retry(self) -> None:
+        self._m_retries.inc()
 
     # -- reference API -------------------------------------------------------
     @abc.abstractmethod

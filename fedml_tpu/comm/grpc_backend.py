@@ -51,6 +51,8 @@ def load_ip_config(path_or_dict: Union[str, dict]) -> dict[int, str]:
 
 
 class GrpcBackend(BaseCommManager):
+    backend_name = "grpc"
+
     def __init__(self, rank: int, ip_config: Union[str, dict],
                  base_port: int = 50000, max_workers: int = 8):
         super().__init__()
@@ -61,6 +63,7 @@ class GrpcBackend(BaseCommManager):
         self._stubs: dict[int, grpc.UnaryUnaryMultiCallable] = {}
 
         def handle(request: bytes, context) -> bytes:
+            self._obs_received(len(request))
             self._on_message(MessageCodec.decode(request))
             return b"ok"
 
@@ -91,6 +94,7 @@ class GrpcBackend(BaseCommManager):
         # server not bound yet) instead of failing UNAVAILABLE immediately
         self._stub(msg.get_receiver_id())(payload, timeout=1800,
                                           wait_for_ready=True)
+        self._obs_sent(len(payload))
 
     def close(self) -> None:
         for ch in self._channels.values():

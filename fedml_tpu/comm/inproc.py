@@ -27,18 +27,27 @@ class InProcRouter:
         with self._lock:
             self._backends[rank] = backend
 
-    def route(self, msg: Message) -> None:
+    def route(self, msg: Message) -> int:
+        """Deliver; returns the encoded frame size (0 when encode=False
+        skips the codec) so both endpoints' byte counters agree."""
+        nbytes = 0
         if self.encode:   # exercise the wire codec even in-memory
-            msg = MessageCodec.decode(MessageCodec.encode(msg))
+            payload = MessageCodec.encode(msg)
+            nbytes = len(payload)
+            msg = MessageCodec.decode(payload)
         rank = msg.get_receiver_id()
         with self._lock:
             dst = self._backends.get(rank)
         if dst is None:
             raise KeyError(f"no backend registered for rank {rank}")
+        dst._obs_received(nbytes)
         dst._on_message(msg)
+        return nbytes
 
 
 class InProcBackend(BaseCommManager):
+    backend_name = "inproc"
+
     def __init__(self, rank: int, router: InProcRouter):
         super().__init__()
         self.rank = rank
@@ -46,4 +55,4 @@ class InProcBackend(BaseCommManager):
         router.register(rank, self)
 
     def send_message(self, msg: Message) -> None:
-        self.router.route(msg)
+        self._obs_sent(self.router.route(msg))

@@ -15,6 +15,7 @@ import logging
 import threading
 from typing import Callable, Optional
 
+from fedml_tpu import obs
 from fedml_tpu.comm.base import BaseCommManager, Observer
 from fedml_tpu.comm.message import Message
 
@@ -77,10 +78,21 @@ class _Manager(Observer):
             log.warning("%s rank %d: no handler for %r", self.node_type,
                         self.rank, msg_type)
             return
-        handler(msg)
+        # spans live at this chokepoint (not per backend) so every
+        # transport's FSM dispatch/send shows on one timeline; the
+        # byte/message counters live in the backends where frame sizes
+        # are known (comm/base.py hooks)
+        with obs.span("comm.handle", backend=self.backend_name,
+                      node=self.node_type, rank=self.rank,
+                      msg_type=str(msg_type)):
+            handler(msg)
 
     def send_message(self, msg: Message) -> None:
-        self.com_manager.send_message(msg)
+        with obs.span("comm.send", backend=self.backend_name,
+                      node=self.node_type, rank=self.rank,
+                      msg_type=str(msg.get_type()),
+                      receiver=msg.get_receiver_id()):
+            self.com_manager.send_message(msg)
 
     def run(self) -> None:
         """Register handlers then block on the receive loop (the reference's

@@ -23,6 +23,8 @@ _TOPIC_C2S = "fedml_"      # client <id> → server
 
 
 class MqttBackend(BaseCommManager):
+    backend_name = "mqtt"
+
     def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
                  port: int = 1883, keepalive: int = 180,
                  client_factory=None):
@@ -62,13 +64,18 @@ class MqttBackend(BaseCommManager):
         self._mqtt.loop_start()
 
     def _on_mqtt_message(self, client, userdata, m) -> None:
+        self._obs_received(len(m.payload))
         self._on_message(Message.from_json(m.payload.decode()))
 
     def send_message(self, msg: Message) -> None:
         receiver = msg.get_receiver_id()
         topic = (_TOPIC_S2C + str(receiver) if self.rank == 0
                  else _TOPIC_C2S + str(self.rank))
-        self._mqtt.publish(topic, msg.to_json())
+        payload = msg.to_json()
+        self._mqtt.publish(topic, payload)
+        # count WIRE bytes (utf-8), matching the receive side's
+        # len(m.payload) — len(str) would undercount non-ASCII params
+        self._obs_sent(len(payload.encode("utf-8")))
 
     def close(self) -> None:
         self._mqtt.loop_stop()

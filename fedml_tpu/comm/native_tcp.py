@@ -31,6 +31,8 @@ def native_available() -> bool:
 
 
 class NativeTcpBackend(BaseCommManager):
+    backend_name = "native_tcp"
+
     def __init__(self, rank: int, ip_config: Union[str, dict],
                  base_port: int = 52000):
         super().__init__()
@@ -64,6 +66,7 @@ class NativeTcpBackend(BaseCommManager):
                 payload = ctypes.string_at(buf, length.value)
             finally:
                 self._lib.fh_buf_free(buf)
+            self._obs_received(len(payload))
             try:
                 self._on_message(MessageCodec.decode(payload))
             except Exception:     # malformed frame: drop, keep serving
@@ -87,6 +90,7 @@ class NativeTcpBackend(BaseCommManager):
                         f"cannot reach rank {receiver} at "
                         f"{self.ip_config[receiver]}:"
                         f"{self.base_port + receiver}")
+                self._obs_retry()
                 time.sleep(0.2)
             self._conns[receiver] = c
         return c
@@ -100,12 +104,14 @@ class NativeTcpBackend(BaseCommManager):
         with self._conn_lock:
             conn = self._connect_locked(rx)
             if self._lib.fh_send(conn, payload, len(payload)) != 0:
+                self._obs_retry()
                 stale = self._conns.pop(rx, None)
                 if stale is not None:
                     self._lib.fh_conn_close(stale)
                 conn = self._connect_locked(rx)
                 if self._lib.fh_send(conn, payload, len(payload)) != 0:
                     raise ConnectionError(f"send to rank {rx} failed")
+        self._obs_sent(len(payload))
 
     def close(self) -> None:
         if not self._alive:

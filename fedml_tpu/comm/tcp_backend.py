@@ -35,6 +35,8 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class TcpBackend(BaseCommManager):
+    backend_name = "tcp"
+
     def __init__(self, rank: int, ip_config: Union[str, dict],
                  base_port: int = 52000):
         super().__init__()
@@ -67,6 +69,7 @@ class TcpBackend(BaseCommManager):
             while self._alive:
                 (length,) = struct.unpack("<Q", _read_exact(conn, 8))
                 payload = _read_exact(conn, length)
+                self._obs_received(len(payload))
                 self._on_message(MessageCodec.decode(payload))
         except (ConnectionError, OSError):
             conn.close()
@@ -90,6 +93,7 @@ class TcpBackend(BaseCommManager):
             except ConnectionRefusedError:
                 if time.monotonic() >= deadline:
                     raise
+                self._obs_retry()
                 time.sleep(0.2)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         with self._conn_lock:
@@ -105,6 +109,7 @@ class TcpBackend(BaseCommManager):
         sock = self._connect(msg.get_receiver_id())
         with self._conn_lock:
             sock.sendall(struct.pack("<Q", len(payload)) + payload)
+        self._obs_sent(len(payload))
 
     def close(self) -> None:
         self._alive = False

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from fedml_tpu import obs
 from fedml_tpu.core.pytree import (tree_merge_counts, tree_select,
                                    tree_vary_noop)
 
@@ -360,34 +361,43 @@ class ClientTrainer:
         batch_unroll) unrolls the batch scan — measured on v5e at the
         bench shape: neutral at chunk 8, and at the chunk-2 optimum a
         full-shard unroll wins ~1-2% (tools/profile_bench.py L2U rows).
+
+        The obs span fires at TRACE time only (this function runs under
+        jit): it measures how long building the local-training scan
+        takes per compile — never the device execution — and, being a
+        host-side no-op outside the traced dataflow, cannot perturb the
+        compiled program (results stay bitwise obs-on/off).
         """
         unroll = self.batch_unroll if unroll is None else unroll
-        # tree_vary_noop: align the fresh (replicated-typed) optimizer
-        # state with the varying type it takes after step 1 under
-        # shard_map (core/pytree.py)
-        state = TrainState(
-            variables=variables,
-            opt_state=tree_vary_noop(self.init_opt(variables), shard),
-            rng=rng)
+        with obs.span("trace.local_train", epochs=epochs, unroll=unroll):
+            # tree_vary_noop: align the fresh (replicated-typed) optimizer
+            # state with the varying type it takes after step 1 under
+            # shard_map (core/pytree.py)
+            state = TrainState(
+                variables=variables,
+                opt_state=tree_vary_noop(self.init_opt(variables), shard),
+                rng=rng)
 
-        def batch_body(state, batch):
-            state, loss = self.train_step(state, batch, global_params)
-            cnt = jnp.sum(batch["mask"])
-            if self.batch_axes:   # loss is already global; weight it globally
-                cnt = self._revary(jax.lax.psum(cnt, self.batch_axes))
-            return state, (loss, cnt)
+            def batch_body(state, batch):
+                state, loss = self.train_step(state, batch, global_params)
+                cnt = jnp.sum(batch["mask"])
+                if self.batch_axes:   # loss is global; weight it globally
+                    cnt = self._revary(jax.lax.psum(cnt, self.batch_axes))
+                return state, (loss, cnt)
 
-        def epoch_body(state, _):
-            state, (losses, counts) = jax.lax.scan(batch_body, state, shard,
-                                                   unroll=unroll)
-            # sample-weighted epoch loss: padding batches contribute nothing
-            return state, jnp.sum(losses * counts) / jnp.maximum(jnp.sum(counts), 1.0)
+            def epoch_body(state, _):
+                state, (losses, counts) = jax.lax.scan(
+                    batch_body, state, shard, unroll=unroll)
+                # sample-weighted epoch loss: padding batches add nothing
+                return state, jnp.sum(losses * counts) / jnp.maximum(
+                    jnp.sum(counts), 1.0)
 
-        state, epoch_losses = jax.lax.scan(epoch_body, state, None, length=epochs)
-        n = jnp.sum(shard["mask"])
-        if self.batch_axes:   # the client's TOTAL sample count (agg weight)
-            n = self._revary(jax.lax.psum(n, self.batch_axes))
-        return state.variables, jnp.mean(epoch_losses), n
+            state, epoch_losses = jax.lax.scan(epoch_body, state, None,
+                                               length=epochs)
+            n = jnp.sum(shard["mask"])
+            if self.batch_axes:   # client's TOTAL sample count (agg weight)
+                n = self._revary(jax.lax.psum(n, self.batch_axes))
+            return state.variables, jnp.mean(epoch_losses), n
 
     # -- eval ---------------------------------------------------------------
     def eval_step(self, variables: Pytree, batch):
@@ -421,12 +431,14 @@ class ClientTrainer:
         return {"loss_sum": loss_sum, "correct": correct, "count": count}
 
     def evaluate(self, variables: Pytree, shard):
-        """Scan eval over batches of a padded shard; returns summed metrics."""
+        """Scan eval over batches of a padded shard; returns summed metrics.
+        (Span = trace-time only, like local_train.)"""
         def body(carry, batch):
             m = self.eval_step(variables, batch)
             return jax.tree.map(jnp.add, carry, m), None
 
         init = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
                 "count": jnp.float32(0)}
-        sums, _ = jax.lax.scan(body, init, shard)
+        with obs.span("trace.evaluate"):
+            sums, _ = jax.lax.scan(body, init, shard)
         return sums

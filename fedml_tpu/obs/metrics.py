@@ -1,0 +1,251 @@
+"""Metrics registry — counters / gauges / histograms with Prometheus-text
+and JSON snapshot exporters.
+
+The reference's observability is wandb scalars written once per eval round
+(FedAVGAggregator.py:137-162); nothing counts bytes on the wire, retries,
+or compile time.  This registry is the system of record for those
+operational metrics: comm backends count bytes/messages per backend label,
+the mesh engines feed transfer/round walls (utils/profiling.py
+TransferOverlapStats writes through to it), and jax compile events land as
+jit_compile_* (fedml_tpu/obs/__init__.py listener).
+
+Design constraints:
+
+* Thread-safe: comm recv loops, prefetch upload threads, and the round
+  loop all write concurrently — every mutation takes the metric's lock
+  (a bare ``self.value += n`` is NOT atomic under the GIL: it is a
+  load/add/store that two threads can interleave).
+* Cheap: one lock + one float op per event.  Metrics stay on even when
+  span tracing is disabled — the expensive parts of observability are
+  span event records and exporter I/O, not counter increments.
+* Prometheus semantics: counters only go up, labels are stable
+  identities (get-or-create returns the same object), histograms are
+  cumulative-bucket.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+from typing import Optional, Sequence
+
+# Prometheus' default duration buckets, extended for multi-minute round /
+# compile walls (the tunnel chip's cold compiles run minutes).
+DEFAULT_SECONDS_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter.  `inc` only; negative increments are rejected so
+    rates stay meaningful."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: tuple):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-value gauge with a `set_max` helper for peak tracking."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: tuple):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    def set_max(self, v: float) -> None:
+        """Monotonic high-water mark (live/peak pairs share one code
+        path: `live.set(x); peak.set_max(x)`)."""
+        with self._lock:
+            if v > self._value:
+                self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus shape: per-bucket counts of
+    observations <= upper bound, plus sum and count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: tuple,
+                 buckets: Sequence[float] = DEFAULT_SECONDS_BUCKETS):
+        self.name = name
+        self.labels = labels
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)   # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(le, cumulative_count), ...] ending with (+inf, total)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for le, c in zip(self.buckets, counts):
+            acc += c
+            out.append((le, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create registry keyed on (name, sorted labels).  Asking for
+    an existing name with a different metric kind is a programming error
+    and raises — silently returning the wrong type would corrupt both."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, object] = {}
+        self._kinds: dict[str, type] = {}      # kind is per NAME, not
+        #                                        per label set: one name
+        #                                        = one # TYPE line
+
+    def _get(self, cls, name: str, labels: dict, **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            known = self._kinds.setdefault(name, cls)
+            if known is not cls:
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{known.kind}, requested {cls.kind}")
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, _label_key(labels), **kw)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None,
+                  **labels) -> Histogram:
+        kw = {} if buckets is None else {"buckets": buckets}
+        h = self._get(Histogram, name, labels, **kw)
+        if buckets is not None and h.buckets != tuple(sorted(buckets)):
+            # same loud-failure policy as the kind conflict: silently
+            # returning a histogram with different buckets would strand
+            # observations at the wrong resolution
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}, requested {tuple(sorted(buckets))}")
+        return h
+
+    def metrics(self) -> list:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- exporters -----------------------------------------------------------
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4)."""
+        by_name: dict[str, list] = {}
+        for m in self.metrics():
+            by_name.setdefault(m.name, []).append(m)
+        lines = []
+        for name in sorted(by_name):
+            group = by_name[name]
+            lines.append(f"# TYPE {name} {group[0].kind}")
+            for m in sorted(group, key=lambda m: m.labels):
+                if m.kind == "histogram":
+                    for le, c in m.cumulative():
+                        le_s = "+Inf" if le == float("inf") else repr(le)
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_fmt_labels(m.labels + (('le', le_s),))} {c}")
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(m.labels)} {m.sum}")
+                    lines.append(
+                        f"{name}_count{_fmt_labels(m.labels)} {m.count}")
+                else:
+                    lines.append(f"{name}{_fmt_labels(m.labels)} {m.value}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able snapshot: {name{labels}: scalar-or-histogram-dict}."""
+        out = {}
+        for m in self.metrics():
+            key = m.name + _fmt_labels(m.labels)
+            if m.kind == "histogram":
+                out[key] = {
+                    "type": "histogram", "sum": m.sum, "count": m.count,
+                    "buckets": [
+                        {"le": ("+Inf" if le == float("inf") else le),
+                         "cumulative_count": c}
+                        for le, c in m.cumulative()],
+                }
+            else:
+                out[key] = m.value
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), indent=2, sort_keys=True)

@@ -1,0 +1,126 @@
+"""Span tracer — nestable wall-clock spans with Chrome-trace and JSONL
+exporters.
+
+One tracer per process collects *complete* trace events ("ph": "X") from
+every thread: the round loop, comm recv loops, and the prefetch upload
+workers all record against the same perf_counter epoch, so a
+`h2d.upload` span produced on the background thread lines up on the same
+timeline as the `round.block_step` spans that consumed it — exactly the
+view needed to see whether uploads hid behind compute.  Nesting needs no
+explicit parent links: Chrome/Perfetto reconstruct the stack per `tid`
+from ts/dur containment.
+
+Overhead when tracing is enabled: two perf_counter calls plus one
+locked deque append per span.  The event buffer is a fixed-size ring
+(default 200k events) so a week-long run cannot OOM the host; drops are
+counted and surfaced in the export.  When observability is disabled the
+tracer is never constructed at all — `obs.span()` returns a shared
+no-op (see fedml_tpu/obs/__init__.py).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+
+class SpanTracer:
+    def __init__(self, max_events: int = 200_000, flight=None):
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(
+            maxlen=max_events)
+        self._recorded = 0
+        self._epoch = time.perf_counter()
+        # wall-clock of the epoch so exported ts can be correlated with
+        # log timestamps (stored in export metadata)
+        self.epoch_unix = time.time()
+        self.pid = os.getpid()
+        self._flight = flight
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._epoch) * 1e6
+
+    def _record(self, ev: dict) -> None:
+        with self._lock:
+            self._events.append(ev)
+            self._recorded += 1
+        if self._flight is not None:
+            self._flight.record("span", ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        ts = self._now_us()
+        try:
+            yield
+        finally:
+            dur = self._now_us() - ts
+            self._record({"name": name, "ph": "X", "ts": ts, "dur": dur,
+                          "pid": self.pid, "tid": threading.get_ident(),
+                          "args": attrs})
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration marker (Chrome "i" event, thread scope)."""
+        self._record({"name": name, "ph": "i", "ts": self._now_us(),
+                      "s": "t", "pid": self.pid,
+                      "tid": threading.get_ident(), "args": attrs})
+
+    # -- introspection -------------------------------------------------------
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._recorded - len(self._events)
+
+    # -- exporters -----------------------------------------------------------
+    def export_chrome(self, path: str) -> str:
+        """Chrome trace-event JSON (load in chrome://tracing or
+        https://ui.perfetto.dev).  Thread names become M (metadata)
+        events so the timeline rows are readable."""
+        events = self.events()
+        tids = {e["tid"] for e in events}
+        names = {t.ident: t.name for t in threading.enumerate()}
+        meta = [{"name": "thread_name", "ph": "M", "pid": self.pid,
+                 "tid": tid,
+                 "args": {"name": names.get(tid, f"thread-{tid}")}}
+                for tid in sorted(tids)]
+        doc = {"traceEvents": meta + events,
+               "displayTimeUnit": "ms",
+               "otherData": {"epoch_unix": self.epoch_unix,
+                             "dropped_events": self.dropped}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def export_jsonl(self, path: str) -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for ev in self.events():
+                f.write(json.dumps(ev) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+class _NoopSpan:
+    """Shared no-op context manager — the disabled-by-default fast path.
+    Stateless, so one instance serves every call site and nesting level
+    concurrently; entering costs two trivial method calls."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()

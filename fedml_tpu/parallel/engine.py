@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fedml_tpu import obs
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.algorithms.fedopt import make_server_optimizer
 from fedml_tpu.core import robust as robust_ops
@@ -539,7 +540,8 @@ class MeshFedAvgEngine(FedAvgEngine):
         the process-global numpy RNG (core/sampling.py), which a
         background thread would race.  The wall lands in transfer_stats
         from whichever thread runs it."""
-        with self.transfer_stats.uploading():
+        with obs.span("h2d.upload_cohort", clients=len(ids)), \
+                self.transfer_stats.uploading():
             cohort = self._host_gather_upload(ids)
             weights = jax.device_put(
                 np.take(np.asarray(self.data.client_num_samples,
@@ -570,8 +572,12 @@ class MeshFedAvgEngine(FedAvgEngine):
         """Host-gather + async device_put of one client block (the
         double-buffer unit), via the shared _host_gather_upload pipeline.
         Runs on the prefetch thread when the pipeline is on; the wall
-        lands in transfer_stats either way."""
-        with self.transfer_stats.uploading():
+        lands in transfer_stats either way.  The span is produced from
+        whichever thread uploads, so on the pipelined path it lands on
+        the worker's trace row, interleaved with the round loop's
+        block_step spans — the overlap is visible directly."""
+        with obs.span("h2d.upload_block", clients=len(ids_blk)), \
+                self.transfer_stats.uploading():
             block = self._host_gather_upload(ids_blk)
             weights = jax.device_put(w_blk, client_sharding(self.mesh))
             rngs = jax.device_put(rngs_blk, client_sharding(self.mesh))
@@ -634,13 +640,21 @@ class MeshFedAvgEngine(FedAvgEngine):
         crngs = np.asarray(jax.random.split(rng, len(ids)))
         self.transfer_stats.round_start()
         try:
-            sums = jax.device_put(self._zero_sums(variables),
-                                  replicated_sharding(self.mesh))
-            with self._block_fetcher(ids, w_all, crngs, spans) as fetch:
-                for _ in spans:
-                    sums = self._block_step(variables, sums, *fetch.get())
-            return self._block_finalize(variables, server_state, sums,
-                                        agg_rng)
+            with obs.span("round.blockstream", round=int(round_idx),
+                          clients=len(ids), blocks=len(spans)):
+                sums = jax.device_put(self._zero_sums(variables),
+                                      replicated_sharding(self.mesh))
+                with self._block_fetcher(ids, w_all, crngs, spans) as fetch:
+                    for i, _ in enumerate(spans):
+                        args = fetch.get()
+                        # dispatch wall only (the jit call is async);
+                        # the device wall shows up as the NEXT get()'s
+                        # wait when uploads outpace compute
+                        with obs.span("round.block_step", block=i):
+                            sums = self._block_step(variables, sums, *args)
+                with obs.span("round.block_finalize"):
+                    return self._block_finalize(variables, server_state,
+                                                sums, agg_rng)
         finally:
             self.transfer_stats.round_end()
 
@@ -1161,8 +1175,11 @@ class MeshRobustEngine(MeshFedAvgEngine):
         crngs = np.asarray(jax.random.split(rng, K))
         self.transfer_stats.round_start()
         try:
-            return self._blockstream_orderstat_body(
-                variables, server_state, ids, w_all, crngs, agg_rng)
+            with obs.span("round.blockstream_orderstat",
+                          round=int(round_idx), clients=K,
+                          defense=self.defense):
+                return self._blockstream_orderstat_body(
+                    variables, server_state, ids, w_all, crngs, agg_rng)
         finally:
             self.transfer_stats.round_end()
 

@@ -53,6 +53,9 @@ class FedConfig:
     stddev: float = 0.0
     # eval cadence
     frequency_of_the_test: int = 5
+    # observability: flight-recorder dump when one round overruns this
+    # many seconds (needs --obs_dir; None = no watchdog — fedml_tpu/obs)
+    round_deadline_s: Optional[float] = None
     # auto per-client test eval during evaluate() (the reference's
     # _local_test_on_all_clients); opt out to skip its upload + cost
     local_test_eval: bool = True

@@ -40,8 +40,12 @@ class RunLogger:
                 json.dump(config, f, indent=2, default=str)
 
     def log(self, metrics: dict, step: Optional[int] = None) -> None:
+        if self._hist.closed:
+            raise ValueError("RunLogger is closed (log after close())")
         rec = {"_step": step, "_time": time.time(), **metrics}
         self._hist.write(json.dumps(rec, default=float) + "\n")
+        # flush per log(): a killed run keeps every line it logged —
+        # history.jsonl is the post-mortem record, not a best-effort one
         self._hist.flush()
         self.summary.update(metrics)
         with open(os.path.join(self.dir, "summary.json"), "w") as f:
@@ -50,9 +54,25 @@ class RunLogger:
             self._wandb.log(metrics, step=step)
 
     def finish(self) -> None:
-        self._hist.close()
+        """Close the history handle and the wandb mirror.  Idempotent —
+        every exit path (cli main, context-manager __exit__, an
+        engine's own cleanup) may call it."""
+        if not self._hist.closed:
+            self._hist.close()
         if self._wandb is not None:
             self._wandb.finish()
+            self._wandb = None
+
+    # close()/with-statement aliases: `with RunLogger(...) as logger:`
+    # guarantees the wandb mirror and the history handle are released on
+    # ANY exit, including an exception mid-run
+    close = finish
+
+    def __enter__(self) -> "RunLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
 
     @staticmethod
     def read_summary(run_dir: str) -> dict:

@@ -17,6 +17,8 @@ from typing import Iterator, Optional
 
 import jax
 
+from fedml_tpu import obs
+
 
 def repin_jax_platforms() -> None:
     """Re-assert an explicit JAX_PLATFORMS env choice over the image's
@@ -103,10 +105,32 @@ class TransferOverlapStats:
     starts during round r lands in r's window); the cumulative numbers
     are window-free.  Thread-safe; overhead is two perf_counter calls
     per event, so it stays on for every streaming round
-    (PERF.md §"Prefetch pipeline" has the measurement recipe)."""
+    (PERF.md §"Prefetch pipeline" has the measurement recipe).
+
+    The metrics registry (fedml_tpu/obs) is the exported system of
+    record: every upload/wait/round event writes through to the shared
+    engine_* counters and histograms below, so a Prometheus snapshot
+    carries the same walls this object reports.  The instance keeps its
+    own cumulative state too — per-engine round windows (and `reset()`)
+    must not be corrupted by another engine in the same process, and
+    prometheus counters never reset."""
 
     def __init__(self):
         self._lock = threading.Lock()
+        # write-through registry handles (shared across engines; the
+        # per-instance fields below stay the per-engine view)
+        self._m_upload_total = obs.counter(
+            "engine_upload_wall_seconds_total")
+        self._m_wait_total = obs.counter("engine_wait_wall_seconds_total")
+        self._m_rounds = obs.counter("engine_rounds_total")
+        # per-event histograms: upload tail = the straggler blocks of a
+        # block-streamed round; round wall = the cohort wall-time
+        self._h_upload = obs.histogram("engine_upload_wall_seconds")
+        self._h_wait = obs.histogram("engine_wait_wall_seconds")
+        self._h_round = obs.histogram("engine_round_wall_seconds")
+        self._h_overlap = obs.histogram(
+            "engine_round_overlap_fraction",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0))
         self.reset()
 
     def reset(self) -> None:
@@ -123,8 +147,11 @@ class TransferOverlapStats:
         try:
             yield
         finally:
+            dt = time.perf_counter() - t0
             with self._lock:
-                self._upload_wall += time.perf_counter() - t0
+                self._upload_wall += dt
+            self._m_upload_total.inc(dt)
+            self._h_upload.observe(dt)
 
     @contextlib.contextmanager
     def waiting(self) -> Iterator[None]:
@@ -132,8 +159,11 @@ class TransferOverlapStats:
         try:
             yield
         finally:
+            dt = time.perf_counter() - t0
             with self._lock:
-                self._wait_wall += time.perf_counter() - t0
+                self._wait_wall += dt
+            self._m_wait_total.inc(dt)
+            self._h_wait.observe(dt)
 
     def round_start(self) -> None:
         """Open a round window (auto-closes a window left open).  The
@@ -162,6 +192,9 @@ class TransferOverlapStats:
                "compute_wall_s": max(wall - wait, 0.0),
                "overlap_fraction": _overlap_fraction(up, wait)}
         self.rounds.append(rec)
+        self._m_rounds.inc()
+        self._h_round.observe(wall)
+        self._h_overlap.observe(rec["overlap_fraction"])
         return rec
 
     def overlap_fraction(self) -> float:

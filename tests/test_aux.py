@@ -4,6 +4,7 @@ import os
 
 import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.algorithms import FedAvgEngine, FedOptEngine
 from fedml_tpu.core.trainer import ClientTrainer
@@ -98,6 +99,24 @@ def test_run_logger_summary_contract(tmp_path):
     assert summary["train_loss"] == 1.0
     lines = open(os.path.join(lg.dir, "history.jsonl")).read().splitlines()
     assert len(lines) == 2 and json.loads(lines[1])["_step"] == 1
+
+
+def test_run_logger_context_manager_and_idempotent_close(tmp_path):
+    """`with RunLogger(...)` closes on any exit; close() is finish()'s
+    alias and both are idempotent; a closed logger refuses log()
+    (silently dropping lines would corrupt the history contract)."""
+    with RunLogger(root=str(tmp_path), project="p", name="cm") as lg:
+        lg.log({"a": 1.0}, step=0)
+        # flush-on-log: the line is durable BEFORE close (a killed run
+        # keeps what it logged)
+        lines = open(os.path.join(lg.dir, "history.jsonl")).read()
+        assert json.loads(lines.splitlines()[0])["a"] == 1.0
+    assert lg._hist.closed
+    lg.close()                          # idempotent (alias of finish)
+    lg.finish()
+    with pytest.raises(ValueError, match="closed"):
+        lg.log({"b": 2.0}, step=1)
+    assert RunLogger.read_summary(lg.dir) == {"a": 1.0}
 
 
 def test_engine_logs_to_logger(tmp_path):

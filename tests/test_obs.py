@@ -1,0 +1,335 @@
+"""Observability-subsystem tests (fedml_tpu/obs: span tracer + metrics
+registry + flight recorder).
+
+Pinned invariants:
+
+* registry thread-safety: concurrent increments/observations from many
+  threads lose nothing (comm recv loops + prefetch workers + the round
+  loop all write concurrently in production);
+* the Chrome-trace exporter emits loadable trace-event JSON (ts/dur/ph/
+  pid/tid complete events), with background-thread spans on their own
+  tid rows of the SAME timeline;
+* the flight recorder dumps on SIGUSR1 and on a round-deadline overrun,
+  and the dump carries the ring + per-thread stacks + a metrics
+  snapshot;
+* observability on vs off is BITWISE result-neutral on the block-stream
+  engine path (same discipline as tests/test_prefetch.py), while the
+  enabled run leaves a loadable trace and a Prometheus snapshot behind;
+* comm byte counters land per backend label (the inproc messaging sim).
+"""
+import glob
+import json
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import obs
+from fedml_tpu.obs.metrics import MetricsRegistry
+from fedml_tpu.obs.tracer import SpanTracer
+
+from parallel_case import _mnist_like_cfg, _setup
+
+
+@pytest.fixture
+def clean_obs():
+    """Fresh disabled obs state around each test; restores the process
+    SIGUSR1 disposition (configure() installs a dump handler)."""
+    prev = signal.getsignal(signal.SIGUSR1)
+    obs.reset()
+    yield
+    obs.reset()
+    signal.signal(signal.SIGUSR1, prev)
+
+
+# -- metrics registry --------------------------------------------------------
+
+def test_registry_concurrent_increments_lose_nothing():
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", backend="test")
+    h = reg.histogram("lat_seconds", buckets=(0.5, 1.0))
+    g = reg.gauge("peak")
+    N_THREADS, N_OPS = 8, 5000
+
+    def work(i):
+        for k in range(N_OPS):
+            c.inc()
+            h.observe(0.25 if k % 2 else 2.0)
+            g.set_max(i * N_OPS + k)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N_THREADS * N_OPS
+    assert h.count == N_THREADS * N_OPS
+    cum = dict(h.cumulative())
+    assert cum[0.5] == N_THREADS * N_OPS // 2          # the 0.25 half
+    assert cum[float("inf")] == N_THREADS * N_OPS
+    assert g.value == N_THREADS * N_OPS - 1            # max survived races
+
+
+def test_registry_identity_and_kind_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", backend="tcp")
+    assert reg.counter("x_total", backend="tcp") is a      # get-or-create
+    assert reg.counter("x_total", backend="grpc") is not a  # label split
+    with pytest.raises(TypeError):
+        reg.gauge("x_total", backend="tcp")                # kind conflict
+    with pytest.raises(TypeError):
+        # kind is per NAME (one # TYPE line per name): a different
+        # label set cannot smuggle a second kind into the exposition
+        reg.gauge("x_total", backend="mqtt")
+    with pytest.raises(ValueError):
+        a.inc(-1)                                          # counters go up
+    h = reg.histogram("h_seconds", buckets=(1.0, 2.0))
+    assert reg.histogram("h_seconds") is h                 # no-buckets ok
+    with pytest.raises(ValueError):
+        reg.histogram("h_seconds", buckets=(5.0,))         # bucket clash
+
+
+def test_prometheus_text_and_json_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("bytes_total", backend="inproc").inc(42)
+    reg.histogram("wall_seconds", buckets=(1.0, 5.0)).observe(3.0)
+    text = reg.to_prometheus()
+    assert "# TYPE bytes_total counter" in text
+    assert 'bytes_total{backend="inproc"} 42' in text
+    assert 'wall_seconds_bucket{le="1.0"} 0' in text
+    assert 'wall_seconds_bucket{le="+Inf"} 1' in text
+    assert "wall_seconds_sum 3.0" in text
+    snap = reg.snapshot()
+    assert snap['bytes_total{backend="inproc"}'] == 42
+    assert snap["wall_seconds"]["count"] == 1
+    json.dumps(snap)                                   # JSON-able
+
+
+# -- span tracer -------------------------------------------------------------
+
+def test_chrome_trace_export_shape_and_nesting(tmp_path):
+    tr = SpanTracer()
+    with tr.span("outer", round=1):
+        with tr.span("inner", phase="aggregate"):
+            time.sleep(0.005)
+    tr.instant("marker", note="x")
+    path = tr.export_chrome(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    by_name = {e["name"]: e for e in events if e.get("ph") in ("X", "i")}
+    for name in ("outer", "inner", "marker"):
+        assert name in by_name
+    for e in (by_name["outer"], by_name["inner"]):
+        assert e["ph"] == "X"
+        for key in ("ts", "dur", "pid", "tid"):       # loadable shape
+            assert isinstance(e[key], (int, float))
+    # nesting: inner contained in outer on the same tid
+    o, i = by_name["outer"], by_name["inner"]
+    assert o["tid"] == i["tid"]
+    assert o["ts"] <= i["ts"]
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+    assert i["args"] == {"phase": "aggregate"}
+    # jsonl twin: one object per line, same span count
+    jl = tr.export_jsonl(str(tmp_path / "trace.jsonl"))
+    lines = [json.loads(ln) for ln in open(jl)]
+    assert len(lines) == 3
+
+
+def test_tracer_background_thread_lands_on_same_timeline(tmp_path):
+    """The prefetch requirement: spans produced on a worker thread share
+    the tracer's epoch — they interleave with the main thread's spans
+    on the one timeline, on a distinct tid row."""
+    tr = SpanTracer()
+
+    def work():
+        with tr.span("bg.upload"):
+            time.sleep(0.002)
+
+    with tr.span("fg.round"):
+        t = threading.Thread(target=work, name="h2d-test")
+        t.start()
+        t.join()
+    ev = {e["name"]: e for e in tr.events()}
+    assert ev["bg.upload"]["tid"] != ev["fg.round"]["tid"]
+    fg, bg = ev["fg.round"], ev["bg.upload"]
+    assert fg["ts"] <= bg["ts"] <= fg["ts"] + fg["dur"]   # same epoch
+
+
+def test_tracer_ring_bound_counts_drops():
+    tr = SpanTracer(max_events=10)
+    for i in range(25):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.events()) == 10
+    assert tr.dropped == 15
+    assert tr.events()[-1]["name"] == "s24"            # newest retained
+
+
+def test_span_disabled_is_noop_singleton(clean_obs):
+    s1, s2 = obs.span("a", x=1), obs.span("b")
+    assert s1 is s2                       # shared stateless no-op
+    with s1:
+        with s2:
+            pass
+    assert obs.tracer() is None and not obs.enabled()
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_dump_on_deadline_overrun(clean_obs, tmp_path):
+    """Simulated round-deadline overrun: the watchdog fires mid-block,
+    dumping ring + stacks while the 'round' is still stuck."""
+    obs.configure(str(tmp_path), install_signal=False)
+    with obs.span("round", round=3):
+        with obs.deadline("round3", 0.05):
+            time.sleep(0.4)               # the overrunning round
+    dumps = glob.glob(str(tmp_path / "flight-*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "deadline_overrun:round3"
+    assert doc["thread_stacks"]           # per-thread Python stacks
+    assert any("time.sleep" in "".join(fr) or "test_obs" in "".join(fr)
+               for fr in doc["thread_stacks"].values())
+    assert "metrics" in doc               # snapshot rides along
+
+
+def test_flight_deadline_cancelled_when_round_finishes(clean_obs,
+                                                       tmp_path):
+    obs.configure(str(tmp_path), install_signal=False)
+    with obs.deadline("fast", 5.0):
+        pass                              # well under deadline
+    time.sleep(0.05)
+    assert not glob.glob(str(tmp_path / "flight-*.json"))
+
+
+def test_flight_dump_on_sigusr1(clean_obs, tmp_path):
+    """kill -USR1 <pid> (what tools/isolate_hang.py --timeout sends to a
+    stuck stage) produces a dump with the recent event ring."""
+    obs.configure(str(tmp_path))          # installs the handler
+    with obs.span("round.blockstream", round=7):
+        pass
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.monotonic() + 5.0
+    dumps = []
+    while time.monotonic() < deadline and not dumps:
+        dumps = glob.glob(str(tmp_path / "flight-*.json"))
+        time.sleep(0.01)
+    assert dumps, "SIGUSR1 produced no flight dump"
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "SIGUSR1"
+    assert any(e.get("name") == "round.blockstream"
+               for e in doc["events"])
+
+
+def test_engine_error_dumps_flight(clean_obs, tmp_path):
+    """An unhandled error inside the run loop leaves a dump behind
+    before propagating."""
+    from fedml_tpu.algorithms import FedAvgEngine
+    cfg = _mnist_like_cfg(comm_round=2)
+    trainer, data = _setup(cfg)
+    eng = FedAvgEngine(trainer, data, cfg, donate=False)
+    obs.configure(str(tmp_path), install_signal=False)
+
+    def boom(*a, **kw):
+        raise RuntimeError("round exploded")
+
+    eng.round_fn = boom
+    with pytest.raises(RuntimeError, match="round exploded"):
+        eng.run(rounds=1)
+    dumps = glob.glob(str(tmp_path / "flight-*.json"))
+    assert len(dumps) == 1
+    assert "engine_error" in json.load(open(dumps[0]))["reason"]
+
+
+# -- obs on/off result parity + artifact acceptance --------------------------
+
+def _assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_blockstream_bitwise_obs_on_vs_off(clean_obs, tmp_path):
+    """Acceptance pin: the block-stream round under --obs_dir produces
+    BITWISE the variables of the obs-disabled run (spans/counters are
+    pure host bookkeeping), and the enabled run exports a loadable
+    Chrome trace whose upload spans sit on the prefetch worker's tid,
+    plus a Prometheus snapshot carrying the engine walls."""
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=2)
+    trainer, data = _setup(cfg)
+    ref = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, stream_block=8)
+    v0 = ref.init_variables()
+    v_off = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+
+    obs.configure(str(tmp_path), install_signal=False)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False, stream_block=8)
+    v_on = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=2)
+    _assert_trees_bitwise(v_off, v_on)
+
+    paths = obs.export()
+    doc = json.load(open(paths["chrome_trace"]))       # loadable
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert {"round", "round.blockstream", "round.block_step",
+            "h2d.upload_block"} <= names
+    # prefetch uploads ran on a background thread, same timeline
+    rnd = next(e for e in spans if e["name"] == "round.blockstream")
+    ups = [e for e in spans if e["name"] == "h2d.upload_block"]
+    assert any(u["tid"] != rnd["tid"] for u in ups)
+    prom = open(paths["prometheus"]).read()
+    assert "engine_round_wall_seconds_count" in prom
+    assert "engine_upload_wall_seconds_total" in prom
+    # metrics are always-on: BOTH runs' rounds landed in the registry
+    line = next(ln for ln in prom.splitlines()
+                if ln.startswith("engine_rounds_total"))
+    assert float(line.split()[-1]) == 4.0, line
+
+
+def test_messaging_comm_counters_per_backend(clean_obs, tmp_path):
+    """The acceptance snapshot: after an inproc messaging-FedAvg run,
+    the Prometheus text carries non-zero comm byte counters labeled
+    with the active backend."""
+    from fedml_tpu.comm.fedavg_messaging import run_messaging_fedavg
+    cfg = _mnist_like_cfg(client_num_in_total=4, client_num_per_round=2,
+                          comm_round=1)
+    trainer, data = _setup(cfg)
+    obs.configure(str(tmp_path), install_signal=False)
+    run_messaging_fedavg(trainer, data, cfg, worker_num=2)
+    prom = obs.registry().to_prometheus()
+    for name in ("comm_sent_bytes_total", "comm_received_bytes_total"):
+        line = next(ln for ln in prom.splitlines()
+                    if ln.startswith(f'{name}{{backend="inproc"}}'))
+        assert float(line.split()[-1]) > 0, line
+    # model-exchange FSM spans landed on the trace too
+    names = {e["name"] for e in obs.tracer().events()}
+    assert "comm.send" in names and "comm.handle" in names
+
+
+def test_cli_obs_dir_writes_artifacts(tmp_path, clean_obs):
+    """--obs_dir through the launcher: the run leaves trace + metrics
+    artifacts (the operator-facing contract README documents)."""
+    from fedml_tpu.cli import main
+    obs_dir = tmp_path / "obs"
+    rc = main(["--algorithm", "fedavg", "--dataset", "mnist", "--model",
+               "lr", "--synthetic_scale", "0.001",
+               "--client_num_in_total", "4", "--client_num_per_round",
+               "4", "--comm_round", "2", "--batch_size", "4",
+               "--frequency_of_the_test", "1",
+               "--run_dir", str(tmp_path / "runs"),
+               "--obs_dir", str(obs_dir)])
+    assert rc == 0
+    doc = json.load(open(obs_dir / "trace.chrome.json"))
+    assert any(e.get("name") == "round" for e in doc["traceEvents"])
+    assert "jit_compile_total" in open(obs_dir / "metrics.prom").read()
+    json.load(open(obs_dir / "metrics.json"))

@@ -1,0 +1,39 @@
+"""CI-config guard: pyproject's pytest addopts must stay xdist-free.
+
+An unconditional `-n auto` in addopts once killed EVERY pytest run in
+this image — pytest-xdist is not installed here, so pytest dies with
+"unrecognized arguments: -n" before collecting a single test, including
+the driver's tier-1 command (which even passes `-p no:xdist`).  PR 1
+removed it; this test keeps it removed.  Parallelism stays an explicit
+opt-in on boxes that have xdist: `pytest -n auto --maxprocesses 8`.
+"""
+import os
+import re
+
+PYPROJECT = os.path.join(os.path.dirname(__file__), "..", "pyproject.toml")
+
+
+def _addopts() -> str:
+    text = open(PYPROJECT).read()
+    try:
+        import tomllib
+        opts = (tomllib.loads(text).get("tool", {}).get("pytest", {})
+                .get("ini_options", {}).get("addopts", ""))
+    except ModuleNotFoundError:               # python 3.10: regex fallback
+        m = re.search(r'^addopts\s*=\s*"(.*)"\s*$', text, re.M)
+        opts = m.group(1) if m else ""
+    if isinstance(opts, list):
+        opts = " ".join(opts)
+    return opts
+
+
+def test_addopts_never_hardcodes_xdist():
+    opts = _addopts()
+    tokens = opts.split()
+    assert "-n" not in tokens and "--numprocesses" not in tokens, (
+        f"pyproject addopts={opts!r} reintroduces pytest-xdist flags: "
+        "xdist is absent in the CI image and this kills every pytest "
+        "run with 'unrecognized arguments: -n' (see PR-1 history)")
+    assert "--dist" not in tokens and "--maxprocesses" not in tokens, (
+        f"addopts={opts!r} carries xdist-only companions that fail "
+        "without the plugin")

@@ -4,10 +4,28 @@ Stages (all ResNet-18-GN, 128 clients, chunk 8, bf16):
   1. plain   : chunk-scan round, no shard_map           (known-good F8)
   2. smap    : same wrapped in shard_map over a 1-device mesh
   3. gather  : smap + device-side take-gather of the stack by ids
-Each prints timing immediately (unbuffered)."""
+Each prints timing immediately (unbuffered).
+
+Watchdog mode (`--timeout S`): each stage runs as a SUBPROCESS with the
+flight recorder enabled (FEDML_OBS_DIR in its env, fedml_tpu/obs).  A
+stage that exceeds the timeout gets SIGUSR1 — the child's obs handler
+dumps its event ring + every thread's Python stack to disk — then a
+grace period to finish the dump, then SIGKILL.  The dump is collected
+into this tool's JSON report, so a wedged compile is diagnosable from
+the artifact instead of a rerun under a debugger:
+
+    python tools/isolate_hang.py --timeout 900 [--obs_dir DIR] [stages]
+"""
 from __future__ import annotations
 
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 
 import jax
@@ -20,6 +38,7 @@ from fedml_tpu.models import create_model
 from fedml_tpu.parallel.mesh import make_mesh, pvary_tree
 
 N, BS, NB, CH = 128, 32, 13, 8
+STAGES = ("plain", "smap", "gather")
 
 
 def log(s):
@@ -70,6 +89,10 @@ def chunk_round_body(trainer, variables, cohort, weights, rngs, axes=None):
 
 
 def run(stage):
+    # watchdog-mode children arrive with FEDML_OBS_DIR set: enable the
+    # flight recorder + SIGUSR1 dump handler before any jax work
+    from fedml_tpu import obs
+    obs.configure_from_env()
     trainer = ClientTrainer(create_model("resnet18_gn", output_dim=10),
                             lr=0.1, train_dtype=jnp.bfloat16)
     stack = data_stack()
@@ -114,14 +137,17 @@ def run(stage):
 
     t0 = time.time()
     log(f"[{stage}] lowering...")
-    lowered = fn.lower(*args)
+    with obs.span("isolate.lower", stage=stage):
+        lowered = fn.lower(*args)
     log(f"[{stage}] lowered in {time.time()-t0:.1f}s; compiling...")
     t0 = time.time()
-    compiled = lowered.compile()
+    with obs.span("isolate.compile", stage=stage):
+        compiled = lowered.compile()
     log(f"[{stage}] compiled in {time.time()-t0:.1f}s; running...")
     t0 = time.time()
-    out = compiled(*args)
-    jax.block_until_ready(out)
+    with obs.span("isolate.first_run", stage=stage):
+        out = compiled(*args)
+        jax.block_until_ready(out)
     log(f"[{stage}] first run {time.time()-t0:.1f}s")
     t0 = time.time()
     for _ in range(3):
@@ -130,6 +156,101 @@ def run(stage):
     log(f"[{stage}] steady {(time.time()-t0)/3:.2f}s/round")
 
 
+def _collect_dumps(obs_dir: str, exclude=()) -> list[dict]:
+    """Load the flight-recorder dumps the child left in obs_dir (the
+    obs naming scheme: flight-<pid>-<seq>.json), skipping `exclude`
+    (dumps that predate this run — a reused --obs_dir must not
+    misattribute an earlier run's dumps to this report)."""
+    out = []
+    for p in sorted(set(glob.glob(os.path.join(obs_dir, "flight-*.json")))
+                    - set(exclude)):
+        try:
+            with open(p) as f:
+                out.append({"path": p, **json.load(f)})
+        except (OSError, json.JSONDecodeError) as e:
+            out.append({"path": p, "error": f"unreadable dump: {e}"})
+    return out
+
+
+def _watch_stage(stage: str, timeout: float, obs_root: str) -> dict:
+    """Run one stage as a flight-recorded subprocess; on timeout,
+    SIGUSR1 it (the child dumps ring + thread stacks), grace-wait for
+    the dump, then SIGKILL.  Returns the stage report."""
+    obs_dir = os.path.join(obs_root, stage)
+    os.makedirs(obs_dir, exist_ok=True)
+    # snapshot pre-existing dumps (reused --obs_dir): the poll below and
+    # the report must see only THIS run's dumps
+    stale = set(glob.glob(os.path.join(obs_dir, "flight-*.json")))
+    env = dict(os.environ, FEDML_OBS_DIR=obs_dir)
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             stage], env=env)
+    report = {"stage": stage, "obs_dir": obs_dir, "pid": proc.pid}
+    try:
+        proc.wait(timeout=timeout)
+        report["status"] = "ok" if proc.returncode == 0 else "error"
+        report["returncode"] = proc.returncode
+    except subprocess.TimeoutExpired:
+        report["status"] = "hang"
+        log(f"[{stage}] still running after {timeout:.0f}s; sending "
+            f"SIGUSR1 for a flight-recorder dump")
+        proc.send_signal(signal.SIGUSR1)
+        # grace period: the dump handler runs when the child's
+        # interpreter next executes bytecode — poll for the file rather
+        # than sleeping blind (a child wedged inside one long C call
+        # may never produce it; the report says so)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if set(glob.glob(os.path.join(obs_dir, "flight-*.json"))) \
+                    - stale:
+                time.sleep(1.0)        # let the write finish
+                break
+            time.sleep(0.5)
+        proc.kill()
+        proc.wait()
+    report["flight_dumps"] = _collect_dumps(obs_dir, exclude=stale)
+    if report["status"] == "hang" and not report["flight_dumps"]:
+        report["note"] = ("no dump appeared: the child never returned "
+                          "to the interpreter (wedged inside a C call "
+                          "— compiler RPC or device wait)")
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    # the bare [] entry lets the empty default pass the choices check
+    # (argparse on 3.10 validates the default list itself)
+    ap.add_argument("stages", nargs="*", default=[],
+                    choices=[*STAGES, []], metavar="stage",
+                    help=f"stages to run (default: all of {STAGES})")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="watchdog mode: per-stage budget in seconds; "
+                         "run each stage as a flight-recorded "
+                         "subprocess, SIGUSR1 + collect its dump on "
+                         "overrun")
+    ap.add_argument("--obs_dir", type=str, default=None,
+                    help="watchdog mode: where per-stage obs artifacts "
+                         "land (default: a temp dir, path printed)")
+    args = ap.parse_args(argv)
+    stages = args.stages or list(STAGES)
+    if args.timeout is None:
+        for stage in stages:        # classic in-process mode
+            run(stage)
+        return 0
+    obs_root = args.obs_dir or tempfile.mkdtemp(prefix="isolate_hang_")
+    log(f"watchdog mode: {args.timeout:.0f}s/stage, artifacts in "
+        f"{obs_root}")
+    reports = [_watch_stage(s, args.timeout, obs_root) for s in stages]
+    report_path = os.path.join(obs_root, "report.json")
+    with open(report_path, "w") as f:
+        json.dump(reports, f, indent=1, default=str)
+    log(f"report: {report_path}")
+    for r in reports:
+        summary = {k: r.get(k) for k in ("stage", "status", "returncode")}
+        summary["flight_dumps"] = [d.get("path")
+                                   for d in r["flight_dumps"]]
+        log(json.dumps(summary))
+    return 0 if all(r["status"] == "ok" for r in reports) else 1
+
+
 if __name__ == "__main__":
-    for stage in (sys.argv[1:] or ["plain", "smap", "gather"]):
-        run(stage)
+    sys.exit(main())
