@@ -36,7 +36,11 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 # consumers key on this instead of guessing from key presence.
 # v2: + schema_version, git_sha, rounds (per-round transfer records),
 #     obs (observability rollup, present only under FEDML_OBS_DIR)
-SCHEMA_VERSION = 2
+# v3: + h2d_bytes_per_round (transfer-compression byte accounting: mean
+#     host->device payload bytes per timed round — 0 on this
+#     resident-cohort bench, filled by streaming/block-stream variants);
+#     per-round records in "rounds" additionally carry "h2d_bytes"
+SCHEMA_VERSION = 3
 
 
 def _git_sha() -> str:
@@ -145,6 +149,7 @@ def main() -> None:
             # no-uploads convention nor the 0.0 transfer-bound reading
             # applies — consumers must not fold this row into trends
             "overlap_fraction": None,
+            "h2d_bytes_per_round": None,
             "error": "chip_unavailable",
             "detail": detail,
         })))
@@ -257,6 +262,13 @@ def main() -> None:
         "vs_baseline": round(rps / ESTIMATED_REFERENCE_ROUNDS_PER_SEC, 4),
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
+        # byte accounting (transfer-compression layer): mean H2D payload
+        # bytes per timed round, from the engine's per-instance counter
+        # (reset() above zeroed it after the one-time cohort upload) —
+        # 0 on this resident path; the stack-dtype A/B lives in
+        # tools/profile_bench.py exp_SD512
+        "h2d_bytes_per_round": round(
+            engine.transfer_stats.h2d_bytes / TIMED_ROUNDS, 1),
         # per-round transfer records (upload/wait/compute walls +
         # overlap, one dict per bracketed round): empty on this
         # resident-cohort path by design — streaming/block-stream bench

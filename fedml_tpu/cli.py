@@ -97,6 +97,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--comm_backend", type=str, default="TCP",
                    choices=("GRPC", "TCP", "NATIVE_TCP"))
     p.add_argument("--base_port", type=int, default=52000)
+    p.add_argument("--wire_transport", type=str, default="none",
+                   choices=("none", "bf16", "int8"),
+                   help="deployment mode: lossy wire dtype for the "
+                        "server->client model sync (wire codec v2, "
+                        "comm/message.py) — bf16 halves / int8 quarters "
+                        "the downlink model bytes; client uploads feed "
+                        "the aggregation and ALWAYS ride exact.  "
+                        "'none' (default) keeps every payload exact; "
+                        "FEDML_WIRE_V1=1 force-disables v2 framing "
+                        "process-wide (the escape hatch)")
+    p.add_argument("--wire_compress", action="store_true",
+                   help="deployment mode: zlib-compress the wire "
+                        "frame's header+small-array section (lossless; "
+                        "wire codec v2)")
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
     p.add_argument("--streaming", action="store_true",
                    help="host-resident client stack; upload only each "
@@ -115,14 +129,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "chain bf16 end-to-end, aggregation/globals stay "
                         "f32 (the measured v5e bench recipe, PERF.md)")
     p.add_argument("--stack_dtype", type=str, default=None,
-                   choices=("float32", "bfloat16"),
+                   choices=("float32", "bfloat16", "uint8"),
                    help="device storage dtype of the client stack's "
                         "INPUTS (mesh engines): bfloat16 halves the "
                         "cohort's HBM footprint and upload bytes — the "
                         "lever for >512 bench-shaped clients per chip "
                         "(measured knee 1.32x -> 1.06x at 1024; "
-                        "PERF.md); inputs at bf16 precision is an "
-                        "accuracy tradeoff")
+                        "PERF.md); uint8 stores image cohorts in their "
+                        "native 8-bit form (4x fewer bytes than f32, 2x "
+                        "fewer than bf16) with the per-dataset dequant "
+                        "fused into the jitted round program (PERF.md "
+                        "'Transfer compression').  Both are accuracy "
+                        "tradeoffs the user opts into; omit the flag "
+                        "for the exact f32 path")
     p.add_argument("--stream_block", type=int, default=None,
                    help="block-streamed rounds (FedAvg-family mesh "
                         "engines): upload the cohort in blocks of this "
@@ -216,7 +235,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _load(cfg: FedConfig):
+def _load(cfg: FedConfig, store_uint8: bool = False):
     from fedml_tpu.data import load_data
     return load_data(cfg.dataset, data_dir=cfg.data_dir,
                      client_num_in_total=cfg.client_num_in_total,
@@ -224,7 +243,15 @@ def _load(cfg: FedConfig):
                      partition_method=cfg.partition_method,
                      partition_alpha=cfg.partition_alpha,
                      max_batches_per_client=cfg.max_batches_per_client,
-                     seed=cfg.seed, synthetic_scale=cfg.synthetic_scale)
+                     seed=cfg.seed, synthetic_scale=cfg.synthetic_scale,
+                     store_uint8=store_uint8)
+
+
+# engines that consume the mesh cohort path's knobs (--stack_dtype,
+# --stream_block, ...) — the uint8 loader storage is gated on these so a
+# non-mesh engine can never receive a quantized stack it cannot dequant
+_STACK_DTYPE_ALGOS = ("fedavg", "fedopt", "fedprox", "fednova",
+                      "fedavg_robust")
 
 
 def _trainer(cfg: FedConfig, data, model_name: Optional[str] = None,
@@ -300,11 +327,21 @@ def _local_dtype(args):
 
 
 def _stack_dtype(args):
-    """--stack_dtype flag -> jnp dtype (None = store inputs as loaded)."""
-    if getattr(args, "stack_dtype", None) == "bfloat16":
-        import jax.numpy as jnp
+    """--stack_dtype flag -> jnp dtype (None/float32 = store inputs as
+    loaded).  Unknown values raise — argparse choices guard the CLI, but
+    programmatic callers (sweep drivers building Namespace objects by
+    hand) must not have a typo silently mean 'f32 stack'."""
+    v = getattr(args, "stack_dtype", None)
+    if v in (None, "float32"):
+        return None
+    import jax.numpy as jnp
+    if v == "bfloat16":
         return jnp.bfloat16
-    return None
+    if v == "uint8":
+        return jnp.uint8
+    raise SystemExit(
+        f"--stack_dtype {v!r} is not supported (choose float32, "
+        "bfloat16, or uint8)")
 
 
 def build_engine(args, cfg: FedConfig, data):
@@ -348,14 +385,12 @@ def build_engine(args, cfg: FedConfig, data):
         logging.getLogger(__name__).warning(
             "--mesh has no %s engine; running the single-device path", algo)
 
-    if args.stack_dtype and algo not in ("fedavg", "fedopt", "fedprox",
-                                         "fednova", "fedavg_robust"):
+    if args.stack_dtype and algo not in _STACK_DTYPE_ALGOS:
         logging.getLogger(__name__).warning(
             "--stack_dtype reaches only the FedAvg-family mesh engines; "
             "ignored by %s", algo)
     if args.stream_block is not None and (
-            mesh is None or algo not in ("fedavg", "fedopt", "fedprox",
-                                         "fednova", "fedavg_robust")):
+            mesh is None or algo not in _STACK_DTYPE_ALGOS):
         logging.getLogger(__name__).warning(
             "--stream_block reaches only the FedAvg-family MESH engines "
             "(needs --mesh); ignored by %s%s", algo,
@@ -603,8 +638,11 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
             jnp.asarray(data.client_shards["x"][0, 0]))
         agg = FedAvgAggregator(init_vars, size - 1,
                                cfg.client_num_in_total, size - 1)
-        server = FedAvgServerManager(agg, cfg.comm_round, 0, size,
-                                     args.comm_backend, **kw)
+        server = FedAvgServerManager(
+            agg, cfg.comm_round, 0, size, args.comm_backend,
+            model_transport=(None if args.wire_transport == "none"
+                             else args.wire_transport),
+            wire_compress=args.wire_compress, **kw)
         with graceful_abort(server):
             server.run_async()
             server.send_init_msg()
@@ -624,7 +662,8 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
 
     client = FedAvgClientManager(trainer, data, cfg.epochs, args.rank, size,
                                  args.comm_backend,
-                                 total_rounds=cfg.comm_round, **kw)
+                                 total_rounds=cfg.comm_round,
+                                 wire_compress=args.wire_compress, **kw)
     with graceful_abort(client):
         client.run()        # blocks until total_rounds uploads are done
     return 0
@@ -693,7 +732,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         _notify_sweep(args)
         return 0
 
-    data = _load(cfg)
+    # uint8 cohort storage starts at the LOADER when the engine will
+    # dequant on device: the stack never takes the f32 detour through
+    # host RAM (4x less resident than f32, and H2D moves the same u8
+    # bytes).  The mesh gate mirrors build_engine's --stack_dtype check.
+    store_u8 = (args.stack_dtype == "uint8" and args.mesh
+                and args.algorithm in _STACK_DTYPE_ALGOS)
+    data = _load(cfg, store_uint8=store_u8)
     eng = build_engine(args, cfg, data)
 
     import inspect

@@ -108,13 +108,24 @@ class FedAvgServerManager(ServerManager):
     def __init__(self, aggregator: FedAvgAggregator, comm_round: int,
                  rank: int = 0, size: int = 1, backend: str = "INPROC",
                  on_round_done: Optional[Callable[[int, Pytree], None]] = None,
-                 straggler_timeout: Optional[float] = None, **kw):
+                 straggler_timeout: Optional[float] = None,
+                 model_transport: Optional[str] = None,
+                 wire_compress: bool = False, **kw):
         """straggler_timeout: seconds to wait for the full cohort after a
         round's first upload; then aggregate the received subset and move
         on.  None = the reference's hang-forever barrier
-        (check_whether_all_receive, FedAVGAggregator.py:50-57)."""
+        (check_whether_all_receive, FedAVGAggregator.py:50-57).
+
+        model_transport: opt-in lossy wire dtype ("bf16"/"int8", wire
+        codec v2) for the DOWNLINK model_params payload only — the
+        client→server uploads feed the weighted average and stay exact
+        regardless; the synced model is a broadcast the next local round
+        re-trains anyway.  None (default) keeps every payload exact.
+        wire_compress: zlib the frame head (codec v2)."""
         super().__init__(rank, size, backend, **kw)
         self.aggregator = aggregator
+        self.model_transport = model_transport
+        self.wire_compress = wire_compress
         self.round_num = comm_round
         self.round_idx = 0
         self.on_round_done = on_round_done
@@ -136,6 +147,10 @@ class FedAvgServerManager(ServerManager):
                        self.aggregator.variables)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, client_idx)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+        if self.model_transport:
+            msg.set_wire_transport(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                                   self.model_transport)
+        msg.wire_compress = self.wire_compress
         self.send_message(msg)
 
     def register_message_receive_handlers(self) -> None:
@@ -210,13 +225,19 @@ class FedAvgClientManager(ClientManager):
 
     def __init__(self, trainer, data, epochs: int, rank: int, size: int,
                  backend: str = "INPROC", total_rounds: Optional[int] = None,
-                 **kw):
+                 wire_compress: bool = False, **kw):
         """total_rounds: in multi-PROCESS deployments the client must stop
         itself — it counts model syncs (the server sends exactly one per
         round, reference FedAvgClientManager.py:60-66) and finishes after
         uploading the last one.  None (in-process simulation) leaves
-        shutdown to the launcher."""
+        shutdown to the launcher.
+
+        The client's model upload is aggregation-critical (it feeds the
+        server's weighted average) and deliberately has NO transport
+        knob — it always rides exact; wire_compress only zlibs the
+        frame head (lossless)."""
         super().__init__(rank, size, backend, **kw)
+        self.wire_compress = wire_compress
         self.trainer = trainer
         self.data = data
         self.epochs = epochs
@@ -251,6 +272,7 @@ class FedAvgClientManager(ClientManager):
         out.add_params(MyMessage.MSG_ARG_KEY_LOCAL_LOSS, float(loss))
         if round_idx is not None:       # echo for stale-upload rejection
             out.add_params(MyMessage.MSG_ARG_KEY_ROUND, int(round_idx))
+        out.wire_compress = self.wire_compress
         self.send_message(out)
         self.rounds_seen += 1
         if (self.total_rounds is not None
@@ -269,6 +291,8 @@ def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",
     worker_num = worker_num or cfg.client_num_per_round
     size = worker_num + 1
     straggler_timeout = backend_kw.pop("straggler_timeout", None)
+    model_transport = backend_kw.pop("model_transport", None)
+    wire_compress = backend_kw.pop("wire_compress", False)
     router = backend_kw.pop("router", None)
     if backend.upper() == "INPROC" and router is None:
         router = InProcRouter()
@@ -281,9 +305,12 @@ def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",
     agg = FedAvgAggregator(init_vars, worker_num,
                            cfg.client_num_in_total, worker_num)
     server = FedAvgServerManager(agg, cfg.comm_round, 0, size, backend,
-                                 straggler_timeout=straggler_timeout, **kw)
+                                 straggler_timeout=straggler_timeout,
+                                 model_transport=model_transport,
+                                 wire_compress=wire_compress, **kw)
     clients = [FedAvgClientManager(trainer, data, cfg.epochs, r, size,
-                                   backend, **kw)
+                                   backend, wire_compress=wire_compress,
+                                   **kw)
                for r in range(1, size)]
     threads = [c.run_async() for c in clients] + [server.run_async()]
     server.send_init_msg()

@@ -89,6 +89,8 @@ class GrpcBackend(BaseCommManager):
         return self._stubs[receiver]
 
     def send_message(self, msg: Message) -> None:
+        # encode applies the v2 wire features (transport dtypes, zlib
+        # head); gRPC's unary call needs the one contiguous frame
         payload = MessageCodec.encode(msg)
         # wait_for_ready rides out the multi-process startup race (peer's
         # server not bound yet) instead of failing UNAVAILABLE immediately

@@ -31,7 +31,10 @@ class InProcRouter:
         """Deliver; returns the encoded frame size (0 when encode=False
         skips the codec) so both endpoints' byte counters agree."""
         nbytes = 0
-        if self.encode:   # exercise the wire codec even in-memory
+        if self.encode:   # exercise the wire codec even in-memory —
+            # including the v2 transport/compression features a sender
+            # opted into, so the simulation sees the same lossy values
+            # a socket deployment would
             payload = MessageCodec.encode(msg)
             nbytes = len(payload)
             msg = MessageCodec.decode(payload)

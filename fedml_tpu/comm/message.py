@@ -8,14 +8,37 @@ through MPI otherwise; here the default codec is a compact self-describing
 binary frame (JSON header + raw little-endian array buffers) that carries
 jax/numpy pytrees zero-copy, and `to_json` keeps the mobile-parity list
 form.
+
+Wire codec v2 (transfer-compression layer): the FedAvg round's dominant
+wire cost is raw f32 model buffers (the reference pays the same cost
+through MPI pickles/JSON — FedML arXiv:2007.13518; the Smart-NIC FL
+study arXiv:2307.06561 shows server-side comm handling dominating round
+latency at scale).  v2 adds, all OPT-IN per message key:
+
+* per-array transport dtypes — f32→bf16 (2x) or int8 + per-tensor
+  affine scale (4x) on the wire, restored to the original dtype on
+  decode.  Aggregation-critical payloads simply stay un-opted (exact,
+  bitwise round trip);
+* zlib compression of the header + small-array section;
+* a chunked streaming encoder (`encode_parts`) that hands the frame to
+  the socket as a prefix + per-buffer parts instead of materializing
+  the whole frame through `BytesIO.getvalue()`.
+
+Frames with no v2 feature active still encode as v1 ("FML1") — decode
+accepts both magics, so v2-aware peers interoperate with v1 frames in
+either direction.  FEDML_WIRE_V1=1 is the escape hatch: it forces v1
+frames (features ignored) process-wide, mirroring `--no_prefetch`.
 """
 from __future__ import annotations
 
-import io
 import json
-from typing import Any
+import os
+import zlib
+from typing import Any, Optional
 
 import numpy as np
+
+from fedml_tpu import obs
 
 
 class Message:
@@ -36,11 +59,31 @@ class Message:
         self.type = type
         self.sender_id = sender_id
         self.receiver_id = receiver_id
+        # send-side wire hints (NOT serialized; decode never restores
+        # them): per-key transport dtypes + frame compression, consumed
+        # by MessageCodec.encode_parts.  Default empty/off = v1 frame,
+        # bitwise-exact arrays.
+        self.wire_transport: dict[str, str] = {}
+        self.wire_compress: bool = False
         self.msg_params: dict[str, Any] = {
             Message.MSG_ARG_KEY_TYPE: type,
             Message.MSG_ARG_KEY_SENDER: sender_id,
             Message.MSG_ARG_KEY_RECEIVER: receiver_id,
         }
+
+    def set_wire_transport(self, key: str, kind: Optional[str]) -> None:
+        """Opt this message key's float arrays into a lossy wire dtype:
+        "bf16" (2x) or "int8" (4x, per-tensor affine scale).  None/"none"
+        clears the opt-in.  Keys never opted in ride exact — keep
+        aggregation-critical payloads (e.g. model averages) that way
+        unless the caller accepts the precision tradeoff."""
+        if kind in (None, "none"):
+            self.wire_transport.pop(key, None)
+            return
+        if kind not in ("bf16", "int8"):
+            raise ValueError(f"unknown wire transport {kind!r} "
+                             "(choose bf16 or int8)")
+        self.wire_transport[key] = kind
 
     # -- reference API (message.py:23-61) -----------------------------------
     def init(self, msg_params):
@@ -101,23 +144,61 @@ class Message:
         return cls().init(json.loads(payload))
 
 
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, reaching through ml_dtypes for the extension
+    dtypes plain numpy rejects (bfloat16 leaves arrive whenever a jax
+    bf16 array rides a Message)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise TypeError(f"undecodable array dtype {name!r}") from None
+
+
+def _bf16_dtype() -> np.dtype:
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+
+
 class MessageCodec:
-    """Binary wire format: 4-byte header length ‖ JSON header ‖ buffers.
+    """Binary wire format: magic ‖ header length ‖ JSON header ‖ buffers.
 
     Pytree leaves that are numpy/jax arrays are flattened into contiguous
     little-endian buffers referenced from the header by (path, dtype,
     shape, offset).  Everything else must be JSON-serializable.
+
+    v1 ("FML1"): 4B magic ‖ u64 LE header length ‖ JSON header ‖ raw
+    buffers, in array order.
+
+    v2 ("FML2"): 4B magic ‖ 1B flags ‖ u64 LE head length ‖ head ‖ big
+    buffers.  `head` is (zlib-compressed iff flags&1): u64 LE JSON
+    length ‖ JSON header ‖ small-array buffers (arrays ≤ SMALL_LIMIT
+    bytes ride inside the head so header+small arrays compress
+    together).  Array meta may carry an "enc" record describing a lossy
+    transport dtype ({"kind": "bf16"|"int8", "orig": dtype[, "scale",
+    "min"]}); decode restores the original dtype.  encode emits v1
+    whenever no v2 feature is active, so default traffic stays
+    byte-identical with older peers; decode accepts both magics.
     """
 
     MAGIC = b"FML1"
+    MAGIC_V2 = b"FML2"
+    FLAG_ZLIB = 0x01
+    SMALL_LIMIT = 1024          # arrays ≤ this ride in the head section
+    ENV_FORCE_V1 = "FEDML_WIRE_V1"   # escape hatch: ignore v2 features
 
     @staticmethod
-    def _flatten(obj, path, arrays, meta):
+    def _flatten(obj, path, arrays, meta, paths):
         if isinstance(obj, dict):
-            return {k: MessageCodec._flatten(v, f"{path}/{k}", arrays, meta)
+            return {k: MessageCodec._flatten(v, f"{path}/{k}", arrays,
+                                             meta, paths)
                     for k, v in obj.items()}
         if isinstance(obj, (list, tuple)):
-            out = [MessageCodec._flatten(v, f"{path}/{i}", arrays, meta)
+            out = [MessageCodec._flatten(v, f"{path}/{i}", arrays, meta,
+                                         paths)
                    for i, v in enumerate(obj)]
             return out if isinstance(obj, list) else {"__tuple__": out}
         if isinstance(obj, np.ndarray) or (
@@ -127,6 +208,7 @@ class MessageCodec:
             ref = len(arrays)
             arrays.append(a)
             meta.append({"dtype": str(a.dtype), "shape": list(a.shape)})
+            paths.append(path)
             return {"__array__": ref}
         if isinstance(obj, (np.integer,)):
             return int(obj)
@@ -148,34 +230,223 @@ class MessageCodec:
             return [MessageCodec._unflatten(v, buffers) for v in obj]
         return obj
 
-    @classmethod
-    def encode(cls, msg: Message) -> bytes:
-        arrays: list[np.ndarray] = []
-        meta: list[dict] = []
-        tree = cls._flatten(msg.msg_params, "", arrays, meta)
-        header = json.dumps({"tree": tree, "arrays": meta}).encode()
-        out = io.BytesIO()
-        out.write(cls.MAGIC)
-        out.write(len(header).to_bytes(8, "little"))
-        out.write(header)
-        for a in arrays:
-            out.write(a.tobytes())
-        return out.getvalue()
+    # -- transport dtypes ----------------------------------------------------
+    @staticmethod
+    def _transport_kind(path: str, transport: dict) -> Optional[str]:
+        for key, kind in transport.items():
+            pre = "/" + key
+            if path == pre or path.startswith(pre + "/"):
+                return kind
+        return None
+
+    @staticmethod
+    def _encode_transport(a: np.ndarray, kind: str, m: dict) -> np.ndarray:
+        """Lossy wire encoding of one float array; updates its meta
+        record in place.  Non-float (and non-finite int8 candidates)
+        stay exact — a silent fallback beats a corrupt quantization."""
+        if not np.issubdtype(a.dtype, np.floating):
+            return a
+        if kind == "bf16":
+            if a.dtype == _bf16_dtype():
+                return a                       # already bf16 on the wire
+            w = a.astype(_bf16_dtype())
+            m["dtype"] = str(w.dtype)
+            m["enc"] = {"kind": "bf16", "orig": str(a.dtype)}
+            return w
+        # int8 + per-tensor affine: q = round((x - min)/scale) - 128
+        if a.size == 0 or not np.all(np.isfinite(a)):
+            return a
+        mn = float(np.min(a))
+        mx = float(np.max(a))
+        scale = (mx - mn) / 255.0 or 1.0
+        q = np.clip(np.rint((a.astype(np.float64) - mn) / scale) - 128,
+                    -128, 127).astype(np.int8)
+        m["dtype"] = "int8"
+        m["enc"] = {"kind": "int8", "orig": str(a.dtype),
+                    "scale": scale, "min": mn}
+        return q
+
+    @staticmethod
+    def _decode_transport(a: np.ndarray, enc: Optional[dict]) -> np.ndarray:
+        if not enc:
+            return a
+        orig = _np_dtype(enc.get("orig", "float32"))
+        if enc["kind"] == "bf16":
+            return a.astype(orig)
+        if enc["kind"] == "int8":
+            return ((a.astype(np.float64) + 128.0) * enc["scale"]
+                    + enc["min"]).astype(orig)
+        raise ValueError(f"unknown wire transport encoding "
+                         f"{enc.get('kind')!r}")
+
+    # -- encode --------------------------------------------------------------
+    @staticmethod
+    def _buf(a: np.ndarray):
+        """Byte view of a contiguous array for the socket — zero-copy
+        when the buffer protocol allows, tobytes() otherwise (ml_dtypes
+        extension formats refuse the memoryview cast)."""
+        try:
+            return a.data.cast("B")
+        except (TypeError, ValueError, BufferError):
+            return a.tobytes()
 
     @classmethod
-    def decode(cls, payload: bytes) -> Message:
-        assert payload[:4] == cls.MAGIC, "bad frame magic"
-        hlen = int.from_bytes(payload[4:12], "little")
-        header = json.loads(payload[12:12 + hlen].decode())
-        off = 12 + hlen
-        buffers = []
-        for m in header["arrays"]:
-            dt = np.dtype(m["dtype"])
-            count = int(np.prod(m["shape"], dtype=np.int64)) if m["shape"] else 1
+    def encode_parts(cls, msg: Message) -> tuple[int, list]:
+        """Chunked streaming encoder: returns (total_len, parts) where
+        `parts` is a list of bytes-like objects whose concatenation is
+        the frame.  Stream-capable backends (tcp) sendall() each part —
+        the multi-GB frame never exists as one contiguous buffer; the
+        others join.  Emits a v1 frame when no v2 feature is active (or
+        FEDML_WIRE_V1=1 forces it)."""
+        arrays: list[np.ndarray] = []
+        meta: list[dict] = []
+        paths: list[str] = []
+        tree = cls._flatten(msg.msg_params, "", arrays, meta, paths)
+        raw_bytes = sum(a.nbytes for a in arrays)
+
+        force_v1 = os.environ.get(cls.ENV_FORCE_V1, "") not in ("", "0")
+        transport = {} if force_v1 else getattr(msg, "wire_transport", {})
+        compress = (not force_v1) and getattr(msg, "wire_compress", False)
+
+        if transport:
+            for i, (a, m, p) in enumerate(zip(arrays, meta, paths)):
+                kind = cls._transport_kind(p, transport)
+                if kind is not None:
+                    arrays[i] = cls._encode_transport(a, kind, m)
+
+        if not transport and not compress:       # plain v1 frame
+            header = json.dumps({"tree": tree, "arrays": meta}).encode()
+            parts = [cls.MAGIC + len(header).to_bytes(8, "little")
+                     + header]
+            parts += [cls._buf(a) for a in arrays]
+            total = sum(len(p) if isinstance(p, (bytes, bytearray))
+                        else p.nbytes for p in parts)
+            cls._account(raw_bytes + len(header) + 12, total)
+            return total, parts
+
+        small = [a.nbytes <= cls.SMALL_LIMIT for a in arrays]
+        for m, s in zip(meta, small):
+            if s:
+                m["small"] = True
+        header = json.dumps({"tree": tree, "arrays": meta}).encode()
+        head = b"".join(
+            [len(header).to_bytes(8, "little"), header]
+            + [a.tobytes() for a, s in zip(arrays, small) if s])
+        flags = 0
+        if compress:
+            head = zlib.compress(head)
+            flags |= cls.FLAG_ZLIB
+        parts = [cls.MAGIC_V2 + bytes([flags])
+                 + len(head).to_bytes(8, "little") + head]
+        parts += [cls._buf(a) for a, s in zip(arrays, small) if not s]
+        total = sum(len(p) if isinstance(p, (bytes, bytearray))
+                    else p.nbytes for p in parts)
+        cls._account(raw_bytes + len(header) + 13, total)
+        return total, parts
+
+    @staticmethod
+    def _account(raw: int, wire: int) -> None:
+        """Compression accounting (always-on metrics, fedml_tpu/obs):
+        raw = what the arrays+header would weigh uncompressed, wire =
+        actual frame bytes; comm_compression_ratio is the cumulative
+        raw/wire quotient."""
+        c_raw = obs.counter("comm_raw_bytes_total")
+        c_wire = obs.counter("comm_compressed_bytes_total")
+        c_raw.inc(raw)
+        c_wire.inc(wire)
+        wired = c_wire.value
+        if wired > 0:
+            obs.gauge("comm_compression_ratio").set(c_raw.value / wired)
+
+    @classmethod
+    def encode(cls, msg: Message) -> bytes:
+        """One contiguous frame (bytes.join accepts the memoryview
+        parts directly).  Backends that need a single buffer (gRPC
+        unary, native fh_send, inproc) call THIS — frame assembly has
+        exactly one definition."""
+        return b"".join(cls.encode_parts(msg)[1])
+
+    # -- decode --------------------------------------------------------------
+    @staticmethod
+    def _read_buffers(payload, metas, off: int, writable: bool,
+                      out: list) -> int:
+        for m, a_out in metas:
+            dt = _np_dtype(m["dtype"])
+            count = (int(np.prod(m["shape"], dtype=np.int64))
+                     if m["shape"] else 1)
             nbytes = count * dt.itemsize
+            if off + nbytes > len(payload):
+                raise ValueError(
+                    f"truncated frame: array needs {nbytes} bytes at "
+                    f"offset {off}, payload has {len(payload)}")
             a = np.frombuffer(payload, dtype=dt, count=count,
                               offset=off).reshape(m["shape"])
-            buffers.append(a)
+            if writable and not m.get("enc"):
+                # np.frombuffer views are read-only; decoded pytree
+                # leaves must survive in-place mutation downstream.
+                # (transport-decoded arrays below are fresh already)
+                a = a.copy()
+            out[a_out] = MessageCodec._decode_transport(a, m.get("enc"))
             off += nbytes
+        return off
+
+    @classmethod
+    def decode(cls, payload: bytes, writable: bool = True) -> Message:
+        """Decode a v1 or v2 frame.  `writable=True` (default) copies
+        each array out of the frame so leaves are mutable; False keeps
+        the v1/big-buffer arrays as read-only zero-copy views into
+        `payload` (cheapest, but in-place mutation raises).  The copy
+        is a deliberate correctness default — np.frombuffer views blew
+        up downstream mutators — at the cost of one transient extra
+        copy per leaf while `payload` is still referenced; receivers of
+        very large frames that only READ the tree (or immediately
+        jnp.asarray it) can pass writable=False to keep the zero-copy
+        profile."""
+        magic = bytes(payload[:4])
+        if magic == cls.MAGIC:
+            hoff, flags = 4, 0
+        elif magic == cls.MAGIC_V2:
+            hoff, flags = 5, payload[4]
+        else:
+            raise ValueError(f"bad frame magic {magic!r} (expected "
+                             f"{cls.MAGIC!r} or {cls.MAGIC_V2!r})")
+        if len(payload) < hoff + 8:
+            raise ValueError("truncated frame: missing header length")
+        hlen = int.from_bytes(payload[hoff:hoff + 8], "little")
+        off = hoff + 8
+        if off + hlen > len(payload):
+            raise ValueError(
+                f"truncated frame: header declares {hlen} bytes, payload "
+                f"has {len(payload) - off} after the length field")
+        if magic == cls.MAGIC:
+            header = json.loads(payload[off:off + hlen].decode())
+            buffers: list = [None] * len(header["arrays"])
+            cls._read_buffers(payload, [(m, i) for i, m in
+                                        enumerate(header["arrays"])],
+                              off + hlen, writable, buffers)
+        else:
+            head = payload[off:off + hlen]
+            if flags & cls.FLAG_ZLIB:
+                try:
+                    head = zlib.decompress(head)
+                except zlib.error as e:
+                    raise ValueError(f"corrupt compressed head: {e}") \
+                        from None
+            if len(head) < 8:
+                raise ValueError("truncated frame: head too short")
+            jlen = int.from_bytes(head[:8], "little")
+            if 8 + jlen > len(head):
+                raise ValueError("truncated frame: head JSON overruns")
+            header = json.loads(head[8:8 + jlen].decode())
+            metas = header["arrays"]
+            buffers = [None] * len(metas)
+            # small arrays live in the head; big ones follow it
+            cls._read_buffers(head,
+                              [(m, i) for i, m in enumerate(metas)
+                               if m.get("small")], 8 + jlen, True, buffers)
+            cls._read_buffers(payload,
+                              [(m, i) for i, m in enumerate(metas)
+                               if not m.get("small")], off + hlen,
+                              writable, buffers)
         params = cls._unflatten(header["tree"], buffers)
         return Message().init(params)

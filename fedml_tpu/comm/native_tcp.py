@@ -96,6 +96,9 @@ class NativeTcpBackend(BaseCommManager):
         return c
 
     def send_message(self, msg: Message) -> None:
+        # encode applies the v2 wire features (transport dtypes, zlib
+        # head); fh_send frames one contiguous buffer, so the chunked
+        # send stays a pure-Python-TCP feature
         payload = MessageCodec.encode(msg)
         rx = msg.get_receiver_id()
         # the whole connect+send (and the dead-connection retry) runs under

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -135,8 +135,16 @@ class SplitNNClientManager(ClientManager):
     def __init__(self, compute: SplitClientCompute, params, opt_state,
                  train_shard: dict, test_shard: dict, rank: int,
                  max_rank: int, epochs: int, server_rank: int = 0,
-                 backend: str = "INPROC", **kw):
+                 backend: str = "INPROC",
+                 act_transport: Optional[str] = None, **kw):
+        """act_transport: opt-in lossy wire dtype ("bf16"/"int8", wire
+        codec v2) for the per-batch ACTIVATION payload — the protocol
+        crosses the process boundary twice per minibatch, so this is
+        where split training's wire bytes live.  Labels/masks stay
+        exact (they feed the loss/metric sums); the gradient downlink
+        is the server's symmetric knob.  None (default) = exact."""
         super().__init__(rank, max_rank + 1, backend, **kw)
+        self.act_transport = act_transport
         self.compute = compute
         self.params, self.opt_state = params, opt_state
         self.train_shard, self.test_shard = train_shard, test_shard
@@ -188,6 +196,9 @@ class SplitNNClientManager(ClientManager):
         # a train batch handled in 'validation' never gets its gradients
         # back and that client deadlocks
         m.add_params(SplitNNMessage.MSG_ARG_KEY_PHASE, self.phase)
+        if self.act_transport:
+            m.set_wire_transport(SplitNNMessage.MSG_ARG_KEY_ACTS,
+                                 self.act_transport)
         self.send_message(m)
         self.batch_idx += 1
 
@@ -234,8 +245,13 @@ class SplitNNServerManager(ServerManager):
     stats, rotates the active node on validation-over."""
 
     def __init__(self, compute: SplitServerCompute, params, opt_state,
-                 max_rank: int, rank: int = 0, backend: str = "INPROC", **kw):
+                 max_rank: int, rank: int = 0, backend: str = "INPROC",
+                 grad_transport: Optional[str] = None, **kw):
+        """grad_transport: the downlink twin of the client's
+        act_transport — opt-in lossy wire dtype for the per-batch
+        activation-gradient reply (wire codec v2); None = exact."""
         super().__init__(rank, max_rank + 1, backend, **kw)
+        self.grad_transport = grad_transport
         self.compute = compute
         self.params, self.opt_state = params, opt_state
         self.max_rank = max_rank
@@ -277,6 +293,9 @@ class SplitNNServerManager(ServerManager):
                             msg.get_sender_id())
             reply.add_params(SplitNNMessage.MSG_ARG_KEY_GRADS,
                              np.asarray(ga))
+            if self.grad_transport:
+                reply.set_wire_transport(SplitNNMessage.MSG_ARG_KEY_GRADS,
+                                         self.grad_transport)
             self.send_message(reply)
             # a train batch reordered past a VALIDATION_MODE reset must not
             # pollute the validation accumulators

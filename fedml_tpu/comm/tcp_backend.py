@@ -105,11 +105,19 @@ class TcpBackend(BaseCommManager):
         return s
 
     def send_message(self, msg: Message) -> None:
-        payload = MessageCodec.encode(msg)
+        # chunked streaming send: the codec hands back a frame prefix +
+        # one part per array buffer, and each part goes to the socket
+        # directly — a multi-GB model frame is never materialized as one
+        # contiguous buffer (the old encode() + concat path transiently
+        # held ~3x the payload: arrays + BytesIO + the length-prefixed
+        # copy)
+        total, parts = MessageCodec.encode_parts(msg)
         sock = self._connect(msg.get_receiver_id())
         with self._conn_lock:
-            sock.sendall(struct.pack("<Q", len(payload)) + payload)
-        self._obs_sent(len(payload))
+            sock.sendall(struct.pack("<Q", total))
+            for part in parts:
+                sock.sendall(part)
+        self._obs_sent(total)
 
     def close(self) -> None:
         self._alive = False

@@ -105,6 +105,11 @@ class FederatedData:
     test_client_shards: Optional[dict[str, np.ndarray]]  # [C, Bt, bs, ...] or None
     class_num: int
     synthetic: bool = False   # True when a stand-in replaced missing files
+    # set when client_shards["x"] is stored uint8 (data/quant.py): the
+    # affine spec (x_f32 = u*scale + offset) the mesh engines fuse into
+    # the jitted round program as its first op.  Eval shards
+    # (train_global/test_global/test_client_shards) always stay float.
+    x_dequant: Optional[object] = None
     _device_cache: dict = dataclasses.field(default_factory=dict, repr=False)
 
     @property

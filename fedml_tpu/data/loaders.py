@@ -135,11 +135,61 @@ def load_data(dataset: str,
               partition_alpha: float = 0.5,
               max_batches_per_client: Optional[int] = None,
               seed: int = 0,
-              synthetic_scale: float = 1.0) -> FederatedData:
+              synthetic_scale: float = 1.0,
+              store_uint8: bool = False) -> FederatedData:
     """Load (or synthesize) a federated dataset.
 
     `synthetic_scale` < 1 shrinks synthetic stand-ins for fast tests.
+
+    `store_uint8` keeps the TRAIN client stack's input leaf in uint8
+    with a `DequantSpec` on `FederatedData.x_dequant` (data/quant.py) —
+    the transfer-compression storage the mesh engines dequantize
+    on-device (`--stack_dtype uint8`): 4x fewer host RAM / H2D bytes
+    than f32 stacks.  For the normalize_image datasets (cifar10/100,
+    cinic10) the stored bytes ARE the raw pixels (exact round trip);
+    elsewhere a per-tensor min/max affine is used.  Eval shards
+    (train_global/test_global/test_client_shards) always stay float —
+    only the cohort path pays transfer at scale.
     """
+    fd = _load_data(dataset, data_dir, client_num_in_total, batch_size,
+                    partition_method, partition_alpha,
+                    max_batches_per_client, seed, synthetic_scale)
+    if store_uint8:
+        from fedml_tpu.data import quant
+        spec = None
+        if not fd.synthetic:
+            # normalize_image datasets: dequant spec derived from the
+            # normalization constants, so the uint8 storage is exactly
+            # the raw pixels (lossless round trip)
+            if dataset in ("cifar10", "cinic10"):
+                spec = quant.spec_from_normalize(CIFAR10_MEAN, CIFAR10_STD)
+            elif dataset == "cifar100":
+                spec = quant.spec_from_normalize(CIFAR100_MEAN,
+                                                 CIFAR100_STD)
+        x = fd.client_shards.get("x")
+        if x is not None and np.issubdtype(np.asarray(x).dtype,
+                                           np.floating):
+            spec = spec or quant.spec_from_minmax(x)
+            fd.client_shards["x"] = quant.quantize_uint8(x, spec)
+            fd.x_dequant = spec
+        else:
+            import logging
+            logging.getLogger(__name__).warning(
+                "store_uint8 ignored for %s: the input leaf is %s "
+                "(integer token ids must not be quantized)", dataset,
+                None if x is None else np.asarray(x).dtype)
+    return fd
+
+
+def _load_data(dataset: str,
+               data_dir: Optional[str] = None,
+               client_num_in_total: Optional[int] = None,
+               batch_size: Optional[int] = None,
+               partition_method: str = "hetero",
+               partition_alpha: float = 0.5,
+               max_batches_per_client: Optional[int] = None,
+               seed: int = 0,
+               synthetic_scale: float = 1.0) -> FederatedData:
     if dataset not in SPECS:
         raise ValueError(f"unknown dataset {dataset!r}; known: {sorted(SPECS)}")
     spec = SPECS[dataset]

@@ -287,8 +287,24 @@ class MeshFedAvgEngine(FedAvgEngine):
         # lose exactness past 256 — sample counts feed the aggregation
         # weights).  Opt-in: inputs at bf16 precision is an accuracy
         # tradeoff the user chooses (tests pin closeness to f32).
+        #
+        # stack_dtype=uint8 is the transfer-compression tier below bf16
+        # (PERF.md "Transfer compression"): the input leaf is stored as
+        # uint8 + an affine DequantSpec (data/quant.py) — 4x fewer H2D
+        # bytes than f32, 2x fewer than bf16 — and the dequantize
+        # (u*scale + offset, f32) is FUSED into the jitted round program
+        # as the first op of the block/chunk scan (_dequant_chunk_x via
+        # the restore_x hook), so local training still runs the
+        # committed float compute recipe.  A loader-quantized stack
+        # (load_data store_uint8 / data.x_dequant) passes through as-is;
+        # a float stack is quantized ONCE here with a min/max spec.
         self.stack_dtype = stack_dtype
         self._stack_dtype_noop_warned = False
+        self._x_dequant = None          # DequantSpec when the stack is u8
+        self._u8_host_shards = None     # quantized host view (data stays
+        #                                 untouched — it may be shared)
+        self._stack_u8 = (stack_dtype is not None
+                          and np.dtype(stack_dtype) == np.dtype(np.uint8))
         self.mesh = mesh if mesh is not None else make_mesh()
         # a "batch" mesh axis splits each client's per-step batch over
         # devices (per-client sample parallelism: mesh.py BATCH_AXIS, the
@@ -324,6 +340,15 @@ class MeshFedAvgEngine(FedAvgEngine):
         self.stream_block = stream_block
         self.streaming = streaming
         self.local_dtype = local_dtype
+        # a loader-quantized stack (store_uint8) arrives uint8 with its
+        # spec on the data object: honor it even without the knob — the
+        # dequant is a correctness requirement, not a preference
+        if (not self._stack_u8 and getattr(data, "x_dequant", None)
+                is not None and "x" in data.client_shards
+                and np.asarray(data.client_shards["x"]).dtype == np.uint8):
+            self._stack_u8 = True
+        if self._stack_u8:
+            self._prepare_uint8_stack(data)
         super().__init__(trainer, data, cfg, donate=donate)
         self._stack = None           # sharded client stack, uploaded lazily
         self._stack_weights = None
@@ -376,12 +401,57 @@ class MeshFedAvgEngine(FedAvgEngine):
         return avg_variables, server_state
 
     # -- device data ----------------------------------------------------------
+    def _prepare_uint8_stack(self, data) -> None:
+        """uint8 cohort storage (stack_dtype=uint8): resolve the dequant
+        spec and the uint8 HOST view of the client stack, ONCE at
+        construction.  A loader-quantized stack (data.x_dequant) passes
+        through; a float stack is quantized here with a min/max spec —
+        into a separate view, never mutating `data` (test oracles and
+        sibling engines share the data object).  Eager so the spec is
+        set on the construction thread before any jit trace or prefetch
+        worker reads it."""
+        from fedml_tpu.data.quant import quantize_uint8, spec_from_minmax
+        shards = data.client_shards
+        x = np.asarray(shards["x"]) if "x" in shards else None
+        if x is None or (x.dtype != np.uint8
+                         and not np.issubdtype(x.dtype, np.floating)):
+            self._stack_u8 = False
+            if x is not None and not self._stack_dtype_noop_warned:
+                self._stack_dtype_noop_warned = True
+                log.warning(
+                    "stack_dtype=uint8 ignored: the input leaf is %s "
+                    "(integer token-id datasets must not be quantized)",
+                    x.dtype)
+            return
+        if x.dtype == np.uint8:
+            spec = getattr(data, "x_dequant", None)
+            if spec is None:
+                raise ValueError(
+                    "client stack x is uint8 but data.x_dequant is unset: "
+                    "a uint8 stack needs its DequantSpec (load_data "
+                    "store_uint8=True sets it)")
+            self._u8_host_shards = shards
+        else:
+            spec = spec_from_minmax(x)
+            self._u8_host_shards = {**shards, "x": quantize_uint8(x, spec)}
+        self._x_dequant = spec
+
+    def _host_shards(self) -> dict:
+        """The host-side client stack every upload path gathers from:
+        the uint8-quantized view when stack_dtype=uint8, else the data's
+        own shards."""
+        return (self._u8_host_shards if self._u8_host_shards is not None
+                else self.data.client_shards)
+
     def _cast_stack_x(self, shards: dict) -> dict:
         """Apply stack_dtype to the input leaf (see __init__); identity
         when unset — and for INTEGER inputs (token ids on the text
         datasets): bf16 represents integers exactly only up to 256, so
-        casting ids would silently remap most of a 10k vocabulary."""
-        if self.stack_dtype is not None and "x" in shards:
+        casting ids would silently remap most of a 10k vocabulary.
+        The uint8 tier never casts here: `_host_shards` is already
+        quantized (once, at construction)."""
+        if (self.stack_dtype is not None and not self._stack_u8
+                and "x" in shards):
             if np.issubdtype(np.asarray(shards["x"]).dtype, np.floating):
                 shards = dict(shards)
                 shards["x"] = np.asarray(shards["x"],
@@ -399,24 +469,46 @@ class MeshFedAvgEngine(FedAvgEngine):
                 self._x_image_shape = image_shape
         return shards
 
+    def _dequant_chunk_x(self, shards: dict) -> dict:
+        """In-program dequantize of a uint8 input slice — the FIRST op
+        of the block/chunk scan body (after the flat_stack restore, so a
+        per-channel spec broadcasts over [..., h, w, c]).  Identity when
+        the stack is not quantized, and for float leaves (the local-eval
+        fallback stacks stay f32)."""
+        if self._x_dequant is None or "x" not in shards:
+            return shards
+        x = shards["x"]
+        if not jnp.issubdtype(x.dtype, jnp.integer):
+            return shards
+        scale = jnp.asarray(self._x_dequant.scale, jnp.float32)
+        offset = jnp.asarray(self._x_dequant.offset, jnp.float32)
+        return {**shards, "x": x.astype(jnp.float32) * scale + offset}
+
     def _restore_chunk_x(self, chunk_shards: dict) -> dict:
-        """Undo flat_stack on one in-scan chunk slice (restore_chunk_x)."""
-        return restore_chunk_x(self._x_image_shape, chunk_shards)
+        """Undo flat_stack on one in-scan chunk slice (restore_chunk_x),
+        then dequantize a uint8 slice — O(chunk) memory either way."""
+        return self._dequant_chunk_x(
+            restore_chunk_x(self._x_image_shape, chunk_shards))
 
     def _local_eval_transform(self, shard: dict) -> dict:
         """Per-client shard hook inside evaluate_local's vmap (shared
-        flat_stack restore guard — restore_flat_eval_shard)."""
-        return restore_flat_eval_shard(self._x_image_shape, shard)
+        flat_stack restore guard — restore_flat_eval_shard — plus the
+        uint8 dequant when the resident stack is quantized)."""
+        return self._dequant_chunk_x(
+            restore_flat_eval_shard(self._x_image_shape, shard))
 
     def _device_stack(self):
         """Upload the [C,...] client stack ONCE, leading axis sharded over the
         mesh (C padded to a mesh-size multiple with zero-weight clients)."""
         if self._stack is None:
             from fedml_tpu.parallel.mesh import pad_cohort
-            shards, weights = self.data.client_shards, self.data.client_num_samples
+            shards, weights = self._host_shards(), self.data.client_num_samples
             shards, weights = pad_cohort(
                 self._cast_stack_x(dict(shards)),
                 np.asarray(weights, np.float32), self.n_shards)
+            self.transfer_stats.add_h2d_bytes(
+                sum(np.asarray(v).nbytes for v in shards.values())
+                + weights.nbytes)
             self._stack = shard_stack(self.mesh, shards)
             self._stack_weights = jax.device_put(
                 weights.astype(np.float32), client_sharding(self.mesh))
@@ -518,11 +610,16 @@ class MeshFedAvgEngine(FedAvgEngine):
     def _host_gather_upload(self, ids) -> dict:
         """THE host-gather upload pipeline (shared by stream_cohort and
         _upload_block so the two streaming granularities can never
-        diverge): slice the host arrays, apply stack_dtype/flat_stack
-        (_cast_stack_x), async device_put with per-leaf sharding."""
+        diverge): slice the host arrays (the uint8 view when the stack
+        is quantized — compressed bytes are what cross H2D), apply
+        stack_dtype/flat_stack (_cast_stack_x), async device_put with
+        per-leaf sharding.  Every byte handed to device_put lands in
+        the engine_h2d_bytes_total accounting."""
         host = self._cast_stack_x(
             {k: np.take(np.asarray(v), ids, axis=0)
-             for k, v in self.data.client_shards.items()})
+             for k, v in self._host_shards().items()})
+        self.transfer_stats.add_h2d_bytes(
+            sum(v.nbytes for v in host.values()))
         return {k: jax.device_put(v, stack_leaf_sharding(self.mesh, v))
                 for k, v in host.items()}
 
@@ -543,10 +640,10 @@ class MeshFedAvgEngine(FedAvgEngine):
         with obs.span("h2d.upload_cohort", clients=len(ids)), \
                 self.transfer_stats.uploading():
             cohort = self._host_gather_upload(ids)
-            weights = jax.device_put(
-                np.take(np.asarray(self.data.client_num_samples,
-                                   np.float32), ids) * wmask,
-                client_sharding(self.mesh))
+            w = np.take(np.asarray(self.data.client_num_samples,
+                                   np.float32), ids) * wmask
+            self.transfer_stats.add_h2d_bytes(w.nbytes)
+            weights = jax.device_put(w, client_sharding(self.mesh))
         return cohort, weights
 
     # -- block-streamed round (stream_block) ---------------------------------
@@ -579,6 +676,8 @@ class MeshFedAvgEngine(FedAvgEngine):
         with obs.span("h2d.upload_block", clients=len(ids_blk)), \
                 self.transfer_stats.uploading():
             block = self._host_gather_upload(ids_blk)
+            self.transfer_stats.add_h2d_bytes(
+                np.asarray(w_blk).nbytes + np.asarray(rngs_blk).nbytes)
             weights = jax.device_put(w_blk, client_sharding(self.mesh))
             rngs = jax.device_put(rngs_blk, client_sharding(self.mesh))
         return block, weights, rngs
@@ -1231,6 +1330,7 @@ class MeshRobustEngine(MeshFedAvgEngine):
                     buf = np.zeros((K, pb), np.float32)
                     buf[:, :xb.shape[1]] = xb
                     xb = buf
+                self.transfer_stats.add_h2d_bytes(K * pb * 4)
                 return jax.device_put(xb, self._param_sharding())
 
         if self.defense in ("krum", "multi_krum"):

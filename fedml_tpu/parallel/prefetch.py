@@ -27,8 +27,16 @@ while round r computes.
 Thread-safety: jax dispatch (device_put included) is thread-safe; the
 producer thread touches only host numpy data and enqueue-side jax
 calls.  Every upload lands in the engine's TransferOverlapStats
-(utils/profiling.py) from whichever thread runs it, and consumer-side
-blocking waits are recorded so overlap_fraction is measurable.
+(utils/profiling.py) from whichever thread runs it — walls AND payload
+bytes (`add_h2d_bytes`, the transfer-compression accounting: the engine
+counts each host buffer it hands to device_put, so uint8/bf16 stacks
+report their real H2D reduction per round) — and consumer-side blocking
+waits are recorded so overlap_fraction is measurable.
+
+The pipeline is dtype-agnostic by construction: a uint8-quantized block
+(stack_dtype=uint8) rides the same produce()/get() contract at 1/4 the
+f32 bytes, which shrinks exactly the upload wall this double buffer
+exists to hide.
 """
 from __future__ import annotations
 

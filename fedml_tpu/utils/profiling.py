@@ -131,15 +131,37 @@ class TransferOverlapStats:
         self._h_overlap = obs.histogram(
             "engine_round_overlap_fraction",
             buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0))
+        # byte accounting (transfer-compression layer): every host
+        # buffer the engine hands to device_put counts here, so the
+        # stack-dtype tiers (f32/bf16/uint8) are comparable as BYTES,
+        # not just walls — bench.py surfaces h2d_bytes_per_round from
+        # this, and the registry counter is the Prometheus view
+        self._m_h2d_bytes = obs.counter("engine_h2d_bytes_total")
         self.reset()
 
     def reset(self) -> None:
         with self._lock:
             self._upload_wall = 0.0
             self._wait_wall = 0.0
+            self._h2d_bytes = 0
             self._round_t0: Optional[float] = None
-            self._snap = (0.0, 0.0)
+            self._snap = (0.0, 0.0, 0)
             self.rounds: list[dict] = []
+
+    def add_h2d_bytes(self, nbytes: int) -> None:
+        """Record host→device payload bytes (called by the engine upload
+        paths where the host buffer sizes are known — any thread)."""
+        n = int(nbytes)
+        with self._lock:
+            self._h2d_bytes += n
+        self._m_h2d_bytes.inc(n)
+
+    @property
+    def h2d_bytes(self) -> int:
+        """Cumulative H2D payload bytes since reset() (per-engine view;
+        engine_h2d_bytes_total is the process-wide counter)."""
+        with self._lock:
+            return self._h2d_bytes
 
     @contextlib.contextmanager
     def uploading(self) -> Iterator[None]:
@@ -174,7 +196,8 @@ class TransferOverlapStats:
         if self._round_t0 is not None:
             self.round_end()
         with self._lock:
-            self._snap = (self._upload_wall, self._wait_wall)
+            self._snap = (self._upload_wall, self._wait_wall,
+                          self._h2d_bytes)
         self._round_t0 = time.perf_counter()
 
     def round_end(self) -> Optional[dict]:
@@ -187,10 +210,12 @@ class TransferOverlapStats:
         with self._lock:
             up = self._upload_wall - self._snap[0]
             wait = self._wait_wall - self._snap[1]
+            h2d = self._h2d_bytes - self._snap[2]
         rec = {"round_wall_s": wall, "upload_wall_s": up,
                "wait_wall_s": wait,
                "compute_wall_s": max(wall - wait, 0.0),
-               "overlap_fraction": _overlap_fraction(up, wait)}
+               "overlap_fraction": _overlap_fraction(up, wait),
+               "h2d_bytes": h2d}
         self.rounds.append(rec)
         self._m_rounds.inc()
         self._h_round.observe(wall)
@@ -204,6 +229,7 @@ class TransferOverlapStats:
     def report(self) -> dict:
         with self._lock:
             up, wait = self._upload_wall, self._wait_wall
+            h2d = self._h2d_bytes
         return {"upload_wall_s": up, "wait_wall_s": wait,
                 "overlap_fraction": _overlap_fraction(up, wait),
-                "rounds": len(self.rounds)}
+                "h2d_bytes": h2d, "rounds": len(self.rounds)}

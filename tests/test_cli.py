@@ -174,6 +174,28 @@ def test_cli_stack_dtype_flag(tmp_path):
     with pytest.raises(SystemExit):      # requires --mesh
         run_cli(tmp_path / "e", "--algorithm", "fedavg", "--dataset",
                 "mnist", "--model", "lr", "--stack_dtype", "bfloat16")
+    # uint8: the loader stores the stack quantized (store_uint8) and the
+    # engine dequantizes in-program — the run must still train
+    s = run_cli(tmp_path / "u8", "--algorithm", "fedavg", "--dataset",
+                "mnist", "--model", "lr", "--lr", "0.1", "--mesh",
+                "--streaming", "--stack_dtype", "uint8")
+    assert "test_acc" in s
+
+
+def test_cli_stack_dtype_rejects_unknown():
+    """_stack_dtype must REJECT unknown values (the old mapper silently
+    turned any non-bfloat16 string into the f32 path) — argparse guards
+    the CLI, but programmatic Namespace callers hit the helper
+    directly."""
+    import argparse
+    from fedml_tpu.cli import _stack_dtype
+    assert _stack_dtype(argparse.Namespace(stack_dtype=None)) is None
+    assert _stack_dtype(argparse.Namespace(stack_dtype="float32")) is None
+    import jax.numpy as jnp
+    assert _stack_dtype(
+        argparse.Namespace(stack_dtype="uint8")) == jnp.uint8
+    with pytest.raises(SystemExit, match="stack_dtype"):
+        _stack_dtype(argparse.Namespace(stack_dtype="float16"))
 
 
 def test_cli_batch_unroll_flag(tmp_path):

@@ -252,3 +252,42 @@ def test_mqtt_via_manager_dispatch():
     st.join(timeout=10)
     assert log == [42]
     cli.finish()
+
+
+def test_mqtt_wire_compress_optin():
+    """Wire codec v2's zlib opt-in on the broker path: a wire_compress
+    message publishes an FMLZ-prefixed zlib payload (smaller than the
+    raw nested-list JSON for model-sized arrays) and decodes to the
+    same values; plain messages stay raw JSON."""
+    broker = FakeBroker()
+    sent = []
+    orig_publish = broker.publish
+
+    def spy_publish(topic, payload):
+        sent.append(payload)
+        orig_publish(topic, payload)
+
+    broker.publish = spy_publish
+    server = MqttBackend(0, 2, client_factory=broker.client_factory)
+    client = MqttBackend(1, 2, client_factory=broker.client_factory)
+    try:
+        w = np.linspace(0.0, 1.0, 512).astype(np.float32).reshape(32, 16)
+        msg = Message(2, 0, 1)
+        msg.add_params("w", w)
+        msg.wire_compress = True
+        server.send_message(msg)
+        got = client._inbox.get(timeout=5)
+        np.testing.assert_allclose(np.asarray(got.get("w")), w, atol=1e-6)
+        assert sent[-1][:4] == b"FMLZ"
+        raw_len = len(Message(2, 0, 1).init(msg.msg_params)
+                      .to_json().encode())
+        assert len(sent[-1]) < raw_len          # it actually compressed
+        # un-opted messages keep the plain JSON wire form
+        plain = Message(2, 0, 1)
+        plain.add_params("n", 7)
+        server.send_message(plain)
+        assert sent[-1][:1] == b"{"
+        assert client._inbox.get(timeout=5).get("n") == 7
+    finally:
+        server.close()
+        client.close()

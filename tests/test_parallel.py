@@ -353,6 +353,73 @@ def test_stack_dtype_bf16_close_to_f32():
     assert cohort["x"].dtype == jnp.int32
 
 
+def test_stack_dtype_uint8_close_to_f32():
+    """uint8 cohort storage (the transfer-compression tier below bf16,
+    PERF.md 'Transfer compression'): the input leaf is quantized ONCE on
+    host to uint8 + an affine DequantSpec, crosses H2D at 1/4 the f32
+    bytes, and the dequantize is fused into the jitted round program as
+    the first op of the chunk scan — training stays close to the
+    f32-stack run on both the resident and streaming paths.  The data
+    object itself must stay untouched (sibling engines share it), and
+    integer token-id inputs must never be quantized."""
+    cfg = _mnist_like_cfg(comm_round=3)
+    trainer, data = _setup(cfg)
+    ref = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=False)
+    v0 = ref.init_variables()
+    v_f32 = ref.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for streaming in (False, True):
+        eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                               donate=False, streaming=streaming,
+                               stack_dtype=jnp.uint8)
+        assert eng._x_dequant is not None
+        if streaming:
+            cohort, _w = eng.stream_cohort(0)
+        else:
+            cohort, _w = eng._device_stack()
+        assert cohort["x"].dtype == jnp.uint8
+        assert cohort["mask"].dtype == jnp.float32
+        # the shared data object keeps its float stack — quantization
+        # lives in the engine's private view
+        assert np.issubdtype(np.asarray(data.client_shards["x"]).dtype,
+                             np.floating)
+        v_u8 = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+        for a, b in zip(jax.tree.leaves(v_f32), jax.tree.leaves(v_u8)):
+            assert a.dtype == b.dtype       # globals keep the f32 grid
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0.05, atol=0.02)
+
+    # loader-quantized stacks (load_data store_uint8) carry their spec
+    # on the data object and pass through without a second quantization
+    from fedml_tpu.data.loaders import load_data
+    u8_data = load_data(cfg.dataset,
+                        client_num_in_total=cfg.client_num_in_total,
+                        batch_size=cfg.batch_size, synthetic_scale=0.02,
+                        seed=cfg.seed, store_uint8=True)
+    assert u8_data.client_shards["x"].dtype == np.uint8
+    assert u8_data.x_dequant is not None
+    # eval shards stay float (they never ride the cohort path)
+    assert np.issubdtype(u8_data.test_global["x"].dtype, np.floating)
+    eng = MeshFedAvgEngine(trainer, u8_data, cfg, mesh=make_mesh(8),
+                           donate=False, stack_dtype=jnp.uint8)
+    assert eng._host_shards() is u8_data.client_shards
+    v_ld = eng.run(variables=jax.tree.map(jnp.copy, v0), rounds=3)
+    for a, b in zip(jax.tree.leaves(v_f32), jax.tree.leaves(v_ld)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.05, atol=0.02)
+
+    # INTEGER inputs: uint8 quantization is refused, not applied
+    int_data = _setup(cfg)[1]
+    int_data.client_shards["x"] = np.asarray(
+        (np.abs(int_data.client_shards["x"][..., :1]) * 1000), np.int32)
+    eng = MeshFedAvgEngine(trainer, int_data, cfg, mesh=make_mesh(8),
+                           donate=False, streaming=True,
+                           stack_dtype=jnp.uint8)
+    assert eng._x_dequant is None
+    cohort, _w = eng.stream_cohort(0)
+    assert cohort["x"].dtype == jnp.int32
+
+
 @pytest.mark.parametrize("defense", ["median", "krum", "trimmed_mean",
                                      "multi_krum"])
 def test_mesh_orderstat_defense_matches_single_device(defense):

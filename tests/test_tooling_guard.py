@@ -1,4 +1,5 @@
-"""CI-config guard: pyproject's pytest addopts must stay xdist-free.
+"""CI/tooling guards: pyproject's pytest addopts must stay xdist-free,
+and bench.py's JSON line must keep its schema contract.
 
 An unconditional `-n auto` in addopts once killed EVERY pytest run in
 this image — pytest-xdist is not installed here, so pytest dies with
@@ -11,6 +12,7 @@ import os
 import re
 
 PYPROJECT = os.path.join(os.path.dirname(__file__), "..", "pyproject.toml")
+BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
 
 
 def _addopts() -> str:
@@ -37,3 +39,25 @@ def test_addopts_never_hardcodes_xdist():
     assert "--dist" not in tokens and "--maxprocesses" not in tokens, (
         f"addopts={opts!r} carries xdist-only companions that fail "
         "without the plugin")
+
+
+def test_bench_json_schema_carries_byte_accounting():
+    """BENCH_*.json trajectory consumers key on schema_version; the
+    transfer-compression fields (h2d_bytes_per_round in the JSON line,
+    h2d_bytes in the per-round records via TransferOverlapStats) landed
+    in v3 — a refactor that drops them or forgets the version bump
+    would silently fork the trajectory format.  Static source check:
+    running the bench needs a chip."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert m, "bench.py lost its SCHEMA_VERSION constant"
+    assert int(m.group(1)) >= 3, (
+        "bench schema must stay >= v3 (byte accounting)")
+    assert '"h2d_bytes_per_round"' in src, (
+        "bench.py JSON line lost the h2d_bytes_per_round field "
+        "(schema v3 byte accounting)")
+    # the per-round records inherit h2d_bytes from the profiler
+    prof = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "utils", "profiling.py")).read()
+    assert '"h2d_bytes"' in prof, (
+        "TransferOverlapStats round records lost the h2d_bytes field")

@@ -1,0 +1,216 @@
+"""Wire codec v2 tests: v1↔v2 frame compatibility, per-key transport
+dtypes, zlib frame compression, the chunked streaming encoder, decode
+hardening (magic/truncation → ValueError, writable leaves), and the
+messaging layers' opt-in wiring.  Pure host — no jit, no sockets (the
+socket paths ride the same encode_parts via test_comm's loopbacks).
+"""
+import numpy as np
+import pytest
+
+from fedml_tpu.comm.message import Message, MessageCodec
+
+
+def _rand_tree(seed: int):
+    """A nested params-shaped tree mixing dtypes the FL payloads carry —
+    bfloat16 exercises the np.dtype("bfloat16")/ml_dtypes path on
+    decode, uint8/int8 the quantized-cohort leaves."""
+    import ml_dtypes
+    rs = np.random.RandomState(seed)
+    return {
+        "dense": {"kernel": rs.randn(7, 5).astype(np.float32),
+                  "bias": rs.randn(5).astype(np.float64)},
+        "bf16_w": rs.randn(4, 3).astype(ml_dtypes.bfloat16),
+        "pixels": rs.randint(0, 256, (2, 8, 8)).astype(np.uint8),
+        "q": rs.randint(-128, 128, (11,)).astype(np.int8),
+        "nested": [rs.randint(0, 9, (3,)).astype(np.int32), "a string",
+                   7, 3.5, None, True],
+        "tup": (rs.randn(2, 2).astype(np.float32), 42),
+        "scalar": np.float32(1.25),
+    }
+
+
+def _assert_tree_equal(a, b):
+    if isinstance(a, dict):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            _assert_tree_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert type(a) is type(b) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_tree_equal(x, y)
+    elif isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    else:
+        assert a == b
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_codec_roundtrip_property(seed):
+    """Exact round trip over nested dicts/tuples/scalars with bf16,
+    uint8, int8, f32, f64 leaves — bitwise, dtype- and type-preserving
+    (scalars become Python numbers, the documented v1 behavior)."""
+    msg = Message(3, sender_id=2, receiver_id=1)
+    tree = _rand_tree(seed)
+    msg.add_params("model_params", tree)
+    out = MessageCodec.decode(MessageCodec.encode(msg))
+    assert out.get_sender_id() == 2 and out.get_receiver_id() == 1
+    got = out.get("model_params")
+    # np scalars serialize to Python numbers (v1 contract)
+    tree = dict(tree)
+    tree["scalar"] = 1.25
+    _assert_tree_equal(tree, got)
+
+
+def test_codec_default_emits_v1_and_decodes_v1():
+    """No v2 feature active → byte-level v1 frame (old peers keep
+    decoding our traffic), and a hand-built v1 frame decodes (we keep
+    decoding theirs)."""
+    import json
+    msg = Message(1, 0, 1)
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    msg.add_params("w", w)
+    frame = MessageCodec.encode(msg)
+    assert frame[:4] == b"FML1"
+    # a v1 frame assembled exactly as the pre-v2 encoder wrote it
+    header = json.dumps({
+        "tree": {"msg_type": 1, "sender": 0, "receiver": 1,
+                 "w": {"__array__": 0}},
+        "arrays": [{"dtype": "float32", "shape": [2, 3]}]}).encode()
+    legacy = (b"FML1" + len(header).to_bytes(8, "little") + header
+              + w.tobytes())
+    out = MessageCodec.decode(legacy)
+    np.testing.assert_array_equal(out.get("w"), w)
+
+
+def test_codec_v2_transport_and_compression():
+    """Transport-opted keys shrink and restore to the original dtype
+    within quantization error; un-opted keys stay bitwise; zlib head
+    compression round-trips; v2 frames carry the FML2 magic."""
+    rs = np.random.RandomState(0)
+    w = rs.randn(128, 64).astype(np.float32)
+    exact = rs.randn(1000).astype(np.float32)
+    for kind, tol in (("bf16", 0.01 * np.max(np.abs(w))),
+                      ("int8", (w.max() - w.min()) / 510 + 1e-6)):
+        msg = Message(1, 0, 1)
+        msg.add_params("w", {"layer": w})
+        msg.add_params("exact", exact)
+        msg.add_params("note", "tiny")      # small array/str in the head
+        msg.set_wire_transport("w", kind)
+        msg.wire_compress = True
+        frame = MessageCodec.encode(msg)
+        assert frame[:4] == b"FML2"
+        ratio = {"bf16": 2, "int8": 4}[kind]
+        # opted payload shrinks ~ratio; exact payload stays full-width
+        assert len(frame) < w.nbytes / ratio + exact.nbytes + 2048
+        out = MessageCodec.decode(frame)
+        got = out.get("w")["layer"]
+        assert got.dtype == np.float32
+        assert np.max(np.abs(got - w)) <= tol
+        np.testing.assert_array_equal(out.get("exact"), exact)  # bitwise
+        assert out.get("note") == "tiny"
+
+
+def test_codec_chunked_parts_match_joined_frame():
+    """encode_parts is the streaming path: the parts' concatenation IS
+    the frame, total_len is exact, and decode accepts it — for both v1
+    and v2 framings."""
+    msg = Message(1, 0, 1)
+    msg.add_params("w", np.arange(100, dtype=np.float32))
+    for compress in (False, True):
+        msg.wire_compress = compress
+        total, parts = MessageCodec.encode_parts(msg)
+        frame = b"".join(bytes(p) for p in parts)
+        assert len(frame) == total
+        np.testing.assert_array_equal(
+            MessageCodec.decode(frame).get("w"),
+            np.arange(100, dtype=np.float32))
+
+
+def test_codec_decode_is_writable_by_default():
+    """np.frombuffer yields read-only views; decoded pytree leaves must
+    survive in-place mutation (the aggregator mutates received trees).
+    writable=False keeps the zero-copy read-only views for callers that
+    want them."""
+    msg = Message(1, 0, 1)
+    msg.add_params("w", np.zeros((4, 4), np.float32))
+    payload = MessageCodec.encode(msg)
+    got = MessageCodec.decode(payload).get("w")
+    got += 1.0                          # must not raise
+    assert got[0, 0] == 1.0
+    ro = MessageCodec.decode(payload, writable=False).get("w")
+    assert not ro.flags.writeable
+    with pytest.raises(ValueError):
+        ro += 1.0
+
+
+def test_codec_decode_hardening():
+    """Bad magic and truncated frames raise ValueError (never a bare
+    assert — it vanishes under python -O — nor a frombuffer crash)."""
+    msg = Message(1, 0, 1)
+    msg.add_params("w", np.arange(32, dtype=np.float32))
+    frame = MessageCodec.encode(msg)
+    with pytest.raises(ValueError, match="magic"):
+        MessageCodec.decode(b"XXXX" + frame[4:])
+    # truncated inside the header
+    with pytest.raises(ValueError, match="truncated"):
+        MessageCodec.decode(frame[:20])
+    # header intact, array buffers truncated
+    with pytest.raises(ValueError, match="truncated"):
+        MessageCodec.decode(frame[:-8])
+    # same guarantees for v2 frames
+    msg.wire_compress = True
+    v2 = MessageCodec.encode(msg)
+    with pytest.raises(ValueError, match="truncated"):
+        MessageCodec.decode(v2[:-8])
+    with pytest.raises(ValueError):
+        MessageCodec.decode(v2[:6])
+
+
+def test_codec_force_v1_escape_hatch(monkeypatch):
+    """FEDML_WIRE_V1=1 ignores every v2 feature process-wide — the
+    --no_prefetch-style escape hatch: frames come out v1 and bitwise
+    exact even when a sender opted into transport compression."""
+    w = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    msg = Message(1, 0, 1)
+    msg.add_params("w", w)
+    msg.set_wire_transport("w", "int8")
+    msg.wire_compress = True
+    monkeypatch.setenv("FEDML_WIRE_V1", "1")
+    frame = MessageCodec.encode(msg)
+    assert frame[:4] == b"FML1"
+    np.testing.assert_array_equal(MessageCodec.decode(frame).get("w"), w)
+
+
+def test_fedavg_messaging_transport_wiring():
+    """The FedAvg server's model sync honors model_transport on exactly
+    the model_params key (round/client_idx metadata must stay exact),
+    and the client upload path has no lossy knob at all."""
+    from fedml_tpu.comm.fedavg_messaging import FedAvgAggregator, MyMessage
+
+    agg = FedAvgAggregator(
+        {"params": {"w": np.random.RandomState(0).randn(32, 8)
+                    .astype(np.float32)}}, 1, 4, 1)
+    sent = []
+
+    class Spy:           # stand-in for the manager's send path
+        def send_message(self, msg):
+            sent.append(msg)
+
+    from fedml_tpu.comm.fedavg_messaging import FedAvgServerManager
+    srv = FedAvgServerManager.__new__(FedAvgServerManager)
+    srv.rank, srv.round_idx = 0, 0
+    srv.aggregator, srv.model_transport = agg, "bf16"
+    srv.wire_compress = True
+    srv.send_message = lambda m: sent.append(m)
+    srv._send_model(1, MyMessage.MSG_TYPE_S2C_INIT_CONFIG, 3)
+    (msg,) = sent
+    assert msg.wire_transport == {MyMessage.MSG_ARG_KEY_MODEL_PARAMS:
+                                  "bf16"}
+    assert msg.wire_compress
+    out = MessageCodec.decode(MessageCodec.encode(msg))
+    assert out.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX) == 3   # exact
+    w = agg.variables["params"]["w"]
+    got = out.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)["params"]["w"]
+    assert got.dtype == np.float32
+    assert 0 < np.max(np.abs(got - w)) <= 0.01 * np.max(np.abs(w))

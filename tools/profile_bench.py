@@ -268,6 +268,44 @@ def exp_PF512():
               f"{_overlap_line(engine)}  loss {loss:.4f}", flush=True)
 
 
+def exp_SD512():
+    """Stack-dtype A/B (the transfer-compression tentpole acceptance):
+    the SAME 512-client block-streamed round (block 64, bench recipe)
+    with f32 vs bf16 vs uint8 cohort storage.  uint8 should halve the
+    H2D bytes again vs bf16 (4x vs f32 on the x leaf; the engine's
+    byte counter reports the exact payload), and on the
+    transfer-bound tunnel the round wall should track bytes — on a
+    real chip the ratio prices in as cohort-per-chip headroom
+    (PERF.md 'Transfer compression').  Queued for the next chip
+    window."""
+    import jax
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    C, BLOCK, ROUNDS = 512, 64, 2
+    for sd, tag in ((None, "f32"), (jnp.bfloat16, "bf16"),
+                    (jnp.uint8, "u8")):
+        cfg, data, trainer = _bench_workload(C)
+        engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(),
+                                  chunk=2, local_dtype=jnp.bfloat16,
+                                  stack_dtype=sd, stream_block=BLOCK,
+                                  donate=False)
+        variables = engine.init_variables()
+        server_state = engine.server_init(variables)
+        rng = jax.random.PRNGKey(0)
+        engine.round_fn(variables, server_state, 0, rng)   # compile
+        engine.transfer_stats.reset()
+        t0 = time.perf_counter()
+        for r in range(ROUNDS):
+            v, s, m = engine.round_fn(variables, server_state, r, rng)
+        loss = float(m["train_loss"])                      # sync barrier
+        dt = (time.perf_counter() - t0) / ROUNDS
+        gb = engine.transfer_stats.h2d_bytes / ROUNDS / 1e9
+        print(f"SD512 {tag} block-stream({BLOCK}/block): {dt:.3f}s/round  "
+              f"{gb:.3f} GB/round H2D  {_overlap_line(engine)}  "
+              f"loss {loss:.4f}", flush=True)
+
+
 def _robust_workload(C: int):
     """CNN-femnist-shaped workload for the order-stat experiments (the
     model class these defenses are used with — MeshRobustEngine
