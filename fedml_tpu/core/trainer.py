@@ -377,6 +377,18 @@ class ClientTrainer:
                 variables=variables,
                 opt_state=tree_vary_noop(self.init_opt(variables), shard),
                 rng=rng)
+            # NOTE on the carry layout (PR-4 copy audit): packing this
+            # TrainState carry's float leaves into per-dtype flat
+            # vectors (the engine.py flatten_carry_f32 treatment) was
+            # built and MEASURED here, and kept OUT: it removes the
+            # per-leaf donated-param staging copies at scan entry (once
+            # per chunk trip) but forces every conv wgrad through a
+            # relayout copy FEEDING the concat (per step) — audited on
+            # the CNN round program at +224 KB static copy bytes net
+            # (tools/hlo_copy_audit.py; per-step > per-entry).  The
+            # chunked cohort loops DO pack their accumulator carries,
+            # where the update is a plain elementwise add and packing
+            # only removes copies.
 
             def batch_body(state, batch):
                 state, loss = self.train_step(state, batch, global_params)

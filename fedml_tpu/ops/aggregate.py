@@ -191,6 +191,11 @@ def robust_weighted_mean_pallas(stacked: Pytree, weights: jax.Array,
         out_specs=pl.BlockSpec((1, TILE), lambda i: (0, i),
                                memory_space=_VMEM),
         out_shape=jax.ShapeDtypeStruct((1, N), jnp.float32),
+        # the output rides the gflat buffer: same [1, N] f32 shape, gflat
+        # is dead after this call (the sq pass above already consumed
+        # it), and each grid step reads its g tile into VMEM before the
+        # o tile stores back — one less HBM allocation per aggregation
+        input_output_aliases={2: 0},
         interpret=interpret,
     )(cf.reshape(1, C), flat, gflat)
     return unflatten_to_tree(out[0], spec)

@@ -63,6 +63,54 @@ def weighted_acc(w):
         "k,k...->...", w, v.astype(jnp.float32))
 
 
+def weighted_sum_tree(w, tree):
+    """Σₖ wₖ·vₖ over a [k, ...]-stacked pytree, per leaf, in f32 — the
+    same cast-and-einsum policy as weighted_acc, without the carry add
+    (the chunked loops accumulate the result into their FLAT carry)."""
+    return jax.tree.map(
+        lambda v: jnp.einsum("k,k...->...", w, v.astype(jnp.float32)), tree)
+
+
+def flatten_carry_f32(tree):
+    """Pack an (unstacked) pytree into ONE [P] f32 vector + unflatten
+    spec — THE scan-carry layout for the chunked cohort loops.
+
+    Why: a pytree carry gives XLA one while-loop buffer per leaf, and
+    any leaf whose in-loop producer prefers a different layout than the
+    carry (e.g. the einsum's transposed output vs the row-major carry)
+    gets a relayout `copy` EVERY scan trip — the round-2b trace's
+    scan-carry copy category (PERF.md), reproduced structurally on CPU
+    by tools/hlo_copy_audit.py (a params-shaped copy per trip in the
+    block step).  A single 1-D f32 buffer has exactly one layout, so the
+    carry aliases across trips and the per-leaf adds fuse into one
+    concatenated update.  Exact: ravel+concat reorder nothing, each
+    element sees the same adds in the same order as the per-leaf carry."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32), tree
+    if len(leaves) == 1:
+        flat = leaves[0].astype(jnp.float32).reshape(-1)
+    else:
+        flat = jnp.concatenate(
+            [l.astype(jnp.float32).reshape(-1) for l in leaves])
+    return flat, tree
+
+
+def unflatten_carry_f32(flat, spec_tree):
+    """Undo flatten_carry_f32: [P] f32 vector back to the pytree of
+    `spec_tree`'s leaf shapes (f32 — the chunk-loop accumulators stay
+    f32; callers apply their own ref-dtype cast when dividing)."""
+    leaves, treedef = jax.tree.flatten(spec_tree)
+    if not leaves:
+        return spec_tree
+    out, off = [], 0
+    for l in leaves:
+        size = int(np.prod(l.shape)) if l.ndim else 1
+        out.append(flat[off:off + size].reshape(l.shape))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
 def pad_ids(ids: np.ndarray, n_shards: int):
     """THE cohort-padding policy (host side): pad sampled client ids to a
     mesh-size multiple with zero-weight repeats of client 0 — wmask=0
@@ -182,7 +230,7 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
         return v, loss
 
     def chunk_body(carry, xs):
-        num, den, lsum = carry
+        num_flat, den, lsum = carry
         cs, cw, cr = xs
         if restore_x is not None:      # flat_stack: image shape back,
             cs = restore_x(cs)         # O(chunk) per trip
@@ -190,16 +238,24 @@ def chunked_weighted_train(trainer, variables, cohort, weights, rngs,
         if client_transform is not None:
             vs = jax.vmap(client_transform,
                           in_axes=(0, 0, None))(vs, cw, variables)
-        num = jax.tree.map(weighted_acc(cw), num, vs)
+        # Σ w·v per leaf, folded into the ONE-vector f32 carry: a pytree
+        # carry gets per-leaf relayout copies every scan trip (the
+        # round-2b copy category — see flatten_carry_f32)
+        num_flat = num_flat + flatten_carry_f32(
+            weighted_sum_tree(cw, vs))[0]
         ys = (flatten_stacked_tree(vs["params"])[0]
               if emit_flat_params else None)
-        return (num, den + jnp.sum(cw), lsum + jnp.sum(losses * cw)), ys
+        return (num_flat, den + jnp.sum(cw),
+                lsum + jnp.sum(losses * cw)), ys
 
-    zeros = pvary_tree(jax.tree.map(
-        lambda a: jnp.zeros(a.shape, jnp.float32), variables), vary_axes)
+    zeros = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                         variables)
+    zeros_flat, num_spec = flatten_carry_f32(zeros)
+    zeros_flat = pvary_tree(zeros_flat, vary_axes)
     zf = pvary_tree(jnp.float32(0), vary_axes)
-    (num, den, lsum), flats = jax.lax.scan(
-        chunk_body, (zeros, zf, zf), (cohort, weights, rngs))
+    (num_flat, den, lsum), flats = jax.lax.scan(
+        chunk_body, (zeros_flat, zf, zf), (cohort, weights, rngs))
+    num = unflatten_carry_f32(num_flat, num_spec)
     if emit_flat_params:
         return num, den, lsum, flats
     return num, den, lsum
@@ -357,12 +413,24 @@ class MeshFedAvgEngine(FedAvgEngine):
         self.round_fn = jax.jit(self._mesh_round,
                                 donate_argnums=(0, 1) if donate else ())
         # streaming variant: the gather happened on host; cohort arrives
-        # pre-sharded [K, ...] with K = padded cohort size
+        # pre-sharded [K, ...] with K = padded cohort size.  This public
+        # entry donates variables/server_state ONLY — bench.py and the
+        # convergence tools upload one cohort and replay it for every
+        # round, so the cohort args must survive the call.
         self.round_fn_streaming = jax.jit(
             self._mesh_round_streaming,
             donate_argnums=(0, 1) if donate else ())
+        # ...but the run() loop gathers a FRESH cohort every round
+        # (_round_args), each consumed exactly once — donate it too, so
+        # a retired cohort's HBM is recycled into the round instead of
+        # sitting next to the prefetched next one (same rationale as the
+        # block-step input donation; results are bitwise donate-on/off,
+        # pinned in tests/test_parallel_stream.py)
+        self._round_fn_streaming_consume = jax.jit(
+            self._mesh_round_streaming,
+            donate_argnums=(0, 1, 2, 3) if donate else ())
         if streaming:
-            self.round_fn = self.round_fn_streaming
+            self.round_fn = self._round_fn_streaming_consume
         if self.stream_block is not None:
             if self.stream_block < 1 or self.stream_block % self.n_shards:
                 raise ValueError(
@@ -949,30 +1017,39 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
         g_params, _ = self._split(local_vars)
 
         def chunk_body(carry, xs):
-            dsum, rest_num, den, tsum, lsum = carry
+            dflat, rflat, den, tsum, lsum = carry
             cs, cw, cr = xs
             cs = self._restore_chunk_x(cs)      # flat_stack (engine.py)
             vs, losses, taus = jax.vmap(one)(cs, cr)
             v_params, v_rest = self._split(vs)
             # params: Σ w·(g − v)/τ  (zero-weight pad lanes contribute 0)
+            # — folded into flat f32 carries like chunked_weighted_train
+            # (flatten_carry_f32: one 1-D buffer per carry, no per-leaf
+            # relayout copies across scan trips)
             coef = cw / jnp.maximum(taus, 1.0)
-            dsum = jax.tree.map(
-                lambda acc, g, v: weighted_acc(coef)(
-                    acc, g[None].astype(jnp.float32)
-                    - v.astype(jnp.float32)),
-                dsum, g_params, v_params)
+            d_chunk = jax.tree.map(
+                lambda g, v: jnp.einsum(
+                    "k,k...->...", coef,
+                    g[None].astype(jnp.float32) - v.astype(jnp.float32)),
+                g_params, v_params)
+            dflat = dflat + flatten_carry_f32(d_chunk)[0]
             # stats collections: plain weighted mean, like FedAvg
-            rest_num = jax.tree.map(weighted_acc(cw), rest_num, v_rest)
-            return (dsum, rest_num, den + jnp.sum(cw),
+            rflat = rflat + flatten_carry_f32(
+                weighted_sum_tree(cw, v_rest))[0]
+            return (dflat, rflat, den + jnp.sum(cw),
                     tsum + jnp.sum(cw * taus),
                     lsum + jnp.sum(losses * cw)), None
 
         zp, zr = self._split(jax.tree.map(
             lambda a: jnp.zeros(a.shape, jnp.float32), variables))
-        zp, zr = pvary_tree(zp, axes), pvary_tree(zr, axes)
+        zpf, d_spec = flatten_carry_f32(zp)
+        zrf, r_spec = flatten_carry_f32(zr)
+        zpf, zrf = pvary_tree(zpf, axes), pvary_tree(zrf, axes)
         zf = pvary_tree(jnp.float32(0), axes)
-        (dsum, rest_num, den, tsum, lsum), _ = jax.lax.scan(
-            chunk_body, (zp, zr, zf, zf, zf), (ch_cohort, ch_w, ch_r))
+        (dflat, rflat, den, tsum, lsum), _ = jax.lax.scan(
+            chunk_body, (zpf, zrf, zf, zf, zf), (ch_cohort, ch_w, ch_r))
+        dsum = unflatten_carry_f32(dflat, d_spec)
+        rest_num = unflatten_carry_f32(rflat, r_spec)
         return (jax.lax.psum(dsum, axes), jax.lax.psum(rest_num, axes),
                 jax.lax.psum(den, axes), jax.lax.psum(tsum, axes),
                 jax.lax.psum(lsum, axes))
@@ -1065,11 +1142,25 @@ class MeshRobustEngine(MeshFedAvgEngine):
                 self._block_step_flats = jax.jit(
                     self._block_step_flats_impl,
                     donate_argnums=(1, 2, 3, 4))
-                self._colstat = jax.jit(self._colstat_impl)
-                self._gram = jax.jit(self._gram_impl)
+                # phase-2 [K, Pb] slices are uploaded fresh per call and
+                # consumed exactly once — donate them, so a retired
+                # slice's device memory recycles instead of stacking
+                # next to the in-flight one (the O(K·Pb) bound).  Gated
+                # on the donate flag (unlike the pre-existing always-
+                # donated sums) so donate=False stays a complete
+                # escape hatch and the bitwise donate-A/B pin really
+                # compiles these programs both ways
+                self._colstat = jax.jit(
+                    self._colstat_impl,
+                    donate_argnums=(0,) if self.donate else ())
+                self._gram = jax.jit(
+                    self._gram_impl,
+                    donate_argnums=(0,) if self.donate else ())
+                # new_flat (argnum 3) is engine-internal and dead after
+                # the finalize — donated with the flag too
                 self._orderstat_finalize = jax.jit(
                     self._orderstat_finalize_impl,
-                    donate_argnums=(0, 1, 2) if self.donate else (2,))
+                    donate_argnums=(0, 1, 2, 3) if self.donate else (2,))
                 self.round_fn = self._round_blockstream_orderstat
 
     def client_transform(self, client_variables, weight, global_variables):

@@ -11,6 +11,11 @@
 #   2. nwp_convergence       — LSTM vs TransformerLM chip training
 #   3. profile_bench C4096B  — 4096-client block-streamed round
 #   4. profile_bench OS256/OSB256 — order-stat resident vs streamed
+#   5. profile_bench DN128   — donate on/off + restructured-carry A/B
+#      (ISSUE 4: prices the scan-carry/donation copy category the
+#      round-2b trace measured at ~0.13 s/round)
+#   6. profile_bench PF512/SD512 — prefetch + stack-dtype A/Bs (PR 1/3
+#      backlog, still tunnel-gated)
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-runs/chip_queue_$(date +%m%d_%H%M)}"
@@ -22,19 +27,25 @@ if ! timeout 180 python -c "import jax; assert jax.devices()[0].platform in ('tp
   echo "chip unavailable; aborting queue"; exit 1
 fi
 
-echo "== 1/4 bench.py"
+echo "== 1/6 bench.py"
 timeout 1500 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.json"
 
-echo "== 2/4 nwp_convergence (600 rounds, vocab 10004 — must match the"
+echo "== 2/6 nwp_convergence (600 rounds, vocab 10004 — must match the"
 echo "   600-round band pinned in test_quality_regression.py)"
 timeout 3600 python tools/nwp_convergence.py 600 \
     --out benchmarks/nwp_convergence_r5.json 2>"$OUT/nwp.err" \
     | tee "$OUT/nwp.log"
 
-echo "== 3/4 profile_bench C4096B (block-streamed 4096 clients)"
+echo "== 3/6 profile_bench C4096B (block-streamed 4096 clients)"
 timeout 5400 python tools/profile_bench.py C4096B 2>&1 | tee "$OUT/c4096b.log"
 
-echo "== 4/4 profile_bench OS256 OSB256 (order-stat timing)"
+echo "== 4/6 profile_bench OS256 OSB256 (order-stat timing)"
 timeout 3600 python tools/profile_bench.py OS256 OSB256 2>&1 | tee "$OUT/os.log"
+
+echo "== 5/6 profile_bench DN128 (donate on/off + restructured carry A/B)"
+timeout 1800 python tools/profile_bench.py DN128 2>&1 | tee "$OUT/dn128.log"
+
+echo "== 6/6 profile_bench PF512 SD512 (prefetch + stack-dtype A/Bs)"
+timeout 3600 python tools/profile_bench.py PF512 SD512 2>&1 | tee "$OUT/pfsd.log"
 
 echo "== queue complete; artifacts in $OUT + benchmarks/"

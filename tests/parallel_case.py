@@ -25,3 +25,21 @@ def _setup(cfg, prox_mu=0.0):
     trainer = ClientTrainer(model, lr=cfg.lr, optimizer=cfg.client_optimizer,
                             prox_mu=prox_mu)
     return trainer, data
+
+
+def run_donate_pair(make_engine, rounds=2):
+    """Bitwise donation-correctness pin (ISSUE 4), shared by the resident
+    and streaming test files: donation is a memory optimization — the
+    SAME program must produce IDENTICAL bits with donate on and off.
+    assert_array_equal, not allclose: any drift means donation changed
+    the computation, not just the buffers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    eng_d = make_engine(donate=True)
+    v0 = eng_d.init_variables()
+    v_don = eng_d.run(variables=jax.tree.map(jnp.copy, v0), rounds=rounds)
+    eng_n = make_engine(donate=False)
+    v_not = eng_n.run(variables=jax.tree.map(jnp.copy, v0), rounds=rounds)
+    for a, b in zip(jax.tree.leaves(v_don), jax.tree.leaves(v_not)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

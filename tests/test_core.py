@@ -53,6 +53,26 @@ class TestPytree:
         t = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
         assert float(tree_l2_norm(t)) == pytest.approx(5.0)
 
+    def test_flatten_carry_roundtrip(self):
+        """flatten/unflatten_carry_f32 (the chunk-scan carry layout,
+        engine.py): bitwise round-trip through the one-vector carry,
+        empty-tree degenerate included (FedNova's stats carry on
+        stats-free models)."""
+        from fedml_tpu.parallel.engine import (flatten_carry_f32,
+                                               unflatten_carry_f32)
+        rs = np.random.RandomState(0)
+        tree = {"w": jnp.asarray(rs.rand(4, 3), jnp.float32),
+                "b": jnp.asarray(rs.rand(3), jnp.float32)}
+        flat, spec = flatten_carry_f32(tree)
+        assert flat.shape == (4 * 3 + 3,) and flat.dtype == jnp.float32
+        back = unflatten_carry_f32(flat, spec)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(back[k]),
+                                          np.asarray(tree[k]))
+        eflat, espec = flatten_carry_f32({})
+        assert eflat.shape == (0,)
+        assert unflatten_carry_f32(eflat, espec) == {}
+
 
 class TestPartition:
     def test_homo_covers_all(self):

@@ -24,7 +24,7 @@ from fedml_tpu.parallel import (MeshFedAvgEngine, MeshFedOptEngine,
 from fedml_tpu.parallel.mesh import make_mesh, make_mesh_2d
 from fedml_tpu.utils.config import FedConfig
 
-from parallel_case import _mnist_like_cfg, _setup
+from parallel_case import _mnist_like_cfg, _setup, run_donate_pair
 
 
 def test_mesh_matches_single_device():
@@ -473,6 +473,24 @@ def test_mesh_orderstat_defense_rejects_ragged_cohort():
 # rounds (PERF.md round-3 table, exp_SCAN); its equivalence tests went with
 # it.  sample_jax, which it exercised, keeps a direct unit test in
 # test_core.py.
+
+
+def test_donate_bitwise_fedavg_resident():
+    cfg = _mnist_like_cfg(comm_round=2)
+    trainer, data = _setup(cfg)
+    run_donate_pair(lambda donate: MeshFedAvgEngine(
+        trainer, data, cfg, mesh=make_mesh(8), donate=donate))
+
+
+def test_donate_bitwise_robust_flats():
+    """The order-stat shard body (emit_flat_params chunked loop + the
+    flats scatter/psum) under donation: bitwise-identical to the
+    non-donating compile."""
+    cfg = _mnist_like_cfg(comm_round=2)
+    trainer, data = _setup(cfg)
+    run_donate_pair(lambda donate: MeshRobustEngine(
+        trainer, data, cfg, defense="median", n_byzantine=1,
+        mesh=make_mesh(8), donate=donate))
 
 
 def test_multihost_mesh_helpers():

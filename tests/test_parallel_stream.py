@@ -17,7 +17,7 @@ from fedml_tpu.parallel import (MeshFedAvgEngine, MeshFedOptEngine,
 from fedml_tpu.parallel.mesh import make_mesh
 from fedml_tpu.utils.config import FedConfig
 
-from parallel_case import _mnist_like_cfg, _setup
+from parallel_case import _mnist_like_cfg, _setup, run_donate_pair
 
 
 def _live_bytes():
@@ -325,6 +325,12 @@ def test_streaming_reference_scale_memory_bound():
     assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
 
 
+@pytest.mark.slow   # 74 s XLA:CPU (the heaviest streaming test —
+#                     ISSUE-4 fast/nightly split): the O(block) device
+#                     bound stays tier-1-guarded by the orderstat
+#                     live-bytes test above (same harness, both phases,
+#                     46 s); this linear-path twin runs in the nightly
+#                     profile — zero coverage loss across the two
 def test_blockstream_device_memory_is_o_block():
     """stream_block's point: a round over a 64-client cohort in 8-client
     blocks must never hold device bytes O(cohort) — only O(block)
@@ -368,6 +374,48 @@ def test_blockstream_device_memory_is_o_block():
     assert cohort_bytes > 4 * block_bytes   # the bound is meaningful
     assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
 
+
+
+def test_donate_bitwise_streaming():
+    """The run-loop streaming variant donates the per-round cohort
+    (engine._round_fn_streaming_consume); the public replay entry must
+    stay un-donated so bench.py-style cohort reuse survives."""
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=2)
+    trainer, data = _setup(cfg)
+    run_donate_pair(lambda donate: MeshFedAvgEngine(
+        trainer, data, cfg, mesh=make_mesh(8), donate=donate,
+        streaming=True))
+    # replay safety: round_fn_streaming does NOT donate the cohort — the
+    # same uploaded cohort must survive two calls (bench.py's pattern)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8),
+                           donate=True, streaming=True)
+    v = eng._prepare_variables(eng.init_variables())
+    ss = eng.server_init(v)
+    cohort, weights = eng.stream_cohort(0)
+    rng = jax.random.PRNGKey(0)
+    v, ss, _ = eng.round_fn_streaming(v, ss, cohort, weights, rng)
+    v, ss, _ = eng.round_fn_streaming(v, ss, cohort, weights, rng)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(v))
+
+
+def test_donate_bitwise_blockstream():
+    cfg = _mnist_like_cfg(client_num_per_round=12, comm_round=2)
+    trainer, data = _setup(cfg)
+    run_donate_pair(lambda donate: MeshFedAvgEngine(
+        trainer, data, cfg, mesh=make_mesh(8), donate=donate,
+        stream_block=8))
+
+
+def test_donate_bitwise_blockstream_orderstat():
+    """Two-phase order-stat rounds with donation end-to-end (flats block
+    step, donated phase-2 slices, donated finalize) == the non-donating
+    compile, bitwise."""
+    cfg = _mnist_like_cfg(comm_round=2, norm_bound=0.5)
+    trainer, data = _setup(cfg)
+    run_donate_pair(lambda donate: MeshRobustEngine(
+        trainer, data, cfg, defense="median", n_byzantine=1,
+        mesh=make_mesh(8), donate=donate, stream_block=8,
+        param_block_bytes=16 * 64))
 
 
 def test_blockstream_uint8_h2d_byte_reduction():

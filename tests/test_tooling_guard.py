@@ -61,3 +61,44 @@ def test_bench_json_schema_carries_byte_accounting():
                              "fedml_tpu", "utils", "profiling.py")).read()
     assert '"h2d_bytes"' in prof, (
         "TransferOverlapStats round records lost the h2d_bytes field")
+
+
+def test_copy_audit_ceilings_artifact_exists():
+    """ISSUE 4: the copy-regression gate needs its pinned artifacts —
+    the per-family ceilings (with a machine-readable calibration env)
+    and the committed pre-PR baseline the FedAvg reduction is asserted
+    against.  Losing either silently disarms the gate."""
+    import json
+    bench_dir = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+    ceil = json.load(open(os.path.join(bench_dir,
+                                       "hlo_copy_ceilings.json")))
+    assert ceil["families"], "ceilings artifact carries no families"
+    for fam, pins in ceil["families"].items():
+        assert pins["copy_bytes_ceiling"] >= 0, fam
+    for key in ("jax", "jaxlib", "date"):
+        assert key in ceil["calibration"], (
+            f"ceilings calibration env lost {key!r} (the recalibrate "
+            "protocol needs it to name version skew)")
+    base = json.load(open(os.path.join(bench_dir,
+                                       "hlo_copy_baseline.json")))
+    assert "fedavg_resident" in base["families"]
+
+
+def test_chip_queue_carries_donate_ab():
+    """ISSUE 4: the next chip window must price the donate/carry A/B —
+    scripts/run_chip_queue.sh carries the DN128 experiment (and stays
+    shell-valid: the round-1 unclosed-paren regression)."""
+    import subprocess
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    src = open(queue).read()
+    assert "DN128" in src, (
+        "run_chip_queue.sh lost the DN128 donate on/off A/B "
+        "(ISSUE 4 queues it for the next chip window)")
+    assert "exp_DN128" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_DN128 experiment the queue runs")
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr

@@ -306,6 +306,46 @@ def exp_SD512():
               f"loss {loss:.4f}", flush=True)
 
 
+def exp_DN128():
+    """Donation/carry A/B (ISSUE 4 tentpole; VERDICT r5 next-#2): the
+    bench's 128-client resident round (chunk 2, bf16 masters, unroll 8)
+    compiled donate-OFF vs donate-ON, with the restructured flat chunk
+    carry in both — the round-2b chip trace priced scan-carry/donation
+    copies at ~0.13 s/round (7% of leaf time), and the static HLO audit
+    (tools/hlo_copy_audit.py) shows the flat carry removing the donated-
+    kernel staging copies; this prices the remaining gap in wall-clock.
+    Results are bitwise donate-independent (pinned in
+    tests/test_parallel.py::test_donate_bitwise_fedavg_resident)."""
+    import jax
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    ITERS = 5
+    for donate in (False, True):
+        cfg, data, trainer = _bench_workload(128)
+        engine = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(),
+                                  chunk=2, local_dtype=jnp.bfloat16,
+                                  donate=donate)
+        v = engine._prepare_variables(engine.init_variables())
+        s = engine.server_init(v)
+        stack, stack_w = engine._device_stack()
+        ids, wmask = engine.sample_padded(0)
+        rng = jax.random.PRNGKey(0)
+        v, s, m = engine.round_fn(v, s, stack, stack_w, ids, wmask, rng)
+        force(m["train_loss"])                             # compile+warm
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            # donated variables/server_state thread through round to
+            # round exactly like the run() loop
+            v, s, m = engine.round_fn(v, s, stack, stack_w, ids, wmask,
+                                      rng)
+        force(m["train_loss"])
+        dt = (time.perf_counter() - t0) / ITERS
+        tag = "donate" if donate else "no_donate"
+        print(f"DN128 {tag} resident round (chunk 2, bf16 masters, "
+              f"flat carry): {dt:.3f}s/round", flush=True)
+
+
 def _robust_workload(C: int):
     """CNN-femnist-shaped workload for the order-stat experiments (the
     model class these defenses are used with — MeshRobustEngine
