@@ -40,7 +40,11 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     host->device payload bytes per timed round — 0 on this
 #     resident-cohort bench, filled by streaming/block-stream variants);
 #     per-round records in "rounds" additionally carry "h2d_bytes"
-SCHEMA_VERSION = 3
+# v4: + "mode" ("sync" | "async") and "async" block (committed_updates,
+#     staleness_p50/p95, buffer_occupancy_mean, deadline_commits —
+#     `python bench.py --mode async`, fedml_tpu/async_); null in sync
+#     mode, so v3 readers that ignore unknown keys keep working
+SCHEMA_VERSION = 4
 
 
 def _git_sha() -> str:
@@ -134,6 +138,15 @@ def _probe_with_retry() -> tuple[bool, str]:
 
 
 def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser("bench")
+    ap.add_argument("--mode", choices=("sync", "async"), default="sync",
+                    help="sync: the north-star resident-cohort rounds/sec "
+                         "bench; async: the buffered staleness-aware "
+                         "scheduler (fedml_tpu/async_) — committed "
+                         "updates/sec + staleness percentiles under the "
+                         "seeded lognormal-latency lifecycle")
+    args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
     # artifact distinguishes "no chip" from a perf regression
@@ -145,11 +158,13 @@ def main() -> None:
             "value": 0.0,
             "unit": "rounds/sec",
             "vs_baseline": 0.0,
+            "mode": args.mode,
             # null, not a number: nothing ran, so neither the 1.0
             # no-uploads convention nor the 0.0 transfer-bound reading
             # applies — consumers must not fold this row into trends
             "overlap_fraction": None,
             "h2d_bytes_per_round": None,
+            "async": None,
             "error": "chip_unavailable",
             "detail": detail,
         })))
@@ -203,6 +218,10 @@ def main() -> None:
     # L2U8 1.806 vs L2 1.851, PERF.md round-3 table)
     trainer = ClientTrainer(model, lr=cfg.lr, train_dtype=jnp.bfloat16,
                             batch_unroll=8)
+
+    if args.mode == "async":
+        _bench_async(cfg, data, trainer)
+        return
     mesh = make_mesh()
     # chunk=2 + bf16 local masters: the measured v5e optimum
     # (tools/profile_bench.py L2 rows; PERF.md round-3 table)
@@ -260,6 +279,8 @@ def main() -> None:
         "value": round(rps, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rps / ESTIMATED_REFERENCE_ROUNDS_PER_SEC, 4),
+        "mode": "sync",
+        "async": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -279,6 +300,67 @@ def main() -> None:
     })
     if obs.enabled():
         obs.export()                   # trace + metrics into FEDML_OBS_DIR
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+# async-mode shape: concurrency 32 / buffer 8 keeps the dispatch-wave
+# vmap at a quarter of the sync bench's 128-wide cohort (the async
+# engine runs unchunked vmap waves, not the mesh scan) while the
+# 4x concurrency/K ratio plus lognormal latencies produces genuine
+# staleness — the regime the discount weights exist for.
+ASYNC_CONCURRENCY = 32
+ASYNC_BUFFER_K = 8
+ASYNC_WARMUP_COMMITS = 2
+ASYNC_TIMED_COMMITS = 12
+
+
+def _bench_async(cfg, data, trainer) -> None:
+    """committed-updates/sec of the buffered async scheduler on the
+    bench workload, under the seeded lognormal-latency lifecycle.
+    Latencies are SIMULATED (no sleeps): the wall measures compute —
+    dispatch-wave training + staleness-discounted commits."""
+    import jax
+
+    from fedml_tpu import obs
+    from fedml_tpu.async_ import AsyncFedAvgEngine, LifecycleConfig
+
+    cfg.frequency_of_the_test = 1        # wall_time per commit
+    lc = LifecycleConfig(latency="lognormal", latency_scale=1.0,
+                         latency_sigma=0.5, heterogeneity=0.5, seed=0)
+    engine = AsyncFedAvgEngine(trainer, data, cfg,
+                               buffer_k=ASYNC_BUFFER_K,
+                               concurrency=ASYNC_CONCURRENCY,
+                               staleness="polynomial", staleness_a=0.5,
+                               lifecycle_cfg=lc)
+    total = ASYNC_WARMUP_COMMITS + ASYNC_TIMED_COMMITS
+    variables = engine.run(rounds=total)
+    jax.block_until_ready(variables)
+    walls = [m["wall_time"] for m in engine.metrics_history]
+    dt = walls[total - 1] - walls[ASYNC_WARMUP_COMMITS - 1]
+    ups = ASYNC_TIMED_COMMITS / dt
+    rep = engine.async_report()
+    print(f"{dt / ASYNC_TIMED_COMMITS:.3f}s/commit  "
+          f"staleness p50/p95 {rep['staleness_p50']:.0f}/"
+          f"{rep['staleness_p95']:.0f}", file=sys.stderr)
+    doc = _stamp({
+        "metric": ("fedavg_cifar10_resnet18gn_128clients_async_"
+                   "committed_updates_per_sec"),
+        "value": round(ups, 4),
+        "unit": "commits/sec",
+        # the sync baseline estimate is a per-ROUND number; an async
+        # commit aggregates buffer_k of 128 clients, so cross-mode
+        # ratios are not meaningful — recorded as null by design
+        "vs_baseline": None,
+        "mode": "async",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": {k: (round(v, 4) if isinstance(v, float) else v)
+                  for k, v in rep.items()},
+    })
+    if obs.enabled():
+        obs.export()
         doc["obs"] = obs.rollup()
     print(json.dumps(doc))
 

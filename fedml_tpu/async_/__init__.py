@@ -1,0 +1,28 @@
+"""Async federation subsystem — buffered staleness-aware aggregation.
+
+Three layers (module docstrings have the full design):
+
+  staleness.py   staleness-discount weight families (constant /
+                 polynomial / hinge), the flat-carry [K, P] buffer, and
+                 the jitted donation-friendly commit program
+  scheduler.py   AsyncFedAvgEngine — event-driven virtual-time
+                 scheduler (FedBuff semi-async; FedAsync at K=1) with
+                 dispatch-wave vmapped training
+  lifecycle.py   seeded client-lifecycle simulator (latency / dropout /
+                 rejoin / crash) + the AsyncServerManager /
+                 AsyncClientManager FSM pair over the comm backends
+"""
+from fedml_tpu.async_.lifecycle import (AsyncClientManager, AsyncMessage,
+                                        AsyncServerManager, ClientLifecycle,
+                                        LifecycleConfig,
+                                        run_async_messaging)
+from fedml_tpu.async_.scheduler import AsyncFedAvgEngine
+from fedml_tpu.async_.staleness import (AsyncBuffer, STALENESS_MODES,
+                                        make_commit_fn, staleness_weight)
+
+__all__ = [
+    "AsyncBuffer", "AsyncClientManager", "AsyncFedAvgEngine",
+    "AsyncMessage", "AsyncServerManager", "ClientLifecycle",
+    "LifecycleConfig", "STALENESS_MODES", "make_commit_fn",
+    "run_async_messaging", "staleness_weight",
+]

@@ -1,0 +1,440 @@
+"""Seeded client-lifecycle simulator + the async messaging FSM pair.
+
+Cross-device federations are defined by client churn: heavy-tailed
+device latencies, dropouts mid-round, rejoins minutes later (the FedML
+paper's "millions of intermittent clients" regime, arXiv:2007.13518 §2).
+`ClientLifecycle` is the ONE seeded source of that behavior, shared by
+both async execution paths:
+
+* the virtual-time scheduler (fedml_tpu/async_/scheduler.py) draws
+  latency/crash/rejoin per dispatch and advances a simulated clock —
+  deterministic per seed, so two runs with the same `--async_seed`
+  produce identical event traces (pinned in tests/test_async.py);
+* the REAL-thread FSM pair below (AsyncServerManager /
+  AsyncClientManager) applies the same draws as actual sleeps and
+  dropped replies over any comm backend (INPROC for tests, TCP/GRPC
+  across machines) — so the async path exercises the real wire codec,
+  the per-backend byte/message counters, and redispatch under loss.
+
+Latency families (per dispatch, scaled by a per-client speed factor
+drawn once at construction — persistent stragglers, not iid noise):
+
+    lognormal   scale · exp(sigma·N(0,1))          (bulk + mild tail)
+    pareto      scale · (1 + Pareto(alpha))        (heavy tail)
+    none        0                                  (the degenerate pin)
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from fedml_tpu import obs
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.async_.staleness import (AsyncBuffer, flat_dim,
+                                        flatten_vars_row, make_commit_fn,
+                                        unflatten_rows)
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+LATENCY_MODES = ("none", "lognormal", "pareto")
+
+
+@dataclasses.dataclass
+class LifecycleConfig:
+    """Knobs of the seeded client-lifecycle model (CLI --async_*)."""
+    latency: str = "none"            # none | lognormal | pareto
+    latency_scale: float = 1.0       # seconds (virtual or real)
+    latency_sigma: float = 0.5       # lognormal spread
+    pareto_alpha: float = 2.0        # pareto tail index (>1 for finite mean)
+    heterogeneity: float = 0.0       # per-client speed-factor lognormal sigma
+    dropout_prob: float = 0.0        # P(crash mid-round) per dispatch
+    rejoin_prob: float = 1.0         # P(a crashed client ever rejoins)
+    rejoin_delay_s: float = 5.0      # mean rejoin delay (exponential)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.latency not in LATENCY_MODES:
+            raise ValueError(f"unknown latency mode {self.latency!r} "
+                             f"(choose one of {LATENCY_MODES})")
+        if not 0.0 <= self.dropout_prob <= 1.0:
+            raise ValueError(f"dropout_prob must be in [0, 1], got "
+                             f"{self.dropout_prob}")
+
+
+class ClientLifecycle:
+    """Seeded per-client draw source.  All randomness flows through ONE
+    np.random.Generator in call order, so a scheduler that processes
+    events deterministically gets a deterministic fault schedule."""
+
+    def __init__(self, cfg: LifecycleConfig, n_clients: int):
+        self.cfg = cfg
+        self.n_clients = n_clients
+        self._rng = np.random.default_rng(cfg.seed)
+        # the virtual-time scheduler draws in deterministic event order;
+        # the messaging FSM draws from concurrent client threads — the
+        # lock keeps the shared Generator coherent there (determinism is
+        # only promised for the single-threaded scheduler path)
+        self._lock = threading.Lock()
+        # persistent per-client speed factors: the straggler identity of
+        # a device does not re-roll every round
+        if cfg.heterogeneity > 0.0:
+            self.speed = np.exp(cfg.heterogeneity
+                                * self._rng.standard_normal(n_clients))
+        else:
+            self.speed = np.ones(n_clients)
+
+    def draw_latency(self, client_id: int) -> float:
+        c = self.cfg
+        if c.latency == "none":
+            return 0.0
+        with self._lock:
+            if c.latency == "lognormal":
+                base = c.latency_scale * float(
+                    np.exp(c.latency_sigma * self._rng.standard_normal()))
+            else:                                # pareto
+                base = c.latency_scale * float(1.0 + self._rng.pareto(
+                    c.pareto_alpha))
+        return base * float(self.speed[client_id])
+
+    def draw_crash(self, client_id: int) -> bool:
+        """Crash-mid-round fault injection: the dispatch trains (or not)
+        but its result never reaches the server."""
+        if self.cfg.dropout_prob <= 0.0:
+            return False
+        with self._lock:
+            return bool(self._rng.random() < self.cfg.dropout_prob)
+
+    def draw_rejoin_delay(self, client_id: int) -> Optional[float]:
+        """Seconds until a crashed client rejoins the dispatchable pool;
+        None = the client is gone for good."""
+        with self._lock:
+            if self._rng.random() >= self.cfg.rejoin_prob:
+                return None
+            return float(self._rng.exponential(self.cfg.rejoin_delay_s))
+
+
+# ---------------------------------------------------------------------------
+# async messaging FSM (real threads over the comm backends)
+# ---------------------------------------------------------------------------
+
+class AsyncMessage:
+    """Message-type constants of the async federation protocol (disjoint
+    from fedavg_messaging.MyMessage's 1-4 so a mixed deployment cannot
+    cross-dispatch)."""
+    MSG_TYPE_S2C_ASYNC_TRAIN = 11
+    MSG_TYPE_C2S_ASYNC_RESULT = 12
+    MSG_TYPE_S2C_ASYNC_STOP = 13
+
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_VERSION = "model_version"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+
+
+class AsyncServerManager(ServerManager):
+    """Buffered staleness-aware async server over any comm backend.
+
+    No round barrier: every inbound result lands in the AsyncBuffer with
+    staleness = current_version − the version echoed by the client; a
+    commit fires when the buffer reaches `buffer_k` OR the deadline
+    timer (armed at the first buffered result after a commit) expires
+    with a part-full buffer.  Contributing clients are redispatched at
+    the new version immediately; on a deadline commit, clients whose
+    outstanding dispatch is older than the previous version are
+    presumed crashed and redispatched too (counted in
+    `async_redispatch_total` — the lifecycle's rejoin path)."""
+
+    def __init__(self, init_variables: Pytree, total_commits: int,
+                 buffer_k: int, rank: int = 0, size: int = 1,
+                 backend: str = "INPROC", staleness_mode: str = "constant",
+                 staleness_a: float = 0.5, staleness_b: float = 4.0,
+                 mix: float = 1.0,
+                 deadline_s: Optional[float] = None, **kw):
+        super().__init__(rank, size, backend, **kw)
+        import jax
+        self.variables = jax.tree.map(np.asarray, init_variables)
+        self.total_commits = total_commits
+        self.buffer_k = buffer_k
+        self.mix = float(mix)
+        self.deadline_s = deadline_s
+        self.version = 0
+        self.partial_commits = 0
+        self.staleness_seen: list[float] = []
+        self.buffer = AsyncBuffer(buffer_k, flat_dim(self.variables))
+        self._commit = make_commit_fn(self.variables, mode=staleness_mode,
+                                      a=staleness_a, b=staleness_b,
+                                      donate=False)
+        self._lock = threading.Lock()
+        self._watchdog: Optional[threading.Timer] = None
+        # rank -> version of its outstanding dispatch (None = idle)
+        self._outstanding: dict[int, Optional[int]] = {
+            r: None for r in range(1, size)}
+        self.done = threading.Event()
+        self._m_occupancy = obs.gauge("async_buffer_occupancy")
+        self._m_staleness = obs.histogram(
+            "async_staleness", buckets=obs.metrics.STALENESS_BUCKETS)
+        self._m_commits = obs.counter("async_commits_total")
+        self._m_deadline = obs.counter("async_deadline_commits_total")
+        self._m_redispatch = obs.counter("async_redispatch_total")
+
+    # -- dispatch ------------------------------------------------------------
+    def send_start(self) -> None:
+        for rank in range(1, self.size):
+            self._dispatch(rank)
+        with self._lock:
+            if self.deadline_s is not None:
+                self._arm_watchdog(self.version)
+
+    def _dispatch(self, rank: int) -> None:
+        msg = Message(AsyncMessage.MSG_TYPE_S2C_ASYNC_TRAIN, self.rank, rank)
+        msg.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, self.variables)
+        msg.add_params(AsyncMessage.MSG_ARG_KEY_CLIENT_INDEX, rank - 1)
+        msg.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, self.version)
+        self._outstanding[rank] = self.version
+        self.send_message(msg)
+
+    # -- FSM -----------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, self._handle_result)
+
+    def _handle_result(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        dispatched = int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION))
+        variables = msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        n = float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES))
+        row = flatten_vars_row(variables)
+        with self._lock:
+            if self.done.is_set():
+                return                      # late straggler after shutdown
+            staleness = float(self.version - dispatched)
+            self.staleness_seen.append(staleness)
+            self._m_staleness.observe(staleness)
+            full = self.buffer.add(row, n, staleness)
+            self._m_occupancy.set(self.buffer.count)
+            self._outstanding[sender] = None
+            if not full:
+                # the contributing client would idle until the next
+                # commit; async has no barrier, so hand it work now
+                self._redispatch_locked([sender])
+                return
+            last = self._commit_locked(deadline_fired=False)
+        if last:
+            self.stop_all()
+
+    def _arm_watchdog(self, armed_version: int) -> None:
+        """Deadline heartbeat: armed at start and re-armed after every
+        commit (and after an empty-buffer retry sweep), so progress
+        never depends on a result arriving first — the crash-starved
+        case (every in-flight client dropped) is exactly when nothing
+        else would wake the server."""
+        self._watchdog = threading.Timer(
+            self.deadline_s, self._on_deadline, args=(armed_version,))
+        self._watchdog.daemon = True
+        self._watchdog.start()
+
+    def _on_deadline(self, armed_version: int) -> None:
+        with self._lock:
+            self._watchdog = None
+            if self.done.is_set() or self.version != armed_version:
+                return                      # committed normally meanwhile
+            if self.buffer.count == 0:
+                # nothing arrived a whole deadline long: presume every
+                # outstanding dispatch crashed, retry them all (the
+                # lifecycle's rejoin path), keep the heartbeat alive
+                self._redispatch_locked(
+                    [r for r, v in self._outstanding.items()
+                     if v is not None])
+                self._arm_watchdog(self.version)
+                return
+            last = self._commit_locked(deadline_fired=True)
+        if last:
+            self.stop_all()
+
+    def _commit_locked(self, deadline_fired: bool) -> bool:
+        """Drain + jitted commit + redispatch; caller holds _lock.
+        Returns True when this was the last commit."""
+        import jax
+        import jax.numpy as jnp
+        if self._watchdog is not None:
+            self._watchdog.cancel()
+            self._watchdog = None
+        rows, w, s, n_real = self.buffer.drain()
+        self._m_occupancy.set(0)
+        with obs.span("async.commit", version=self.version,
+                      n_results=n_real, deadline=deadline_fired):
+            new_vars, _stats = self._commit(
+                jax.tree.map(jnp.asarray, self.variables),
+                jnp.asarray(rows), jnp.asarray(w), jnp.asarray(s),
+                jnp.float32(self.mix))
+            self.variables = jax.tree.map(np.asarray, new_vars)
+        self.version += 1
+        self._m_commits.inc()
+        if deadline_fired:
+            self.partial_commits += 1
+            self._m_deadline.inc()
+        if self.version >= self.total_commits:
+            self.done.set()
+            return True
+        # redispatch everyone idle; on a deadline commit also retry
+        # ranks whose outstanding dispatch predates the PREVIOUS
+        # version — two commits without a reply reads as a crash
+        retry = [r for r, v in self._outstanding.items()
+                 if v is None or (deadline_fired and v < self.version - 1)]
+        self._redispatch_locked(retry)
+        if self.deadline_s is not None:
+            self._arm_watchdog(self.version)
+        return False
+
+    def _redispatch_locked(self, ranks) -> None:
+        for r in ranks:
+            self._m_redispatch.inc()
+            self._dispatch(r)
+
+    def stop_all(self) -> None:
+        """Broadcast STOP and close this manager (never under _lock —
+        finish() joins the receive thread, which may be waiting on it)."""
+        for rank in range(1, self.size):
+            try:
+                self.send_message(Message(
+                    AsyncMessage.MSG_TYPE_S2C_ASYNC_STOP, self.rank, rank))
+            except Exception:                  # a dead client's transport
+                log.warning("stop broadcast to rank %d failed", rank,
+                            exc_info=True)
+        self.finish()
+
+
+class AsyncClientManager(ClientManager):
+    """One lifecycle-simulated device: on a train dispatch, draw this
+    dispatch's fate from the seeded lifecycle — a crash swallows the
+    result (the server's deadline path carries on without it); otherwise
+    sleep the drawn latency (REAL seconds — keep latency_scale small in
+    tests) and upload the trained model with the dispatch version echoed
+    for staleness accounting."""
+
+    def __init__(self, trainer, data, epochs: int, rank: int, size: int,
+                 backend: str = "INPROC",
+                 lifecycle: Optional[ClientLifecycle] = None, **kw):
+        super().__init__(rank, size, backend, **kw)
+        import jax
+        self.trainer = trainer
+        self.data = data
+        self.epochs = epochs
+        self.lifecycle = lifecycle
+        self.crashes = 0
+        self.done = threading.Event()
+        self._local_train = jax.jit(
+            lambda v, shard, rng: trainer.local_train(
+                v, shard, rng, self.epochs))
+        self._rng = jax.random.PRNGKey(2000 + rank)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            AsyncMessage.MSG_TYPE_S2C_ASYNC_TRAIN, self._handle_train)
+        self.register_message_receive_handler(
+            AsyncMessage.MSG_TYPE_S2C_ASYNC_STOP, self._handle_stop)
+
+    def _handle_train(self, msg: Message) -> None:
+        import jax
+        import jax.numpy as jnp
+        if self.done.is_set() or self._closed:
+            return      # dispatch raced shutdown: the server is gone
+        client_idx = int(msg.get(AsyncMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        if self.lifecycle is not None:
+            if self.lifecycle.draw_crash(client_idx):
+                # crash mid-round: the work is lost, nothing is sent —
+                # the server's deadline/redispatch path is the rejoin
+                self.crashes += 1
+                obs.counter("async_dropouts_total").inc()
+                return
+            lat = self.lifecycle.draw_latency(client_idx)
+            if lat > 0.0:
+                time.sleep(lat)
+        variables = msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        shard = jax.tree.map(lambda a: jnp.asarray(a[client_idx]),
+                             self.data.client_shards)
+        self._rng, rng = jax.random.split(self._rng)
+        with obs.span("async.local_train", rank=self.rank,
+                      client=client_idx):
+            new_vars, _loss, n = self._local_train(
+                jax.tree.map(jnp.asarray, variables), shard, rng)
+        out = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, self.rank, 0)
+        out.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                       jax.tree.map(np.asarray, new_vars))
+        out.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        out.add_params(AsyncMessage.MSG_ARG_KEY_VERSION,
+                       int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+        if self.done.is_set() or self._closed:
+            return      # STOP landed during the latency sleep / train
+        self.send_message(out)
+
+    def _handle_stop(self, msg: Message) -> None:
+        self.done.set()
+        self.finish()
+
+
+def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
+                        total_commits: Optional[int] = None,
+                        backend: str = "INPROC",
+                        worker_num: Optional[int] = None,
+                        lifecycle_cfg: Optional[LifecycleConfig] = None,
+                        lifecycle: Optional[ClientLifecycle] = None,
+                        staleness_mode: str = "constant",
+                        staleness_a: float = 0.5, staleness_b: float = 4.0,
+                        mix: float = 1.0, deadline_s: Optional[float] = None,
+                        timeout_s: float = 600.0, **backend_kw):
+    """Launch the async server + one lifecycle-simulated client per rank
+    (threads for INPROC; for TCP/GRPC run one rank per process and call
+    the managers directly).  Returns (variables, server) after
+    `total_commits` commits.  A stall past `timeout_s` dumps the flight
+    recorder — the scheduler-deadlock artifact — before raising."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.comm.inproc import InProcRouter
+
+    worker_num = worker_num or cfg.client_num_per_round
+    size = worker_num + 1
+    total_commits = (total_commits if total_commits is not None
+                     else cfg.comm_round)
+    router = backend_kw.pop("router", None)
+    if backend.upper() == "INPROC" and router is None:
+        router = InProcRouter()
+    kw = dict(backend_kw)
+    if router is not None:
+        kw["router"] = router
+
+    if lifecycle is None and lifecycle_cfg is not None:
+        lifecycle = ClientLifecycle(lifecycle_cfg, worker_num)
+    init_vars = trainer.init(jax.random.PRNGKey(cfg.seed),
+                             jnp.asarray(data.client_shards["x"][0, 0]))
+    server = AsyncServerManager(
+        init_vars, total_commits, buffer_k, 0, size, backend,
+        staleness_mode=staleness_mode, staleness_a=staleness_a,
+        staleness_b=staleness_b, mix=mix, deadline_s=deadline_s, **kw)
+    clients = [AsyncClientManager(trainer, data, cfg.epochs, r, size,
+                                  backend, lifecycle=lifecycle, **kw)
+               for r in range(1, size)]
+    threads = [c.run_async() for c in clients] + [server.run_async()]
+    server.send_start()
+    if not server.done.wait(timeout=timeout_s):
+        obs.dump_flight("async_scheduler_deadlock")
+        for c in clients:
+            c.finish()
+        server.finish()
+        raise TimeoutError(
+            f"async federation stalled: {server.version}/{total_commits} "
+            f"commits in {timeout_s}s (buffer {server.buffer.count}/"
+            f"{buffer_k}; all in-flight clients may have crashed with no "
+            f"deadline set)")
+    for c in clients:
+        c.finish()
+    for t in threads:
+        t.join(timeout=10)
+    return jax.tree.map(jnp.asarray, server.variables), server

@@ -1,0 +1,225 @@
+"""Staleness-aware buffered aggregation — the async commit math.
+
+The synchronous engines aggregate a whole cohort at once; the async
+scheduler (fedml_tpu/async_/scheduler.py) instead accumulates client
+results into a bounded buffer and commits whenever K results arrived or
+a round deadline fired (FedBuff-style semi-async, arXiv:2106.06639's
+shape).  Each buffered result carries a STALENESS s = the number of
+server commits since the model version it trained from; the commit
+discounts stale results with one of three standard weight families
+(FedAsync, arXiv:1903.03934 §5):
+
+    constant      λ(s) = 1
+    polynomial    λ(s) = (1 + s)^-a
+    hinge         λ(s) = 1 if s <= b else 1 / (a·(s - b) + 1)
+
+Commit rule (mixing form — FedAsync's update, generalized to a buffer):
+
+    w̃_i   = n_i · λ(s_i)                    (samples x staleness discount)
+    avg   = Σ w̃_i v_i / Σ w̃_i              (tree_weighted_mean)
+    v_new = (1 - α_eff) · v + α_eff · avg
+
+With α_eff = 1, a full buffer (K = cohort), and constant weights this is
+EXACTLY the synchronous FedAvg aggregation — `0·v + 1·avg` is bitwise
+`avg`, and avg is the same tree_weighted_mean over the same stacked
+results — which is what makes the degenerate-config equivalence pin in
+tests/test_async.py exact rather than approximate.  K = 1 with a
+polynomial/hinge weight is pure FedAsync.
+
+Buffer layout: ONE flat f32 [K, P] row matrix — the flat-carry layout of
+parallel/engine.py (flatten_carry_f32: ravel + concat in jax leaf
+order), stacked along the buffer axis.  One buffer, one layout, so the
+commit program's donated inputs alias cleanly instead of paying a
+per-leaf relayout copy; tools/hlo_copy_audit.py audits the compiled
+commit program as the `async_commit` family against the pinned ceiling
+in benchmarks/hlo_copy_ceilings.json.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.pytree import tree_weighted_mean
+
+Pytree = Any
+
+STALENESS_MODES = ("constant", "polynomial", "hinge")
+
+
+def staleness_weight(mode: str, s, a: float = 0.5, b: float = 4.0):
+    """λ(s) for a [K] staleness vector (f32 in, f32 out).  `a`/`b` are
+    the FedAsync shape parameters (polynomial exponent / hinge knee)."""
+    s = jnp.asarray(s, jnp.float32)
+    if mode == "constant":
+        return jnp.ones_like(s)
+    if mode == "polynomial":
+        return jnp.power(1.0 + s, -jnp.float32(a))
+    if mode == "hinge":
+        return jnp.where(s <= b, jnp.float32(1.0),
+                         1.0 / (jnp.float32(a) * (s - b) + 1.0))
+    raise ValueError(f"unknown staleness mode {mode!r} "
+                     f"(choose one of {STALENESS_MODES})")
+
+
+# ---------------------------------------------------------------------------
+# flat rows — the engine flat-carry layout, with a leading buffer axis
+# ---------------------------------------------------------------------------
+
+def flat_dim(template: Pytree) -> int:
+    """P — total element count of the variables template (the row width
+    of the buffer matrix)."""
+    return sum(int(np.prod(l.shape)) if np.ndim(l) else 1
+               for l in jax.tree.leaves(template))
+
+
+def flatten_vars_row(tree: Pytree) -> np.ndarray:
+    """One variables pytree → [P] f32 HOST row, ravel+concat in jax leaf
+    order — the same element order as engine.flatten_carry_f32, so the
+    buffer and the chunk-scan carries speak one layout."""
+    leaves = [np.asarray(l, np.float32).reshape(-1)
+              for l in jax.tree.leaves(tree)]
+    if not leaves:
+        return np.zeros((0,), np.float32)
+    return leaves[0] if len(leaves) == 1 else np.concatenate(leaves)
+
+
+def flatten_stacked_rows(stacked: Pytree) -> jax.Array:
+    """[C, ...]-stacked variables → [C, P] f32 rows (device-side; the
+    per-row element order matches flatten_vars_row/flatten_carry_f32).
+    The dispatch-wave trainer emits these so buffer inserts are row
+    slices, not pytree walks."""
+    leaves = jax.tree.leaves(stacked)
+    C = leaves[0].shape[0]
+    if len(leaves) == 1:
+        return leaves[0].reshape(C, -1).astype(jnp.float32)
+    return jnp.concatenate(
+        [l.reshape(C, -1).astype(jnp.float32) for l in leaves], axis=1)
+
+
+def unflatten_rows(rows: jax.Array, template: Pytree) -> Pytree:
+    """[K, P] rows → [K, ...]-stacked pytree of the template's leaf
+    shapes (in-program; slices + reshapes only, so values are
+    bit-preserved — the commit's tree_weighted_mean then sees exactly
+    the numbers the clients produced)."""
+    leaves, treedef = jax.tree.flatten(template)
+    K = rows.shape[0]
+    out, off = [], 0
+    for l in leaves:
+        size = int(np.prod(l.shape)) if l.ndim else 1
+        out.append(rows[:, off:off + size].reshape((K,) + tuple(l.shape)))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# the jitted commit program
+# ---------------------------------------------------------------------------
+
+def make_commit_fn(template: Pytree, mode: str = "constant",
+                   a: float = 0.5, b: float = 4.0, donate: bool = True):
+    """Build the jitted async commit:
+
+        commit(variables, rows [K,P] f32, weights [K], staleness [K],
+               alpha) -> (new_variables, stats)
+
+    `weights` are per-result sample counts with zero-weight pad lanes
+    (a deadline commit drains a part-full buffer padded to capacity —
+    zero lanes drop out of the weighted mean exactly, so ONE compiled
+    program serves full and partial commits).  `alpha` is the server
+    mixing rate α_eff; stats carries the effective discount mass for
+    observability.  `variables` is donated — the output has its exact
+    shapes, so the update aliases in place instead of paying a
+    params-sized HBM copy per commit (the rows matrix is NOT donated:
+    no output matches its [K, P] shape, so donating it only trips
+    XLA's unusable-donation warning)."""
+    if mode not in STALENESS_MODES:
+        raise ValueError(f"unknown staleness mode {mode!r} "
+                         f"(choose one of {STALENESS_MODES})")
+
+    def commit(variables, rows, weights, staleness, alpha):
+        lam = staleness_weight(mode, staleness, a, b)
+        w = weights * lam
+        stacked = unflatten_rows(rows, variables)
+        avg = tree_weighted_mean(stacked, w)
+        alpha = jnp.asarray(alpha, jnp.float32)
+        new = jax.tree.map(
+            lambda v, m: ((1.0 - alpha) * v.astype(jnp.float32)
+                          + alpha * m).astype(v.dtype),
+            variables, avg)
+        stats = {"discount_mass": jnp.sum(w) / jnp.maximum(
+            jnp.sum(weights), 1e-12)}
+        return new, stats
+
+    return jax.jit(commit, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# the bounded aggregation buffer
+# ---------------------------------------------------------------------------
+
+class AsyncBuffer:
+    """Bounded host-side aggregation buffer: [capacity, P] f32 rows plus
+    per-row sample weights and staleness.  `drain()` always returns
+    capacity-sized arrays (zero-weight pad lanes beyond `count`) so the
+    commit program compiles once; the real-row count rides alongside.
+
+    Host-side by design: results arrive from the comm FSM as numpy
+    payloads (wire codec) or from the in-process scheduler as device
+    rows fetched once per dispatch wave — either way one np.copyto per
+    insert, and the commit uploads the matrix in one device_put."""
+
+    def __init__(self, capacity: int, p: int):
+        if capacity < 1:
+            raise ValueError(f"buffer capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.rows = np.zeros((capacity, p), np.float32)
+        self.weights = np.zeros((capacity,), np.float32)
+        self.staleness = np.zeros((capacity,), np.float32)
+        self.count = 0
+
+    def add(self, row: np.ndarray, weight: float, staleness: float) -> bool:
+        """Insert one result; returns True when the buffer reached
+        capacity (the scheduler's commit trigger)."""
+        if self.count >= self.capacity:
+            raise RuntimeError("async buffer overflow: commit before add")
+        i = self.count
+        np.copyto(self.rows[i], row)
+        self.weights[i] = np.float32(weight)
+        self.staleness[i] = np.float32(staleness)
+        self.count += 1
+        return self.count >= self.capacity
+
+    def drain(self):
+        """(rows [K,P], weights [K], staleness [K], n_real) — padded to
+        capacity with zero-weight lanes; resets the buffer."""
+        n = self.count
+        out = (self.rows.copy(), self.weights.copy(),
+               self.staleness.copy(), n)
+        self.rows[:] = 0.0
+        self.weights[:] = 0.0
+        self.staleness[:] = 0.0
+        self.count = 0
+        return out
+
+    def state(self) -> dict:
+        """Checkpointable snapshot (fedml_tpu/utils/checkpoint.py
+        extra_state) — plain arrays, restored by load_state."""
+        return {"rows": self.rows.copy(), "weights": self.weights.copy(),
+                "staleness": self.staleness.copy(),
+                # 0-d ndarray, not a numpy scalar: orbax StandardSave
+                # rejects np.int64(x) leaves
+                "count": np.asarray(self.count, np.int64)}
+
+    def load_state(self, state: dict) -> None:
+        rows = np.asarray(state["rows"], np.float32)
+        if rows.shape != self.rows.shape:
+            raise ValueError(
+                f"async buffer shape mismatch: checkpoint {rows.shape} vs "
+                f"configured {self.rows.shape} (buffer_k or model changed)")
+        np.copyto(self.rows, rows)
+        np.copyto(self.weights, np.asarray(state["weights"], np.float32))
+        np.copyto(self.staleness, np.asarray(state["staleness"], np.float32))
+        self.count = int(state["count"])

@@ -111,6 +111,66 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deployment mode: zlib-compress the wire "
                         "frame's header+small-array section (lossless; "
                         "wire codec v2)")
+    # async federation (fedml_tpu/async_): buffered staleness-aware
+    # commits over a seeded client-lifecycle simulator — FedBuff-style
+    # semi-async (commit on K buffered results or a deadline), FedAsync
+    # as the K=1 degenerate config.  PERF.md "Async federation".
+    p.add_argument("--async", dest="async_mode", action="store_true",
+                   help="run the buffered asynchronous scheduler "
+                        "(fedml_tpu/async_) instead of synchronous "
+                        "rounds: commits fire on --async_buffer_k "
+                        "buffered results or --async_round_deadline_s, "
+                        "client results are staleness-discounted "
+                        "(--async_staleness), and client churn comes "
+                        "from the seeded lifecycle simulator "
+                        "(--async_latency/--async_dropout_prob).  "
+                        "comm_round counts COMMITS.  FedAvg/FedProx "
+                        "only; incompatible with --mesh")
+    p.add_argument("--async_buffer_k", type=int, default=None,
+                   help="aggregation-buffer capacity K (default "
+                        "client_num_per_round; 1 = pure FedAsync)")
+    p.add_argument("--async_concurrency", type=int, default=None,
+                   help="clients in flight at once (default "
+                        "max(buffer_k, client_num_per_round))")
+    p.add_argument("--async_round_deadline_s", type=float, default=None,
+                   help="commit a part-full buffer after this many "
+                        "(simulated) seconds since the last commit — "
+                        "the crash/straggler escape hatch")
+    p.add_argument("--async_staleness", type=str, default="constant",
+                   choices=("constant", "polynomial", "hinge"),
+                   help="staleness-discount family (FedAsync §5)")
+    p.add_argument("--async_staleness_a", type=float, default=0.5,
+                   help="polynomial exponent / hinge slope")
+    p.add_argument("--async_staleness_b", type=float, default=4.0,
+                   help="hinge knee (staleness where discounting starts)")
+    p.add_argument("--async_mix", type=float, default=1.0,
+                   help="server mixing rate alpha: v <- (1-a)v + "
+                        "a*discounted_buffer_mean (1.0 installs the "
+                        "mean — the FedAvg-degenerate setting)")
+    p.add_argument("--async_seed", type=int, default=None,
+                   help="lifecycle-simulator seed (default --seed); two "
+                        "runs with equal seeds produce identical event "
+                        "traces")
+    p.add_argument("--async_latency", type=str, default="none",
+                   choices=("none", "lognormal", "pareto"),
+                   help="per-dispatch client latency family")
+    p.add_argument("--async_latency_scale", type=float, default=1.0,
+                   help="latency scale in simulated seconds")
+    p.add_argument("--async_latency_sigma", type=float, default=0.5,
+                   help="lognormal sigma / per-client heterogeneity "
+                        "uses --async_heterogeneity")
+    p.add_argument("--async_pareto_alpha", type=float, default=2.0,
+                   help="pareto tail index for --async_latency pareto "
+                        "(>1 for a finite mean; lower = heavier tail)")
+    p.add_argument("--async_heterogeneity", type=float, default=0.0,
+                   help="per-client persistent speed-factor spread "
+                        "(lognormal sigma; 0 = homogeneous fleet)")
+    p.add_argument("--async_dropout_prob", type=float, default=0.0,
+                   help="P(crash mid-round) per dispatch")
+    p.add_argument("--async_rejoin_prob", type=float, default=1.0,
+                   help="P(a crashed client ever rejoins)")
+    p.add_argument("--async_rejoin_delay_s", type=float, default=5.0,
+                   help="mean rejoin delay (exponential, simulated s)")
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
     p.add_argument("--streaming", action="store_true",
                    help="host-resident client stack; upload only each "
@@ -344,9 +404,46 @@ def _stack_dtype(args):
         "bfloat16, or uint8)")
 
 
+def _build_async_engine(args, cfg: FedConfig, data):
+    """--async: the buffered staleness-aware scheduler over the seeded
+    lifecycle simulator (fedml_tpu/async_).  FedAvg/FedProx only — the
+    commit program is the FedAvg mixing rule; other aggregation families
+    have no async formulation here yet."""
+    from fedml_tpu.async_ import AsyncFedAvgEngine, LifecycleConfig
+    if args.algorithm not in ("fedavg", "fedprox"):
+        raise SystemExit(f"--async supports fedavg/fedprox, not "
+                         f"{args.algorithm!r}")
+    if args.mesh:
+        raise SystemExit("--async runs the vmap dispatch-wave engine; "
+                         "--mesh is not supported (the async cohort is "
+                         "bounded by --async_concurrency, not HBM)")
+    lc = LifecycleConfig(
+        latency=args.async_latency,
+        latency_scale=args.async_latency_scale,
+        latency_sigma=args.async_latency_sigma,
+        pareto_alpha=args.async_pareto_alpha,
+        heterogeneity=args.async_heterogeneity,
+        dropout_prob=args.async_dropout_prob,
+        rejoin_prob=args.async_rejoin_prob,
+        rejoin_delay_s=args.async_rejoin_delay_s,
+        seed=args.async_seed if args.async_seed is not None else cfg.seed)
+    return AsyncFedAvgEngine(
+        _trainer(cfg, data), data, cfg,
+        buffer_k=args.async_buffer_k,
+        concurrency=args.async_concurrency,
+        staleness=args.async_staleness,
+        staleness_a=args.async_staleness_a,
+        staleness_b=args.async_staleness_b,
+        mix=args.async_mix,
+        round_deadline_s=args.async_round_deadline_s,
+        lifecycle_cfg=lc)
+
+
 def build_engine(args, cfg: FedConfig, data):
     """Algorithm dispatch (the reference's fed_launch algorithm select)."""
     algo = args.algorithm
+    if getattr(args, "async_mode", False):
+        return _build_async_engine(args, cfg, data)
     mesh = None
     if args.mesh_batch is not None and args.mesh_batch < 1:
         raise SystemExit(f"--mesh_batch must be >= 1, got {args.mesh_batch}")

@@ -22,6 +22,14 @@ from fedml_tpu.comm.message import Message
 log = logging.getLogger(__name__)
 
 
+class ManagerClosedError(RuntimeError):
+    """send_message on a finished manager.  Raised so wrong shutdown
+    ordering fails loudly; the ONE benign case — a handler that was
+    already in flight when another thread called finish() — is caught
+    at the FSM dispatch chokepoint (receive_message) and degraded to a
+    logged drop, matching the pre-guard behavior for that race."""
+
+
 def _build_backend(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
     b = backend.upper()
     if b == "INPROC":
@@ -66,6 +74,7 @@ class _Manager(Observer):
         self.com_manager.add_observer(self)
         self.message_handler_dict: dict[object, Callable[[Message], None]] = {}
         self._thread: Optional[threading.Thread] = None
+        self._closed = False
 
     # -- reference API -------------------------------------------------------
     def register_message_receive_handler(self, msg_type,
@@ -85,9 +94,29 @@ class _Manager(Observer):
         with obs.span("comm.handle", backend=self.backend_name,
                       node=self.node_type, rank=self.rank,
                       msg_type=str(msg_type)):
-            handler(msg)
+            try:
+                handler(msg)
+            except ManagerClosedError:
+                if not self._closed:
+                    raise      # a PEER's closed manager: real FSM bug
+                # this manager finished while the handler was in
+                # flight — its reply has nowhere to go; drop like the
+                # pre-guard code did instead of killing the recv loop
+                log.warning("%s rank %d: dropped handler send for %r "
+                            "(manager finished mid-handler)",
+                            self.node_type, self.rank, msg_type)
 
     def send_message(self, msg: Message) -> None:
+        if self._closed:
+            # loud, not silent: a send after finish() means the caller's
+            # shutdown ordering is wrong (e.g. an async commit racing a
+            # teardown) — dropping the frame here would surface later as
+            # a peer hanging on a message that never left this process.
+            # (receive_message downgrades the one benign case — a
+            # handler already in flight when finish() landed.)
+            raise ManagerClosedError(
+                f"{self.node_type} rank {self.rank}: send_message after "
+                f"finish() — the manager is closed")
         with obs.span("comm.send", backend=self.backend_name,
                       node=self.node_type, rank=self.rank,
                       msg_type=str(msg.get_type()),
@@ -114,7 +143,15 @@ class _Manager(Observer):
 
     def finish(self) -> None:
         """Graceful stop — the reference calls MPI.COMM_WORLD.Abort()
-        (client_manager.py:70-79); we just stop the loop and close."""
+        (client_manager.py:70-79); we stop the loop, close the backend,
+        and JOIN the run_async() receive thread (with a bounded timeout:
+        a backend whose recv loop is wedged must not hang teardown
+        forever — the leak is logged instead).  Idempotent, and marks
+        the manager closed so late send_message calls fail loudly
+        instead of racing the closed transport."""
+        if self._closed:
+            return
+        self._closed = True
         self.com_manager.stop_receive_message()
         close = getattr(self.com_manager, "close", None)
         if close is not None:
@@ -122,6 +159,11 @@ class _Manager(Observer):
         if (self._thread is not None
                 and self._thread is not threading.current_thread()):
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                log.warning(
+                    "%s rank %d: receive thread still alive 10s after "
+                    "finish() — backend recv loop did not stop",
+                    self.node_type, self.rank)
 
 
 class ClientManager(_Manager):

@@ -36,6 +36,15 @@ DEFAULT_SECONDS_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
 )
 
+# Staleness buckets for the async federation's `async_staleness`
+# histogram (fedml_tpu/async_): staleness is COMMIT counts, not seconds
+# — integer-valued, small in healthy runs (FedBuff's useful regime is
+# single digits), heavy-tailed under churn.  Shared here so the
+# scheduler and the messaging FSM register one compatible histogram
+# (the registry rejects same-name/different-bucket registrations).
+STALENESS_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
+                     24.0, 32.0, 48.0, 64.0)
+
 
 def _label_key(labels: dict) -> tuple:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))

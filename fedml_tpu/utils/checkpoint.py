@@ -41,9 +41,18 @@ class FedCheckpointManager:
                                                  create=True))
 
     def save(self, round_idx: int, variables: Pytree,
-             server_state: Pytree = ()) -> None:
+             server_state: Pytree = (),
+             extra_state: Optional[Pytree] = None) -> None:
+        """`extra_state` carries engine-specific round state beyond the
+        (variables, server_state) pair — the async engine checkpoints
+        its aggregation-buffer contents and per-client staleness
+        counters through it (fedml_tpu/async_/scheduler.py
+        async_state()).  Only written when provided, so synchronous
+        checkpoints keep their existing on-disk structure."""
         state = {"variables": variables,
                  "server_state": _wrap_empty(server_state)}
+        if extra_state is not None:
+            state["extra_state"] = extra_state
         self._mgr.save(round_idx, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
 
@@ -52,14 +61,22 @@ class FedCheckpointManager:
 
     def restore(self, variables_template: Pytree,
                 server_state_template: Pytree = (),
-                round_idx: Optional[int] = None):
+                round_idx: Optional[int] = None,
+                extra_template: Optional[Pytree] = None):
         """Returns (round_idx, variables, server_state); templates define
         the pytree structure/dtypes (pass engine.init_variables() /
-        engine.server_init(v))."""
+        engine.server_init(v)).  With `extra_template` the checkpoint's
+        extra_state is restored too and a 4-tuple is returned — only
+        for checkpoints that were saved with one."""
         step = round_idx if round_idx is not None else self.latest_step_or_raise()
         template = {"variables": variables_template,
                     "server_state": _wrap_empty(server_state_template)}
+        if extra_template is not None:
+            template["extra_state"] = extra_template
         out = self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        if extra_template is not None:
+            return (step, out["variables"],
+                    _unwrap_empty(out["server_state"]), out["extra_state"])
         return step, out["variables"], _unwrap_empty(out["server_state"])
 
     def latest_step_or_raise(self) -> int:

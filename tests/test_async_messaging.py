@@ -1,0 +1,182 @@
+"""Async messaging FSM tests (fedml_tpu/async_/lifecycle.py) + the
+comm-manager shutdown satellite.
+
+The real-thread path: AsyncServerManager/AsyncClientManager over the
+in-proc router — frames go through MessageCodec, so the wire codec and
+the per-backend byte/message counters see genuine async traffic; the
+lifecycle simulator injects crashes (dropped replies) and latencies
+(real, millisecond-scale sleeps here).  Ordering is thread-scheduled,
+so these tests assert PROTOCOL invariants (commit counts, staleness
+recorded, recovery under loss), not bitwise values — the deterministic
+pins live in test_async.py's virtual-time path.
+"""
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu import obs
+from fedml_tpu.async_ import (ClientLifecycle, LifecycleConfig,
+                              run_async_messaging)
+from fedml_tpu.comm import ClientManager, InProcRouter, Message
+
+from parallel_case import _mnist_like_cfg, _setup
+
+
+def _small_setup(n_clients=4):
+    cfg = _mnist_like_cfg(client_num_in_total=n_clients,
+                          client_num_per_round=n_clients, comm_round=4)
+    trainer, data = _setup(cfg)
+    return cfg, trainer, data
+
+
+def test_async_messaging_commits_and_staleness_over_wire():
+    """4 workers, buffer of 2: the server reaches its commit budget and
+    the staleness accounting sees the version lag a 2-of-4 buffer
+    necessarily produces; every payload crossed the codec (byte
+    counters moved)."""
+    cfg, trainer, data = _small_setup()
+    sent0 = obs.counter("comm_sent_bytes_total", backend="inproc").value
+    v, server = run_async_messaging(trainer, data, cfg, buffer_k=2,
+                                    total_commits=4, timeout_s=120)
+    assert server.version == 4
+    assert len(server.staleness_seen) >= 8      # 4 commits x K=2
+    assert all(s >= 0.0 for s in server.staleness_seen)
+    assert np.isfinite(float(jax.tree.leaves(v)[0].ravel()[0]))
+    sent1 = obs.counter("comm_sent_bytes_total", backend="inproc").value
+    assert sent1 > sent0                        # real frames, real codec
+
+
+def test_async_messaging_crash_recovers_via_deadline():
+    """One worker crashes on EVERY dispatch while the healthy one is
+    slow relative to the deadline: the buffer can never reach K inside
+    a deadline window, so every commit is a deadline (partial) commit —
+    and the federation still reaches its budget.  Crash-mid-round is
+    survivable, not fatal."""
+    cfg, trainer, data = _small_setup(n_clients=2)
+
+    class CrashOne(ClientLifecycle):
+        def draw_crash(self, client_id):
+            return client_id == 1               # a permanently dead device
+
+        def draw_latency(self, client_id):
+            return 0.4                          # slow vs the 0.05 deadline
+
+    lc = CrashOne(LifecycleConfig(seed=0), 2)
+    v, server = run_async_messaging(trainer, data, cfg, buffer_k=2,
+                                    total_commits=3, worker_num=2,
+                                    deadline_s=0.05, timeout_s=60,
+                                    lifecycle=lc)
+    assert server.version == 3
+    assert server.partial_commits >= 1          # deadline path exercised
+    assert server.buffer.count == 0
+
+
+def test_async_messaging_stall_dumps_flight_and_raises(tmp_path):
+    """EVERY worker crashes on every dispatch and no deadline is set:
+    the launcher must dump the flight recorder (scheduler-deadlock
+    artifact) and raise, never hang."""
+    cfg, trainer, data = _small_setup(n_clients=2)
+
+    class CrashAll(ClientLifecycle):
+        def draw_crash(self, client_id):
+            return True
+
+    obs.reset()
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    try:
+        with pytest.raises(TimeoutError, match="async federation stalled"):
+            run_async_messaging(
+                trainer, data, cfg, buffer_k=2, total_commits=2,
+                worker_num=2, timeout_s=1.5,
+                lifecycle=CrashAll(LifecycleConfig(seed=0), 2))
+        import json
+        reasons = [json.load(open(d))["reason"]
+                   for d in obs.flight().dumps]
+        assert any("async_scheduler_deadlock" in r for r in reasons), reasons
+    finally:
+        obs.reset()
+
+
+# -- comm-manager shutdown satellite ----------------------------------------
+
+class _Echo(ClientManager):
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(1, lambda msg: None)
+
+
+def test_manager_finish_joins_thread_and_guards_sends():
+    """ISSUE-5 satellite: finish() must JOIN the run_async() receive
+    thread (bounded), be idempotent, and close the manager so a late
+    send fails loudly instead of racing the closed transport."""
+    router = InProcRouter()
+    m = _Echo(0, 1, "INPROC", router=router)
+    t = m.run_async()
+    assert t.is_alive()
+    m.send_message(Message(1, 0, 0))            # open manager: sends fine
+    m.finish()
+    assert not t.is_alive(), "finish() did not join the receive thread"
+    with pytest.raises(RuntimeError, match="after finish"):
+        m.send_message(Message(1, 0, 0))
+    m.finish()                                  # idempotent, no raise
+    assert not t.is_alive()
+
+
+def test_manager_finish_mid_handler_drops_send_not_crash():
+    """The one benign closed-send race: finish() lands while a handler
+    is still in flight; the handler's reply must be DROPPED with a log
+    (pre-guard behavior), not raise through the receive loop and kill
+    the thread mid-teardown."""
+    router = InProcRouter()
+    entered = threading.Event()
+    sent_after_close = {"raised": False}
+
+    class SlowEcho(ClientManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(5, self._echo)
+
+        def _echo(self, msg):
+            entered.set()
+            time.sleep(0.3)                  # finish() lands here
+            try:
+                self.send_message(Message(5, 0, 0))
+            except BaseException:
+                sent_after_close["raised"] = True
+                raise
+
+    m = SlowEcho(0, 1, "INPROC", router=router)
+    t = m.run_async()
+    router.route(Message(5, 0, 0))
+    assert entered.wait(2.0)
+    m.finish()                               # while the handler sleeps
+    t.join(timeout=5.0)
+    assert not t.is_alive()                  # loop exited cleanly
+    assert sent_after_close["raised"]        # the guard did fire...
+    # ...but was downgraded at the dispatch chokepoint — the thread
+    # died by sentinel, not by exception (join above proves it)
+
+
+def test_manager_finish_from_handler_thread_does_not_self_join():
+    """A manager that finishes ITSELF from inside its own handler (the
+    async client's STOP path) must not deadlock trying to join its own
+    thread — the loop exits and the thread dies on its own."""
+    router = InProcRouter()
+    done = threading.Event()
+
+    class SelfStop(ClientManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(9, self._stop)
+
+        def _stop(self, msg):
+            self.finish()
+            done.set()
+
+    m = SelfStop(0, 1, "INPROC", router=router)
+    t = m.run_async()
+    router.route(Message(9, 0, 0))
+    assert done.wait(timeout=5.0)
+    t.join(timeout=5.0)
+    assert not t.is_alive()

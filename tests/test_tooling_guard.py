@@ -63,6 +63,32 @@ def test_bench_json_schema_carries_byte_accounting():
         "TransferOverlapStats round records lost the h2d_bytes field")
 
 
+def test_bench_json_schema_v4_carries_async_block():
+    """ISSUE 5: schema v4 adds the async-mode fields — the "mode" key on
+    every line (v3 readers that ignore unknown keys keep working) and
+    the "async" block with committed updates, staleness percentiles and
+    buffer occupancy from `python bench.py --mode async`.  Static source
+    check like the v3 guard."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 4, (
+        "bench schema must stay >= v4 (async federation block)")
+    for field in ('"mode"', '"async"', "staleness_p50", "staleness_p95",
+                  "buffer_occupancy_mean", "committed_updates"):
+        assert field in src, (
+            f"bench.py lost the v4 async field {field} "
+            "(see fedml_tpu/async_ and _bench_async)")
+    # the async block's numbers come from the engine's rollup — the
+    # field names above must stay in sync with it
+    sched = open(os.path.join(os.path.dirname(__file__), "..",
+                              "fedml_tpu", "async_", "scheduler.py")).read()
+    for field in ("committed_updates", "staleness_p50", "staleness_p95",
+                  "buffer_occupancy_mean"):
+        assert field in sched, (
+            f"AsyncFedAvgEngine.async_report lost {field!r} — bench.py's "
+            "v4 async block reads it")
+
+
 def test_copy_audit_ceilings_artifact_exists():
     """ISSUE 4: the copy-regression gate needs its pinned artifacts —
     the per-family ceilings (with a machine-readable calibration env)
@@ -102,3 +128,18 @@ def test_chip_queue_carries_donate_ab():
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr
+
+
+def test_chip_queue_carries_async_ab():
+    """ISSUE 5: the next chip window must price the async federation —
+    scripts/run_chip_queue.sh carries the ASYNC A/B step and
+    profile_bench.py defines the exp_ASYNC experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    assert "profile_bench.py ASYNC" in open(queue).read(), (
+        "run_chip_queue.sh lost the ASYNC buffered-aggregation A/B "
+        "(ISSUE 5 queues it for the next chip window)")
+    assert "exp_ASYNC" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_ASYNC experiment the queue runs")

@@ -280,12 +280,30 @@ def build_family_programs(donate: bool = True,
         out["gossip"] = [
             ("round", eng.round_fn, (wv, stack, stack_w, rng))]
 
+    if want("async_commit"):
+        # the async federation's staleness-discounted commit program
+        # (fedml_tpu/async_/staleness.py): donated variables + a flat
+        # [K, P] buffer-row matrix — the flat-carry layout, so a
+        # relayout/donation regression in the commit shows up here like
+        # the round programs' (ISSUE 5 acceptance gate)
+        import jax.numpy as jnp
+        from fedml_tpu.async_.staleness import flat_dim, make_commit_fn
+        v = trainer.init(rng, jnp.asarray(data.client_shards["x"][0, 0]))
+        K = 8
+        commit = make_commit_fn(v, mode="polynomial", a=0.5,
+                                donate=donate)
+        rows = jnp.zeros((K, flat_dim(v)), jnp.float32)
+        w = jnp.ones((K,), jnp.float32)
+        s = jnp.zeros((K,), jnp.float32)
+        out["async_commit"] = [
+            ("commit", commit, (v, rows, w, s, jnp.float32(1.0)))]
+
     return out
 
 
 ALL_FAMILIES = ("fedavg_resident", "fedavg_streaming", "fedavg_blockstream",
                 "fednova_resident", "robust_orderstat", "robust_blockstream",
-                "hierarchical", "gossip")
+                "hierarchical", "gossip", "async_commit")
 
 
 def audit_families(families: list[str] | None = None,

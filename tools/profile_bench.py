@@ -910,6 +910,43 @@ def exp_NWP():
               f"{dt*1e3:.2f} ms per 13-step local epoch", flush=True)
 
 
+def exp_ASYNC():
+    """Async federation A/B (ISSUE 5): committed-updates/sec of the
+    buffered staleness-aware scheduler (fedml_tpu/async_) on the bench
+    workload, at two buffer sizes against the same dispatch width —
+    K=8 (semi-async, 4x concurrency/K => genuine staleness under the
+    seeded lognormal lifecycle) vs K=32 (buffer == concurrency, the
+    near-synchronous end).  Latencies are SIMULATED (virtual clock), so
+    the wall prices the compute: dispatch-wave vmapped training + the
+    jitted flat-carry commit.  One async commit aggregates K results;
+    an A-row round aggregates all 128 — compare samples/sec, not raw
+    rates (the printout carries both)."""
+    import jax
+    from fedml_tpu.async_ import AsyncFedAvgEngine, LifecycleConfig
+
+    CONC, WARMUP, TIMED = 32, 2, 8
+    for K in (8, 32):
+        cfg, data, trainer = _bench_workload(N_CLIENTS)
+        cfg.frequency_of_the_test = 1        # wall_time per commit
+        lc = LifecycleConfig(latency="lognormal", latency_scale=1.0,
+                             latency_sigma=0.5, heterogeneity=0.5, seed=0)
+        engine = AsyncFedAvgEngine(trainer, data, cfg, buffer_k=K,
+                                   concurrency=CONC,
+                                   staleness="polynomial", staleness_a=0.5,
+                                   lifecycle_cfg=lc, donate=False)
+        total = WARMUP + TIMED
+        v = engine.run(rounds=total)
+        jax.block_until_ready(v)
+        walls = [m["wall_time"] for m in engine.metrics_history]
+        dt = (walls[total - 1] - walls[WARMUP - 1]) / TIMED
+        rep = engine.async_report()
+        print(f"ASYNC K={K} conc={CONC}: {dt:.3f}s/commit "
+              f"({K * SPC / dt:.0f} samples/s)  staleness p50/p95 "
+              f"{rep['staleness_p50']:.0f}/{rep['staleness_p95']:.0f}  "
+              f"buffer fill {rep['buffer_occupancy_mean'] / K:.2f}",
+              flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
