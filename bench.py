@@ -44,7 +44,18 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     staleness_p50/p95, buffer_occupancy_mean, deadline_commits —
 #     `python bench.py --mode async`, fedml_tpu/async_); null in sync
 #     mode, so v3 readers that ignore unknown keys keep working
-SCHEMA_VERSION = 4
+# v5: + "ingest" block (`python bench.py --mode ingest`, the
+#     concurrent-uplink ingestion torture, fedml_tpu/async_/torture.py):
+#     a "legacy" arm (the PR-5 path faithfully: inline decode on recv
+#     threads + unbounded inbox + drained O(K·P) commit), a
+#     "legacy_bounded_inbox" arm (same path + this PR's inbox
+#     backpressure — isolates the queue-discipline win), and
+#     decode-into+streaming "arms" per pool size, each carrying
+#     committed_updates_per_sec, decode_p50_s/decode_p95_s and
+#     lock_wait_seconds, plus the headline "speedup_vs_legacy"
+#     (best arm / legacy — the ISSUE-6 >=2x acceptance gate); null in
+#     sync/async modes
+SCHEMA_VERSION = 5
 
 
 def _git_sha() -> str:
@@ -140,12 +151,28 @@ def _probe_with_retry() -> tuple[bool, str]:
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser("bench")
-    ap.add_argument("--mode", choices=("sync", "async"), default="sync",
+    ap.add_argument("--mode", choices=("sync", "async", "ingest"),
+                    default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
                          "scheduler (fedml_tpu/async_) — committed "
                          "updates/sec + staleness percentiles under the "
-                         "seeded lognormal-latency lifecycle")
+                         "seeded lognormal-latency lifecycle; ingest: the "
+                         "concurrent-uplink ingestion torture "
+                         "(fedml_tpu/async_/torture.py) — sustained "
+                         "committed-updates/sec of the server's "
+                         "decode+aggregate path under N saturating "
+                         "clients, legacy vs decode-into+streaming A/B")
+    ap.add_argument("--ingest_clients", type=int, default=32,
+                    help="ingest mode: concurrent uplink clients")
+    ap.add_argument("--ingest_backend", default="TCP",
+                    choices=("TCP", "GRPC", "INPROC"),
+                    help="ingest mode: transport under torture")
+    ap.add_argument("--ingest_pools", default="1,4,8",
+                    help="ingest mode: comma-separated decode-pool sizes "
+                         "for the decode-into+streaming arms")
+    ap.add_argument("--ingest_commits", type=int, default=30,
+                    help="ingest mode: timed commits per arm")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -165,6 +192,7 @@ def main() -> None:
             "overlap_fraction": None,
             "h2d_bytes_per_round": None,
             "async": None,
+            "ingest": None,
             "error": "chip_unavailable",
             "detail": detail,
         })))
@@ -179,6 +207,9 @@ def main() -> None:
     # bench run (Chrome trace + Prometheus snapshot land there); the
     # default-off path adds nothing to the timed loop
     obs.configure_from_env()
+    if args.mode == "ingest":
+        _bench_ingest(args)
+        return
     import jax.numpy as jnp
 
     from fedml_tpu.core.trainer import ClientTrainer
@@ -281,6 +312,7 @@ def main() -> None:
         "vs_baseline": round(rps / ESTIMATED_REFERENCE_ROUNDS_PER_SEC, 4),
         "mode": "sync",
         "async": None,
+        "ingest": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -358,6 +390,114 @@ def _bench_async(cfg, data, trainer) -> None:
         "rounds": [],
         "async": {k: (round(v, 4) if isinstance(v, float) else v)
                   for k, v in rep.items()},
+        "ingest": None,
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+# ingest-mode shape: 8-deep buffer under 32 saturating clients is the
+# same 4x oversubscription the async bench runs, and 30 timed commits
+# (240 committed updates) keep even the slow legacy arm's wall around a
+# minute on a small box.
+INGEST_BUFFER_K = 8
+INGEST_WARMUP_COMMITS = 5
+
+
+def _bench_ingest(args) -> None:
+    """Concurrent-uplink ingestion torture (ISSUE 6): N in-process
+    clients saturate one transport with pre-encoded result frames while
+    the server ingests and commits.  Arms: the PR-5 legacy path
+    faithfully (inline decode on the recv threads, unbounded inbox,
+    drained O(K·P) commit), the same path with ONLY this PR's inbox
+    backpressure (queue-discipline isolation), and decode-into +
+    streaming aggregation-on-arrival at each --ingest_pools size.  The
+    headline is speedup_vs_legacy = best arm / legacy sustained
+    committed-updates/sec — the >=2x acceptance gate."""
+    from fedml_tpu import obs
+    from fedml_tpu.async_.torture import run_ingest_torture
+
+    pools = [int(p) for p in str(args.ingest_pools).split(",") if p.strip()]
+    if not pools or any(p < 1 for p in pools):
+        # fail BEFORE the two slow legacy arms burn their minutes; pool=0
+        # is the inline FSM route, which would mislabel the A/B table
+        raise SystemExit(
+            f"--ingest_pools must be a comma-separated list of decode-pool "
+            f"sizes >= 1, got {args.ingest_pools!r}")
+    port = int(os.environ.get("BENCH_INGEST_PORT", "53300"))
+
+    arm_no = [0]
+
+    def run(tag, **kw):
+        # fresh port per arm: the previous arm's listener may linger in
+        # TIME_WAIT, and a straggler client thread could still be
+        # connected to it
+        arm_no[0] += 1
+        rep = run_ingest_torture(
+            n_clients=args.ingest_clients, backend=args.ingest_backend,
+            buffer_k=INGEST_BUFFER_K, commits=args.ingest_commits,
+            warmup_commits=INGEST_WARMUP_COMMITS,
+            base_port=port + arm_no[0], **kw)
+        print(f"{tag}: {rep['committed_updates_per_sec']:.1f} updates/s  "
+              f"decode p50/p95 {rep['decode_p50_s'] * 1e3:.2f}/"
+              f"{rep['decode_p95_s'] * 1e3:.2f} ms  "
+              f"lock wait {rep['lock_wait_seconds']:.2f}s", file=sys.stderr)
+        return rep
+
+    legacy = run("legacy pool=0", ingest_pool=0, decode_into=False,
+                 streaming=False)
+    # queue-discipline isolation: the SAME decode+drain path with only
+    # this PR's inbox backpressure applied, so the table separates the
+    # "stop letting the heap absorb the uplinks" win from the
+    # decode-into/streaming win
+    bounded = run("legacy bounded-inbox", ingest_pool=0, decode_into=False,
+                  streaming=False, inbox_bound=2 * args.ingest_clients)
+    arms = [run(f"decode-into pool={p}", ingest_pool=p, decode_into=True,
+                streaming=True) for p in pools]
+    best = max(arms, key=lambda r: r["committed_updates_per_sec"])
+    legacy_ups = legacy["committed_updates_per_sec"]
+    doc = _stamp({
+        "metric": (f"async_ingest_{args.ingest_backend.lower()}_"
+                   f"{args.ingest_clients}clients_"
+                   "committed_updates_per_sec"),
+        "value": round(best["committed_updates_per_sec"], 4),
+        "unit": "updates/sec",
+        # the sync baseline estimate prices training FLOPs; the torture
+        # path trains nothing — the in-schema comparison is the legacy
+        # arm, so vs_baseline stays null by design
+        "vs_baseline": None,
+        "mode": "ingest",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": {
+            "backend": legacy["backend"],
+            "n_clients": legacy["n_clients"],
+            "buffer_k": legacy["buffer_k"],
+            "p": legacy["p"],
+            "frame_bytes": legacy["frame_bytes"],
+            "commits": legacy["commits"],
+            "legacy": {k: round(legacy[k], 6) for k in (
+                "committed_updates_per_sec", "decode_p50_s",
+                "decode_p95_s", "lock_wait_seconds")},
+            "legacy_bounded_inbox": {k: round(bounded[k], 6) for k in (
+                "committed_updates_per_sec", "decode_p50_s",
+                "decode_p95_s", "lock_wait_seconds")},
+            "arms": [{
+                "ingest_pool": a["ingest_pool"],
+                "committed_updates_per_sec": round(
+                    a["committed_updates_per_sec"], 4),
+                "decode_p50_s": round(a["decode_p50_s"], 6),
+                "decode_p95_s": round(a["decode_p95_s"], 6),
+                "lock_wait_seconds": round(a["lock_wait_seconds"], 4),
+            } for a in arms],
+            "speedup_vs_legacy": round(
+                best["committed_updates_per_sec"] / legacy_ups, 2)
+                if legacy_ups > 0 else None,
+        },
     })
     if obs.enabled():
         obs.export()

@@ -1,28 +1,38 @@
 """Async federation subsystem — buffered staleness-aware aggregation.
 
-Three layers (module docstrings have the full design):
+Four layers (module docstrings have the full design):
 
   staleness.py   staleness-discount weight families (constant /
-                 polynomial / hinge), the flat-carry [K, P] buffer, and
-                 the jitted donation-friendly commit program
+                 polynomial / hinge), the flat-carry [K, P] buffer —
+                 drain mode and streaming aggregation-on-arrival (the
+                 jitted donated fold + O(P) stream commit, ISSUE 6) —
+                 and the RowLayout the decode-into fast path targets
   scheduler.py   AsyncFedAvgEngine — event-driven virtual-time
                  scheduler (FedBuff semi-async; FedAsync at K=1) with
                  dispatch-wave vmapped training
   lifecycle.py   seeded client-lifecycle simulator (latency / dropout /
                  rejoin / crash) + the AsyncServerManager /
-                 AsyncClientManager FSM pair over the comm backends
+                 AsyncClientManager FSM pair over the comm backends,
+                 with the bounded parallel-decode ingest pool
+  torture.py     concurrent-uplink ingestion torture bench
+                 (bench.py --mode ingest / profile_bench exp_INGEST)
 """
 from fedml_tpu.async_.lifecycle import (AsyncClientManager, AsyncMessage,
                                         AsyncServerManager, ClientLifecycle,
                                         LifecycleConfig,
                                         run_async_messaging)
 from fedml_tpu.async_.scheduler import AsyncFedAvgEngine
-from fedml_tpu.async_.staleness import (AsyncBuffer, STALENESS_MODES,
-                                        make_commit_fn, staleness_weight)
+from fedml_tpu.async_.staleness import (AsyncBuffer, RowLayout,
+                                        STALENESS_MODES, make_commit_fn,
+                                        make_drain_fold_fn, make_fold_fn,
+                                        make_stream_commit_fn,
+                                        staleness_weight)
+from fedml_tpu.async_.torture import run_ingest_torture
 
 __all__ = [
     "AsyncBuffer", "AsyncClientManager", "AsyncFedAvgEngine",
     "AsyncMessage", "AsyncServerManager", "ClientLifecycle",
-    "LifecycleConfig", "STALENESS_MODES", "make_commit_fn",
-    "run_async_messaging", "staleness_weight",
+    "LifecycleConfig", "RowLayout", "STALENESS_MODES", "make_commit_fn",
+    "make_drain_fold_fn", "make_fold_fn", "make_stream_commit_fn",
+    "run_async_messaging", "run_ingest_torture", "staleness_weight",
 ]

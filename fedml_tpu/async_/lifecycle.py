@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import queue
 import threading
 import time
 from typing import Any, Optional
@@ -35,9 +36,10 @@ import numpy as np
 
 from fedml_tpu import obs
 from fedml_tpu.comm.managers import ClientManager, ServerManager
-from fedml_tpu.comm.message import Message
-from fedml_tpu.async_.staleness import (AsyncBuffer, flat_dim,
+from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.async_.staleness import (AsyncBuffer, RowLayout, flat_dim,
                                         flatten_vars_row, make_commit_fn,
+                                        make_stream_commit_fn,
                                         unflatten_rows)
 
 log = logging.getLogger(__name__)
@@ -149,14 +151,40 @@ class AsyncServerManager(ServerManager):
     the new version immediately; on a deadline commit, clients whose
     outstanding dispatch is older than the previous version are
     presumed crashed and redispatched too (counted in
-    `async_redispatch_total` — the lifecycle's rejoin path)."""
+    `async_redispatch_total` — the lifecycle's rejoin path).
+
+    Ingestion hot path (ISSUE 6).  Three orthogonal knobs:
+
+    * `streaming` (default True): aggregation-on-arrival — each result
+      folds w̃·row into the buffer's running flat f32 accumulator
+      (staleness.make_fold_fn), so the commit is the O(P)
+      make_stream_commit_fn mix instead of the O(K·P) drained
+      reduction.  `streaming=False` keeps the PR-5 drain path — the
+      perf A/B's legacy arm, and bitwise-anchored to sync FedAvg.
+    * `ingest_pool` (default 0): a bounded decode pool fed RAW frames
+      by the backend's frame sink (comm/base.py), so wire decode runs
+      off the transport recv threads; zlib and the numpy cast/copy hot
+      spots release the GIL, so decodes of concurrent uplinks overlap.
+      Saturation blocks the sink — transport flow control is the
+      backpressure.  0 = decode inline in the recv path (the FSM
+      route).
+    * `decode_into` (default True, pool only): decode v2/v1 frames
+      straight into preallocated scratch rows at the RowLayout offsets
+      (MessageCodec.decode_into) — no intermediate pytree, one pass
+      per leaf.  False decodes zero-copy (copy="never") and
+      re-flattens, isolating the decode-into win in the A/B.
+
+    `redispatch=False` (torture-bench mode) never sends downlinks:
+    clients push uplinks at their own rate and the server only ingests
+    and commits."""
 
     def __init__(self, init_variables: Pytree, total_commits: int,
                  buffer_k: int, rank: int = 0, size: int = 1,
                  backend: str = "INPROC", staleness_mode: str = "constant",
                  staleness_a: float = 0.5, staleness_b: float = 4.0,
-                 mix: float = 1.0,
-                 deadline_s: Optional[float] = None, **kw):
+                 mix: float = 1.0, deadline_s: Optional[float] = None,
+                 streaming: bool = True, ingest_pool: int = 0,
+                 decode_into: bool = True, redispatch: bool = True, **kw):
         super().__init__(rank, size, backend, **kw)
         import jax
         self.variables = jax.tree.map(np.asarray, init_variables)
@@ -164,13 +192,29 @@ class AsyncServerManager(ServerManager):
         self.buffer_k = buffer_k
         self.mix = float(mix)
         self.deadline_s = deadline_s
+        self.streaming = streaming
+        self.decode_into = decode_into
+        self.redispatch = redispatch
+        self.ingest_pool = int(ingest_pool)
         self.version = 0
         self.partial_commits = 0
+        self.updates_committed = 0
         self.staleness_seen: list[float] = []
-        self.buffer = AsyncBuffer(buffer_k, flat_dim(self.variables))
-        self._commit = make_commit_fn(self.variables, mode=staleness_mode,
-                                      a=staleness_a, b=staleness_b,
-                                      donate=False)
+        self.commit_walls: list[float] = []      # perf_counter per commit
+        self.commit_sizes: list[int] = []        # n_real per commit
+        p = flat_dim(self.variables)
+        self.buffer = AsyncBuffer(buffer_k, p, streaming=streaming,
+                                  staleness_mode=staleness_mode,
+                                  staleness_a=staleness_a,
+                                  staleness_b=staleness_b)
+        if streaming:
+            self._commit = make_stream_commit_fn(self.variables,
+                                                 donate=False)
+        else:
+            self._commit = make_commit_fn(self.variables,
+                                          mode=staleness_mode,
+                                          a=staleness_a, b=staleness_b,
+                                          donate=False)
         self._lock = threading.Lock()
         self._watchdog: Optional[threading.Timer] = None
         # rank -> version of its outstanding dispatch (None = idle)
@@ -183,6 +227,42 @@ class AsyncServerManager(ServerManager):
         self._m_commits = obs.counter("async_commits_total")
         self._m_deadline = obs.counter("async_deadline_commits_total")
         self._m_redispatch = obs.counter("async_redispatch_total")
+        self._m_lock_wait = obs.counter("async_lock_wait_seconds")
+        self._m_pool_depth = obs.gauge("async_ingest_pool_depth")
+        self._m_decode = obs.histogram(
+            "comm_decode_seconds",
+            buckets=obs.metrics.DECODE_SECONDS_BUCKETS,
+            backend=self.com_manager.backend_name)
+        self._layout = RowLayout(self.variables,
+                                 AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        self._pool = None
+        if self.ingest_pool > 0:
+            # the pool only sees traffic on backends that route raw
+            # frames through _deliver_frame; MQTT speaks broker JSON and
+            # a no-encode inproc router hands Message objects across —
+            # fall back to inline decode loudly instead of building an
+            # idle pool that an A/B would silently mislabel
+            cm = self.com_manager
+            if not cm.supports_frame_sink:
+                log.warning(
+                    "ingest_pool=%d has no effect on the %s backend "
+                    "(frames never reach the raw-frame sink) — decoding "
+                    "inline instead", self.ingest_pool, cm.backend_name)
+                self.ingest_pool = 0
+        if self.ingest_pool > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.ingest_pool,
+                thread_name_prefix="async-ingest")
+            # scratch rows sized to the in-flight bound: tasks hold at
+            # most 2x pool rows (the semaphore's submit bound), so the
+            # free-list never starves and never grows
+            self._scratch: "queue.Queue[np.ndarray]" = queue.Queue()
+            for _ in range(2 * self.ingest_pool):
+                self._scratch.put(np.empty((p,), np.float32))
+            self._ingest_sem = threading.BoundedSemaphore(
+                2 * self.ingest_pool)
+            self.com_manager.set_frame_sink(self._ingest_frame)
 
     # -- dispatch ------------------------------------------------------------
     def send_start(self) -> None:
@@ -206,26 +286,102 @@ class AsyncServerManager(ServerManager):
             AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, self._handle_result)
 
     def _handle_result(self, msg: Message) -> None:
-        sender = msg.get_sender_id()
-        dispatched = int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION))
-        variables = msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)
-        n = float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES))
-        row = flatten_vars_row(variables)
-        with self._lock:
+        """FSM route (ingest_pool=0): the backend decoded the frame
+        inline; flatten and fold/insert."""
+        row = flatten_vars_row(msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        self._ingest_row(
+            msg.get_sender_id(), row,
+            float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES)),
+            int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+
+    # -- parallel ingest (frame sink + decode pool) --------------------------
+    def _ingest_frame(self, payload) -> Optional[Message]:
+        """Frame sink, called on the backend's recv threads with RAW
+        undecoded frames.  Bounded hand-off to the decode pool: when
+        2x pool tasks are already in flight, the acquire blocks this
+        recv thread and the transport's flow control backpressures the
+        sender — the pool can saturate, never the heap."""
+        if self.done.is_set() or self._closed:
+            return None                       # shutdown: drop late frames
+        self._ingest_sem.acquire()
+        self._m_pool_depth.inc()
+        try:
+            self._pool.submit(self._ingest_task, payload)
+        except RuntimeError:                  # pool torn down mid-flight
+            self._ingest_sem.release()
+            self._m_pool_depth.dec()
+        return None
+
+    def _ingest_task(self, payload) -> None:
+        """Decode-pool worker: decode one frame into a scratch row
+        (zlib + numpy casts release the GIL, so tasks overlap), then
+        fold it into the buffer."""
+        row = self._scratch.get()
+        try:
+            t0 = time.perf_counter()
+            msg = None
+            with obs.span("ingest.decode", nbytes=len(payload),
+                          into=self.decode_into):
+                if self.decode_into:
+                    try:
+                        msg = MessageCodec.decode_into(payload, row,
+                                                       self._layout)
+                    except ValueError:
+                        msg = None            # not a result frame / skew
+                if msg is None:
+                    # fallback (or the decode-into A/B's legacy arm):
+                    # zero-copy views + immediate re-flatten
+                    full = MessageCodec.decode(payload, copy="never")
+                    if (full.get_type()
+                            != AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT):
+                        # control traffic: hand to the FSM dispatch loop
+                        self.com_manager._on_message(full)
+                        return
+                    np.copyto(row, flatten_vars_row(
+                        full.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)))
+                    msg = full
+            self._m_decode.observe(time.perf_counter() - t0)
+            self._ingest_row(
+                msg.get_sender_id(), row,
+                float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES)),
+                int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+        except Exception:                     # never kill a pool worker
+            log.exception("ingest task failed (%d bytes)", len(payload))
+        finally:
+            self._scratch.put(row)
+            self._ingest_sem.release()
+            self._m_pool_depth.dec()
+
+    def _ingest_row(self, sender: int, row: np.ndarray, weight: float,
+                    dispatched: int) -> None:
+        """The ONE insert path (FSM route and decode pool both land
+        here): staleness accounting, buffer fold/insert, commit
+        trigger.  Lock acquisition is timed into
+        async_lock_wait_seconds — the contention signal of the
+        concurrent-uplink regime."""
+        t0 = time.perf_counter()
+        self._lock.acquire()
+        self._m_lock_wait.inc(time.perf_counter() - t0)
+        last = False
+        try:
             if self.done.is_set():
                 return                      # late straggler after shutdown
             staleness = float(self.version - dispatched)
             self.staleness_seen.append(staleness)
             self._m_staleness.observe(staleness)
-            full = self.buffer.add(row, n, staleness)
+            with obs.span("ingest.fold", sender=sender):
+                full = self.buffer.add(row, weight, staleness)
             self._m_occupancy.set(self.buffer.count)
             self._outstanding[sender] = None
             if not full:
                 # the contributing client would idle until the next
                 # commit; async has no barrier, so hand it work now
-                self._redispatch_locked([sender])
+                if self.redispatch:
+                    self._redispatch_locked([sender])
                 return
             last = self._commit_locked(deadline_fired=False)
+        finally:
+            self._lock.release()
         if last:
             self.stop_all()
 
@@ -249,9 +405,10 @@ class AsyncServerManager(ServerManager):
                 # nothing arrived a whole deadline long: presume every
                 # outstanding dispatch crashed, retry them all (the
                 # lifecycle's rejoin path), keep the heartbeat alive
-                self._redispatch_locked(
-                    [r for r, v in self._outstanding.items()
-                     if v is not None])
+                if self.redispatch:
+                    self._redispatch_locked(
+                        [r for r, v in self._outstanding.items()
+                         if v is not None])
                 self._arm_watchdog(self.version)
                 return
             last = self._commit_locked(deadline_fired=True)
@@ -259,23 +416,39 @@ class AsyncServerManager(ServerManager):
             self.stop_all()
 
     def _commit_locked(self, deadline_fired: bool) -> bool:
-        """Drain + jitted commit + redispatch; caller holds _lock.
-        Returns True when this was the last commit."""
+        """Jitted commit + redispatch; caller holds _lock.  Streaming
+        mode: O(P) mix of the server variables with the arrival
+        accumulator (the [K, P] reduction already happened at arrival
+        time).  Drain mode (legacy A/B arm): drain + the O(K·P)
+        tree_weighted_mean commit.  Returns True when this was the
+        last commit."""
         import jax
         import jax.numpy as jnp
         if self._watchdog is not None:
             self._watchdog.cancel()
             self._watchdog = None
-        rows, w, s, n_real = self.buffer.drain()
-        self._m_occupancy.set(0)
         with obs.span("async.commit", version=self.version,
-                      n_results=n_real, deadline=deadline_fired):
-            new_vars, _stats = self._commit(
-                jax.tree.map(jnp.asarray, self.variables),
-                jnp.asarray(rows), jnp.asarray(w), jnp.asarray(s),
-                jnp.float32(self.mix))
+                      streaming=self.streaming,
+                      n_results=self.buffer.count,
+                      deadline=deadline_fired):
+            if self.streaming:
+                acc, wsum, _w, _s, n_real, _raw = self.buffer.take_stream()
+                self._m_occupancy.set(0)
+                new_vars, _stats = self._commit(
+                    jax.tree.map(jnp.asarray, self.variables),
+                    acc, wsum, jnp.float32(self.mix))
+            else:
+                rows, w, s, n_real = self.buffer.drain()
+                self._m_occupancy.set(0)
+                new_vars, _stats = self._commit(
+                    jax.tree.map(jnp.asarray, self.variables),
+                    jnp.asarray(rows), jnp.asarray(w), jnp.asarray(s),
+                    jnp.float32(self.mix))
             self.variables = jax.tree.map(np.asarray, new_vars)
         self.version += 1
+        self.updates_committed += n_real
+        self.commit_walls.append(time.perf_counter())
+        self.commit_sizes.append(n_real)
         self._m_commits.inc()
         if deadline_fired:
             self.partial_commits += 1
@@ -286,9 +459,11 @@ class AsyncServerManager(ServerManager):
         # redispatch everyone idle; on a deadline commit also retry
         # ranks whose outstanding dispatch predates the PREVIOUS
         # version — two commits without a reply reads as a crash
-        retry = [r for r, v in self._outstanding.items()
-                 if v is None or (deadline_fired and v < self.version - 1)]
-        self._redispatch_locked(retry)
+        if self.redispatch:
+            retry = [r for r, v in self._outstanding.items()
+                     if v is None or (deadline_fired
+                                      and v < self.version - 1)]
+            self._redispatch_locked(retry)
         if self.deadline_s is not None:
             self._arm_watchdog(self.version)
         return False
@@ -300,15 +475,38 @@ class AsyncServerManager(ServerManager):
 
     def stop_all(self) -> None:
         """Broadcast STOP and close this manager (never under _lock —
-        finish() joins the receive thread, which may be waiting on it)."""
-        for rank in range(1, self.size):
-            try:
-                self.send_message(Message(
-                    AsyncMessage.MSG_TYPE_S2C_ASYNC_STOP, self.rank, rank))
-            except Exception:                  # a dead client's transport
-                log.warning("stop broadcast to rank %d failed", rank,
-                            exc_info=True)
+        finish() joins the receive thread, which may be waiting on it).
+        A no-downlink (redispatch=False) server skips the broadcast:
+        its torture clients have no listeners to stop."""
+        if self.redispatch:
+            for rank in range(1, self.size):
+                try:
+                    self.send_message(Message(
+                        AsyncMessage.MSG_TYPE_S2C_ASYNC_STOP, self.rank,
+                        rank))
+                except Exception:              # a dead client's transport
+                    log.warning("stop broadcast to rank %d failed", rank,
+                                exc_info=True)
         self.finish()
+
+    def finish(self) -> None:
+        """Tear down the decode pool before the base shutdown: done is
+        set (or the manager closed) so the sink drops new frames, and
+        in-flight tasks fall through _ingest_row's done guard.  The
+        shutdown WAITS for the in-flight tasks (bounded: the semaphore
+        caps them at 2x pool, none can block — scratch rows are sized
+        to the same bound) so callers reading the decode/lock-wait
+        metrics after finish() see a quiesced pool, not stragglers
+        still observing into the histograms — EXCEPT when finish() is
+        itself running on a pool worker (the final commit's
+        _ingest_row -> stop_all chain), where waiting would self-join;
+        there the pool drains on its own and an external finish()
+        (idempotent) does the quiescing join."""
+        if self._pool is not None:
+            on_worker = threading.current_thread().name.startswith(
+                "async-ingest")
+            self._pool.shutdown(wait=not on_worker)
+        super().finish()
 
 
 class AsyncClientManager(ClientManager):
@@ -389,6 +587,8 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
                         staleness_mode: str = "constant",
                         staleness_a: float = 0.5, staleness_b: float = 4.0,
                         mix: float = 1.0, deadline_s: Optional[float] = None,
+                        streaming: bool = True, ingest_pool: int = 0,
+                        decode_into: bool = True,
                         timeout_s: float = 600.0, **backend_kw):
     """Launch the async server + one lifecycle-simulated client per rank
     (threads for INPROC; for TCP/GRPC run one rank per process and call
@@ -417,7 +617,9 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
     server = AsyncServerManager(
         init_vars, total_commits, buffer_k, 0, size, backend,
         staleness_mode=staleness_mode, staleness_a=staleness_a,
-        staleness_b=staleness_b, mix=mix, deadline_s=deadline_s, **kw)
+        staleness_b=staleness_b, mix=mix, deadline_s=deadline_s,
+        streaming=streaming, ingest_pool=ingest_pool,
+        decode_into=decode_into, **kw)
     clients = [AsyncClientManager(trainer, data, cfg.epochs, r, size,
                                   backend, lifecycle=lifecycle, **kw)
                for r in range(1, size)]

@@ -33,9 +33,28 @@ commit program's donated inputs alias cleanly instead of paying a
 per-leaf relayout copy; tools/hlo_copy_audit.py audits the compiled
 commit program as the `async_commit` family against the pinned ceiling
 in benchmarks/hlo_copy_ceilings.json.
+
+Streaming aggregation-on-arrival (the ISSUE-6 ingestion path): instead
+of drain-then-reduce, each arrival folds w̃_i·row_i into a running flat
+f32 accumulator via a jitted donated fold step (make_fold_fn), and the
+commit shrinks to an O(P) mix of the server variables with ONE
+accumulator row (make_stream_commit_fn — audited as the
+`async_stream_commit` family, 0 copy ops).  The bitwise anchor is
+make_drain_fold_fn: a single compiled lax.scan over the drained [K, P]
+matrix whose per-lane ops are EXACTLY the arrival fold's — validated
+bitwise-equal to the per-arrival folds on this toolchain
+(tests/test_async.py), zero-weight pad lanes included, so full and
+deadline (partial) commits share one anchor.  NOTE the legacy drain
+commit (make_commit_fn) normalizes weights BEFORE the sum
+(tree_weighted_mean: Σ v_i·(w̃_i/W)); a streaming partial sum
+necessarily divides after (Σ w̃_i·v_i)/W, so the two FAMILIES agree to
+float tolerance, not bitwise — the streaming path is pinned against its
+own compiled drain twin, and make_commit_fn stays untouched as the
+scheduler's sync-FedAvg anchor.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import jax
@@ -99,6 +118,14 @@ def flatten_stacked_rows(stacked: Pytree) -> jax.Array:
         [l.reshape(C, -1).astype(jnp.float32) for l in leaves], axis=1)
 
 
+def unflatten_row(row: jax.Array, template: Pytree) -> Pytree:
+    """[P] flat row → pytree of the template's leaf shapes (in-program;
+    slices + reshapes only, bit-preserving — the single-row case of
+    unflatten_rows, so the offset walk has exactly one definition)."""
+    return jax.tree.map(lambda a: a[0], unflatten_rows(row[None, :],
+                                                       template))
+
+
 def unflatten_rows(rows: jax.Array, template: Pytree) -> Pytree:
     """[K, P] rows → [K, ...]-stacked pytree of the template's leaf
     shapes (in-program; slices + reshapes only, so values are
@@ -112,6 +139,39 @@ def unflatten_rows(rows: jax.Array, template: Pytree) -> Pytree:
         out.append(rows[:, off:off + size].reshape((K,) + tuple(l.shape)))
         off += size
     return jax.tree.unflatten(treedef, out)
+
+
+class RowLayout:
+    """Flat-row decode layout for MessageCodec.decode_into: wire-codec
+    array path (the codec's "/key/sub/leaf" strings) → (offset, size,
+    shape) in the [P] f32 row, in jax leaf order — flatten_vars_row's
+    element order, so a frame decoded into the row is bit-identical to
+    flatten_vars_row of the decoded pytree (f32/f64/bf16 leaves; int8
+    transport dequant reproduces _decode_transport's f64 affine math).
+
+    `key` is the message param the layout tiles (the async uplink's
+    model_params); every other param in a frame decodes normally."""
+
+    def __init__(self, template: Pytree, key: str):
+        from jax.tree_util import tree_flatten_with_path
+        self.key = key
+        self.offsets: dict[str, tuple[int, int, tuple]] = {}
+        off = 0
+        for path, leaf in tree_flatten_with_path(template)[0]:
+            parts = []
+            for k in path:
+                if hasattr(k, "key"):            # DictKey / FlattenedIndexKey
+                    parts.append(str(k.key))
+                elif hasattr(k, "idx"):          # SequenceKey
+                    parts.append(str(k.idx))
+                else:                            # GetAttrKey
+                    parts.append(str(getattr(k, "name", k)))
+            p = "/" + key + ("/" + "/".join(parts) if parts else "")
+            shape = tuple(np.shape(leaf))
+            size = int(np.prod(shape)) if shape else 1
+            self.offsets[p] = (off, size, shape)
+            off += size
+        self.p = off
 
 
 # ---------------------------------------------------------------------------
@@ -157,69 +217,274 @@ def make_commit_fn(template: Pytree, mode: str = "constant",
 
 
 # ---------------------------------------------------------------------------
+# streaming aggregation-on-arrival (the ingestion hot path)
+# ---------------------------------------------------------------------------
+
+def make_fold_fn(mode: str = "constant", a: float = 0.5, b: float = 4.0):
+    """Jitted arrival fold — the streaming partial sum's one step:
+
+        fold(acc [P], wsum, row [P], weight, staleness)
+            -> (acc + w̃·row, wsum + w̃),   w̃ = weight·λ(staleness)
+
+    `acc` and `wsum` are donated: the running accumulator updates in
+    place, so an arrival costs one O(P) multiply-add and no buffer-row
+    copy at commit time.  λ is computed IN-program (scalar jnp.power ==
+    the [K]-vector power of the drain twin bitwise on this toolchain —
+    numpy's libm differs, which is why the fold is jitted rather than a
+    host numpy loop)."""
+    if mode not in STALENESS_MODES:
+        raise ValueError(f"unknown staleness mode {mode!r} "
+                         f"(choose one of {STALENESS_MODES})")
+
+    def fold(acc, wsum, row, weight, staleness):
+        lam = staleness_weight(mode, staleness, a, b)
+        wt = jnp.asarray(weight, jnp.float32) * lam
+        return acc + wt * row, wsum + wt
+
+    return jax.jit(fold, donate_argnums=(0, 1))
+
+
+def make_drain_fold_fn(mode: str = "constant", a: float = 0.5,
+                       b: float = 4.0):
+    """ONE compiled drained twin of the arrival fold: lax.scan the same
+    per-lane ops over a [K, P] matrix (zero-weight pad lanes are exact
+    no-ops, so a capacity-padded deadline drain matches a partial
+    streaming fold).  drain(rows, weights, staleness) -> (acc, wsum) —
+    bitwise-equal to folding the lanes one arrival at a time through
+    make_fold_fn (pinned in tests/test_async.py), which is what makes
+    the streaming commit auditable against a drained replay."""
+    if mode not in STALENESS_MODES:
+        raise ValueError(f"unknown staleness mode {mode!r} "
+                         f"(choose one of {STALENESS_MODES})")
+
+    def drain(rows, weights, staleness):
+        def body(carry, xs):
+            acc, wsum = carry
+            row, w, s = xs
+            lam = staleness_weight(mode, s, a, b)
+            wt = w * lam
+            return (acc + wt * row, wsum + wt), None
+        init = (jnp.zeros((rows.shape[1],), jnp.float32),
+                jnp.zeros((), jnp.float32))
+        (acc, wsum), _ = jax.lax.scan(body, init,
+                                      (rows, weights, staleness))
+        return acc, wsum
+
+    return jax.jit(drain)
+
+
+def make_stream_commit_fn(template: Pytree, donate: bool = True):
+    """Build the O(P) streaming commit:
+
+        commit(variables, acc [P], wsum, alpha) -> (new_variables, stats)
+
+    The K-wide reduction already happened at arrival time (make_fold_fn),
+    so the commit is one divide + the mixing update — no [K, P] matrix
+    upload, no O(K·P) reduce.  `variables` AND `wsum` are donated (the
+    update aliases in place; the stats passthrough of the consumed
+    scalar aliases instead of paying XLA's param-to-output copy); `acc`
+    is not (no output shares its [P] shape).  Audited as the
+    `async_stream_commit` hlo_copy_audit family with a 0-copy-op
+    ceiling."""
+
+    def commit(variables, acc, wsum, alpha):
+        avg = unflatten_row(acc / wsum, variables)
+        alpha = jnp.asarray(alpha, jnp.float32)
+        new = jax.tree.map(
+            lambda v, m: ((1.0 - alpha) * v.astype(jnp.float32)
+                          + alpha * m).astype(v.dtype),
+            variables, avg)
+        return new, {"discount_wsum": wsum}
+
+    return jax.jit(commit, donate_argnums=(0, 2) if donate else ())
+
+
+# ---------------------------------------------------------------------------
 # the bounded aggregation buffer
 # ---------------------------------------------------------------------------
 
 class AsyncBuffer:
-    """Bounded host-side aggregation buffer: [capacity, P] f32 rows plus
-    per-row sample weights and staleness.  `drain()` always returns
-    capacity-sized arrays (zero-weight pad lanes beyond `count`) so the
-    commit program compiles once; the real-row count rides alongside.
+    """Bounded aggregation buffer, in one of two modes:
 
-    Host-side by design: results arrive from the comm FSM as numpy
-    payloads (wire codec) or from the in-process scheduler as device
-    rows fetched once per dispatch wave — either way one np.copyto per
-    insert, and the commit uploads the matrix in one device_put."""
+    * drain mode (default, the PR-5 layout): [capacity, P] f32 host
+      rows plus per-row sample weights and staleness.  `drain()` always
+      returns capacity-sized arrays (zero-weight pad lanes beyond
+      `count`) so the commit program compiles once; the real-row count
+      rides alongside.  One np.copyto per insert, one device_put of the
+      matrix at commit.
+    * streaming mode (ISSUE-6 aggregation-on-arrival): no row matrix —
+      `add` folds w̃·row into a running flat f32 accumulator via the
+      jitted donated fold step (make_fold_fn), so `take_stream()` hands
+      the commit one [P] accumulator + Σw̃ and the commit is O(P).
+      Per-lane weights/staleness are still recorded (stats +
+      checkpoint), and a drain-mode checkpoint restores into a
+      streaming buffer by REPLAYING its rows through the same fold —
+      bitwise the accumulator the arrivals would have built.
 
-    def __init__(self, capacity: int, p: int):
+    Internally thread-safe (ISSUE-6 satellite): `add`, `drain`,
+    `take_stream`, `state`, and `load_state` all take the buffer's own
+    lock, so a checkpoint snapshot racing a decode-pool insert can
+    never see a torn (count, accumulator) pair.  Callers that already
+    serialize under a manager lock pay one uncontended acquire."""
+
+    def __init__(self, capacity: int, p: int, *, streaming: bool = False,
+                 staleness_mode: str = "constant", staleness_a: float = 0.5,
+                 staleness_b: float = 4.0):
         if capacity < 1:
             raise ValueError(f"buffer capacity must be >= 1, got {capacity}")
         self.capacity = capacity
-        self.rows = np.zeros((capacity, p), np.float32)
+        self.p = p
+        self.streaming = streaming
+        self._lock = threading.Lock()
         self.weights = np.zeros((capacity,), np.float32)
         self.staleness = np.zeros((capacity,), np.float32)
         self.count = 0
+        if streaming:
+            self.rows = None
+            self._fold = make_fold_fn(staleness_mode, staleness_a,
+                                      staleness_b)
+            self.acc = jnp.zeros((p,), jnp.float32)
+            self.wsum = jnp.zeros((), jnp.float32)
+            self.raw_wsum = 0.0          # un-discounted Σweight (stats)
+        else:
+            self.rows = np.zeros((capacity, p), np.float32)
 
     def add(self, row: np.ndarray, weight: float, staleness: float) -> bool:
         """Insert one result; returns True when the buffer reached
-        capacity (the scheduler's commit trigger)."""
-        if self.count >= self.capacity:
-            raise RuntimeError("async buffer overflow: commit before add")
-        i = self.count
-        np.copyto(self.rows[i], row)
-        self.weights[i] = np.float32(weight)
-        self.staleness[i] = np.float32(staleness)
-        self.count += 1
-        return self.count >= self.capacity
+        capacity (the scheduler's commit trigger).  Streaming mode folds
+        the row into the accumulator instead of storing it."""
+        with self._lock:
+            if self.count >= self.capacity:
+                raise RuntimeError("async buffer overflow: commit before add")
+            i = self.count
+            self.weights[i] = np.float32(weight)
+            self.staleness[i] = np.float32(staleness)
+            if self.streaming:
+                self.acc, self.wsum = self._fold(
+                    self.acc, self.wsum,
+                    np.ascontiguousarray(row, np.float32),
+                    np.float32(weight), np.float32(staleness))
+                # jax on CPU may alias `row`'s host buffer zero-copy and
+                # dispatches asynchronously; block before returning so
+                # callers may recycle/overwrite the row (the decode
+                # pool's scratch free-list does exactly that — an unsynced
+                # fold would read a half-overwritten row)
+                self.wsum.block_until_ready()
+                self.raw_wsum += float(weight)
+            else:
+                np.copyto(self.rows[i], row)
+            self.count += 1
+            return self.count >= self.capacity
 
     def drain(self):
         """(rows [K,P], weights [K], staleness [K], n_real) — padded to
-        capacity with zero-weight lanes; resets the buffer."""
-        n = self.count
-        out = (self.rows.copy(), self.weights.copy(),
-               self.staleness.copy(), n)
-        self.rows[:] = 0.0
-        self.weights[:] = 0.0
-        self.staleness[:] = 0.0
-        self.count = 0
-        return out
+        capacity with zero-weight lanes; resets the buffer.  Drain mode
+        only (a streaming buffer has no rows to hand back)."""
+        with self._lock:
+            if self.streaming:
+                raise RuntimeError(
+                    "drain() on a streaming AsyncBuffer — use take_stream()")
+            n = self.count
+            out = (self.rows.copy(), self.weights.copy(),
+                   self.staleness.copy(), n)
+            self.rows[:] = 0.0
+            self.weights[:] = 0.0
+            self.staleness[:] = 0.0
+            self.count = 0
+            return out
+
+    def take_stream(self):
+        """(acc [P], wsum, weights [K], staleness [K], n_real, raw_wsum)
+        — the streaming commit's inputs; resets the buffer.  Streaming
+        mode only."""
+        with self._lock:
+            if not self.streaming:
+                raise RuntimeError(
+                    "take_stream() on a drain-mode AsyncBuffer — use drain()")
+            out = (self.acc, self.wsum, self.weights.copy(),
+                   self.staleness.copy(), self.count, self.raw_wsum)
+            self.acc = jnp.zeros((self.p,), jnp.float32)
+            self.wsum = jnp.zeros((), jnp.float32)
+            self.raw_wsum = 0.0
+            self.weights[:] = 0.0
+            self.staleness[:] = 0.0
+            self.count = 0
+            return out
 
     def state(self) -> dict:
         """Checkpointable snapshot (fedml_tpu/utils/checkpoint.py
-        extra_state) — plain arrays, restored by load_state."""
-        return {"rows": self.rows.copy(), "weights": self.weights.copy(),
-                "staleness": self.staleness.copy(),
-                # 0-d ndarray, not a numpy scalar: orbax StandardSave
-                # rejects np.int64(x) leaves
-                "count": np.asarray(self.count, np.int64)}
+        extra_state) — plain arrays, restored by load_state.  Streaming
+        mode carries the accumulator fields instead of the row matrix."""
+        with self._lock:
+            common = {"weights": self.weights.copy(),
+                      "staleness": self.staleness.copy(),
+                      # 0-d ndarray, not a numpy scalar: orbax
+                      # StandardSave rejects np.int64(x) leaves
+                      "count": np.asarray(self.count, np.int64)}
+            if self.streaming:
+                common.update(
+                    acc=np.asarray(self.acc, np.float32).copy(),
+                    wsum=np.asarray(self.wsum, np.float32).copy(),
+                    raw_wsum=np.asarray(self.raw_wsum, np.float64))
+            else:
+                common["rows"] = self.rows.copy()
+            return common
 
     def load_state(self, state: dict) -> None:
-        rows = np.asarray(state["rows"], np.float32)
-        if rows.shape != self.rows.shape:
-            raise ValueError(
-                f"async buffer shape mismatch: checkpoint {rows.shape} vs "
-                f"configured {self.rows.shape} (buffer_k or model changed)")
-        np.copyto(self.rows, rows)
-        np.copyto(self.weights, np.asarray(state["weights"], np.float32))
-        np.copyto(self.staleness, np.asarray(state["staleness"], np.float32))
-        self.count = int(state["count"])
+        with self._lock:
+            w = np.asarray(state["weights"], np.float32)
+            if w.shape != self.weights.shape:
+                raise ValueError(
+                    f"async buffer shape mismatch: checkpoint weights "
+                    f"{w.shape} vs configured {self.weights.shape} "
+                    f"(buffer_k changed)")
+            np.copyto(self.weights, w)
+            np.copyto(self.staleness,
+                      np.asarray(state["staleness"], np.float32))
+            self.count = int(state["count"])
+            if self.streaming:
+                if "acc" in state:
+                    acc = np.asarray(state["acc"], np.float32)
+                    if acc.shape != (self.p,):
+                        raise ValueError(
+                            f"async buffer shape mismatch: checkpoint acc "
+                            f"{acc.shape} vs configured ({self.p},) "
+                            f"(model changed)")
+                    self.acc = jnp.asarray(acc)
+                    self.wsum = jnp.asarray(
+                        np.asarray(state["wsum"], np.float32))
+                    self.raw_wsum = float(state.get(
+                        "raw_wsum", float(np.sum(self.weights))))
+                elif "rows" in state:
+                    # drain-mode checkpoint into a streaming buffer:
+                    # replay the saved rows through the fold — bitwise
+                    # the accumulator those arrivals would have built
+                    rows = np.asarray(state["rows"], np.float32)
+                    if rows.shape[1] != self.p:
+                        raise ValueError(
+                            f"async buffer shape mismatch: checkpoint rows "
+                            f"{rows.shape} vs row width {self.p}")
+                    self.acc = jnp.zeros((self.p,), jnp.float32)
+                    self.wsum = jnp.zeros((), jnp.float32)
+                    for i in range(self.count):
+                        self.acc, self.wsum = self._fold(
+                            self.acc, self.wsum, rows[i],
+                            self.weights[i], self.staleness[i])
+                    self.raw_wsum = float(np.sum(self.weights[:self.count]))
+                else:
+                    raise ValueError(
+                        "async buffer checkpoint carries neither 'acc' nor "
+                        "'rows'")
+            else:
+                if "rows" not in state:
+                    raise ValueError(
+                        "streaming-buffer checkpoint cannot restore into a "
+                        "drain-mode AsyncBuffer: the row matrix is not "
+                        "reconstructible from the accumulator")
+                rows = np.asarray(state["rows"], np.float32)
+                if rows.shape != self.rows.shape:
+                    raise ValueError(
+                        f"async buffer shape mismatch: checkpoint "
+                        f"{rows.shape} vs configured {self.rows.shape} "
+                        f"(buffer_k or model changed)")
+                np.copyto(self.rows, rows)

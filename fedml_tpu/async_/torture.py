@@ -1,0 +1,311 @@
+"""Concurrent-uplink ingestion torture bench (ISSUE 6).
+
+The Smart-NIC FL study (arXiv:2307.06561) shows the server's
+deserialize+aggregate path becomes the bottleneck under concurrent
+uplinks — exactly where the PR-5 async server sat: recv threads decoding
+wire frames into intermediate pytrees, one manager lock serializing
+buffer inserts, and an O(K·P) drained reduction at every commit.  This
+harness prices that path: N in-process simulated clients saturate a real
+backend (TCP sockets / gRPC channels / the inproc router) with
+pre-encoded result frames — no training, no downlinks — while the
+server ingests and commits, reporting
+
+    committed-updates/sec    Σ n_real over timed commits / wall
+    decode p50/p95           from the comm_decode_seconds histogram
+    lock wait                async_lock_wait_seconds growth (contention)
+
+Clients send PRE-ENCODED frames (encode cost would otherwise compete
+with the server for cores on small boxes), so the wall measures the
+server's ingestion pipeline alone.  `bench.py --mode ingest` wraps this
+in the A/B the acceptance gate reads: legacy (inline decode + drain
+commit, the PR-5 path) vs decode-into + streaming at pool 1/4/8;
+tools/profile_bench.py exp_INGEST queues the same sweep for chip
+windows.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu import obs
+from fedml_tpu.async_.lifecycle import AsyncMessage, AsyncServerManager
+from fedml_tpu.comm.message import Message, MessageCodec
+
+log = logging.getLogger(__name__)
+
+DEFAULT_P = 262_144          # 1 MiB f32 rows — a small-CNN-sized uplink
+
+
+def make_template(p: int) -> dict:
+    """Synthetic variables pytree of exactly `p` f32 elements, shaped
+    like a small model (one matrix + two vectors) so the RowLayout has
+    several leaves to tile and the wire frame several buffers."""
+    if p < 4:
+        return {"params": {"w": np.zeros((p,), np.float32)}}
+    cols = 64 if p >= 8192 else 4
+    rows = max(1, (p // 2) // cols)
+    rest = p - rows * cols
+    bias = rest // 2
+    return {"params": {
+        "dense": {"kernel": np.zeros((rows, cols), np.float32),
+                  "bias": np.zeros((bias,), np.float32)},
+        "head": np.zeros((rest - bias,), np.float32),
+    }}
+
+
+def _result_frame(template, rank: int, p_seed: int) -> bytes:
+    """One pre-encoded C2S result frame from `rank` (version 0 — the
+    torture server runs constant staleness weights, so the growing
+    staleness is weight-neutral)."""
+    import jax
+    rs = np.random.RandomState(p_seed)
+    vals = jax.tree.map(
+        lambda a: rs.randn(*a.shape).astype(np.float32), template)
+    msg = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, rank, 0)
+    msg.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, vals)
+    msg.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, 32.0)
+    msg.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, 0)
+    return MessageCodec.encode(msg)
+
+
+# ---------------------------------------------------------------------------
+# client drivers — raw-transport uplink spammers
+# ---------------------------------------------------------------------------
+
+def _tcp_client(host: str, port: int, frame: bytes, stop: threading.Event):
+    prefix = struct.pack("<Q", len(frame))
+    wire = prefix + frame                  # one buffer, one sendall
+    s = socket.create_connection((host, port), timeout=30)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    try:
+        while not stop.is_set():
+            s.sendall(wire)                # kernel bufs = backpressure
+    except OSError:
+        pass                               # server closed mid-send
+    finally:
+        s.close()
+
+
+def _grpc_client(host: str, port: int, frame: bytes, stop: threading.Event):
+    import grpc
+    from fedml_tpu.comm.grpc_backend import _METHOD, _OPTS
+    ch = grpc.insecure_channel(f"{host}:{port}", options=_OPTS)
+    stub = ch.unary_unary(_METHOD)
+    try:
+        while not stop.is_set():
+            stub(frame, timeout=60, wait_for_ready=True)
+    except grpc.RpcError:
+        pass                               # server stopped
+    finally:
+        ch.close()
+
+
+def _inproc_client(backend, frame: bytes, stop: threading.Event):
+    try:
+        while not stop.is_set():
+            backend._obs_received(len(frame))
+            backend._deliver_frame(frame)
+    except Exception:
+        pass                               # manager finished mid-frame
+
+
+# ---------------------------------------------------------------------------
+# histogram percentiles (cumulative-bucket interpolation)
+# ---------------------------------------------------------------------------
+
+def _quantile_from_cumulative(before: list, after: list, q: float) -> float:
+    """Approximate quantile of the observations BETWEEN two cumulative
+    snapshots of one histogram (linear interpolation inside the bucket,
+    lower edge 0 for the first)."""
+    deltas = [(le, a - b) for (le, a), (_, b) in zip(after, before)]
+    total = deltas[-1][1]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_le, prev_c = 0.0, 0
+    for le, c in deltas:
+        if c >= target:
+            if le == float("inf"):
+                return prev_le
+            span = c - prev_c
+            frac = (target - prev_c) / span if span > 0 else 1.0
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_c = (0.0 if le == float("inf") else le), c
+    return prev_le
+
+
+# ---------------------------------------------------------------------------
+# the torture run
+# ---------------------------------------------------------------------------
+
+def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
+                       p: int = DEFAULT_P, buffer_k: int = 8,
+                       commits: int = 40, warmup_commits: int = 5,
+                       ingest_pool: int = 8, decode_into: bool = True,
+                       streaming: bool = True, base_port: int = 53200,
+                       timeout_s: float = 300.0,
+                       inbox_bound: Optional[int] = None,
+                       template: Optional[dict] = None) -> dict:
+    """Saturate one server with `n_clients` concurrent uplinks until
+    `warmup_commits + commits` commits land; returns the ingestion
+    report.  `streaming=False, ingest_pool=0, decode_into=False` is the
+    PR-5 legacy arm (inline decode on recv threads + drained O(K·P)
+    commit) — FAITHFULLY, including its unbounded manager inbox: under
+    saturation the recv threads decode into the heap faster than the
+    one dispatch thread drains, so that arm measures the queue
+    pathology too (and its memory grows for the run's duration — keep
+    `commits` moderate).  `inbox_bound` bounds the inbox for sink-less
+    (pool 0) configurations, blocking the recv threads when full so
+    transport flow control backpressures the senders — the A/B's
+    queue-discipline isolation arm."""
+    import jax
+    import jax.numpy as jnp
+
+    if warmup_commits < 1:
+        raise ValueError(
+            f"warmup_commits must be >= 1 (the rate window opens at the "
+            f"last warmup commit's wall time), got {warmup_commits}")
+    backend = backend.upper()
+    template = template if template is not None else make_template(p)
+    total = warmup_commits + commits
+    kw: dict = {}
+    if backend == "INPROC":
+        from fedml_tpu.comm.inproc import InProcRouter
+        kw["router"] = InProcRouter()
+    elif backend in ("TCP", "GRPC"):
+        kw["ip_config"] = {0: "127.0.0.1"}
+        kw["base_port"] = base_port
+        if backend == "TCP":
+            # the pure-Python transport is the A/B's named spec; the
+            # native .so would move decode threading off-harness
+            kw["force_python_tcp"] = True
+
+    hist = obs.histogram("comm_decode_seconds",
+                         buckets=obs.metrics.DECODE_SECONDS_BUCKETS,
+                         backend=backend.lower())
+    lock_wait = obs.counter("async_lock_wait_seconds")
+    recv = obs.counter("comm_received_bytes_total",
+                       backend=backend.lower())
+
+    server = AsyncServerManager(
+        template, total, buffer_k, 0, n_clients + 1, backend,
+        staleness_mode="constant", mix=1.0, streaming=streaming,
+        ingest_pool=ingest_pool, decode_into=decode_into,
+        redispatch=False, **kw)
+    if inbox_bound is not None and ingest_pool == 0:
+        server.com_manager.bound_inbox(inbox_bound)
+    server.run_async()
+
+    stop = threading.Event()
+    frames = [_result_frame(template, r, r) for r in
+              range(1, n_clients + 1)]
+    threads = []
+    # full-run metric baselines — the fallback window for runs so fast
+    # every commit lands before the post-warmup snapshot below is taken
+    hist_start, lock_start, recv_start = (hist.cumulative(),
+                                          lock_wait.value, recv.value)
+    with obs.span("ingest.torture", backend=backend, clients=n_clients,
+                  pool=ingest_pool, decode_into=decode_into,
+                  streaming=streaming):
+        for r, frame in enumerate(frames, start=1):
+            if backend == "TCP":
+                t = threading.Thread(target=_tcp_client,
+                                     args=("127.0.0.1", base_port, frame,
+                                           stop), daemon=True)
+            elif backend == "GRPC":
+                t = threading.Thread(target=_grpc_client,
+                                     args=("127.0.0.1", base_port, frame,
+                                           stop), daemon=True)
+            else:
+                t = threading.Thread(target=_inproc_client,
+                                     args=(server.com_manager, frame,
+                                           stop), daemon=True)
+            t.start()
+            threads.append(t)
+        # metric baselines at the LAST WARMUP commit, so the decode
+        # percentiles / lock wait / ingested bytes measure the same
+        # post-warmup regime as the headline rate (jit+codec cold-start
+        # and page-cold memcpys land in the excluded warmup window)
+        deadline = time.perf_counter() + timeout_s
+        while (len(server.commit_walls) < warmup_commits
+               and not server.done.is_set()
+               and time.perf_counter() < deadline):
+            time.sleep(0.005)
+        hist0, lock0, recv0 = (hist.cumulative(), lock_wait.value,
+                               recv.value)
+        finished = server.done.wait(
+            timeout=max(0.0, deadline - time.perf_counter()))
+        # a client whose transport errored out mid-run died silently
+        # (its spam loop just ends) — count survivors BEFORE stop.set()
+        # so a rate measured under reduced load is flagged, not silently
+        # reported as n_clients' worth of pressure
+        clients_alive = sum(1 for t in threads if t.is_alive())
+        stop.set()
+    if not finished:
+        obs.dump_flight("ingest_torture_stall")
+        server.finish()
+        raise TimeoutError(
+            f"ingest torture stalled: {server.version}/{total} commits in "
+            f"{timeout_s}s (backend {backend}, {n_clients} clients, "
+            f"pool {ingest_pool})")
+    server.finish()                 # waits out in-flight decode tasks
+    for t in threads:
+        t.join(timeout=10)
+    # one quiesced snapshot (post pool drain) feeds both percentiles
+    # and the lock-wait delta — no straggler can split the windows
+    hist1, lock1, recv1 = hist.cumulative(), lock_wait.value, recv.value
+    if clients_alive < n_clients:
+        log.warning(
+            "%d/%d torture clients died before the run ended (transport "
+            "timeout/error) — the reported rate was measured under "
+            "reduced uplink pressure", n_clients - clients_alive,
+            n_clients)
+    metric_window = "post_warmup"
+    if hist1[-1][1] - hist0[-1][1] <= 0:
+        # the whole run landed inside one poll interval of the warmup
+        # boundary: fall back to the full-run window rather than report
+        # plausible-looking zeros for the percentiles
+        metric_window = "full_run"
+        hist0, lock0, recv0 = hist_start, lock_start, recv_start
+
+    walls, sizes = server.commit_walls, server.commit_sizes
+    dt = walls[-1] - walls[warmup_commits - 1]
+    updates = int(sum(sizes[warmup_commits:]))
+    frame_bytes = len(frames[0])
+    report = {
+        "backend": backend,
+        "n_clients": n_clients,
+        "p": int(sum(int(np.prod(np.shape(l)))
+                     for l in jax.tree.leaves(template))),
+        "frame_bytes": frame_bytes,
+        "buffer_k": buffer_k,
+        "ingest_pool": ingest_pool,
+        "decode_into": bool(decode_into),
+        "streaming": bool(streaming),
+        "inbox_bound": inbox_bound,
+        "commits": commits,
+        "updates_committed": updates,
+        "committed_updates_per_sec": updates / dt if dt > 0 else 0.0,
+        "commits_per_sec": commits / dt if dt > 0 else 0.0,
+        "decode_p50_s": _quantile_from_cumulative(hist0, hist1, 0.50),
+        "decode_p95_s": _quantile_from_cumulative(hist0, hist1, 0.95),
+        "decode_samples": int(hist1[-1][1] - hist0[-1][1]),
+        "metric_window": metric_window,
+        "lock_wait_seconds": lock1 - lock0,
+        "ingested_bytes": recv1 - recv0,
+        "clients_alive_at_end": clients_alive,
+        "staleness_p95": float(np.percentile(
+            np.asarray(server.staleness_seen or [0.0]), 95)),
+    }
+    # the torture server's final variables must be finite — a NaN here
+    # means the fold/commit math broke under concurrency
+    report["finite"] = bool(all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree.leaves(server.variables)))
+    return report

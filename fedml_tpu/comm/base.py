@@ -10,9 +10,10 @@ from __future__ import annotations
 
 import abc
 import queue
+import time
 
 from fedml_tpu import obs
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import Message, MessageCodec
 
 
 class Observer(abc.ABC):
@@ -33,11 +34,19 @@ class BaseCommManager(abc.ABC):
     one Prometheus snapshot (fedml_tpu/obs)."""
 
     backend_name = "base"
+    # True when inbound traffic reaches the _deliver_frame chokepoint as
+    # raw wire frames, so an installed frame sink actually sees it; a
+    # backend whose receive path hands over already-decoded Messages
+    # (broker JSON, no-encode inproc) must override with False so ingest
+    # pools fall back to inline decode instead of idling silently
+    supports_frame_sink = True
 
     def __init__(self):
         self._observers: list[Observer] = []
         self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
         self._running = False
+        self._draining = False
+        self._frame_sink = None
         b = self.backend_name
         self._m_sent_msgs = obs.counter("comm_sent_messages_total",
                                         backend=b)
@@ -47,6 +56,9 @@ class BaseCommManager(abc.ABC):
         self._m_recv_bytes = obs.counter("comm_received_bytes_total",
                                          backend=b)
         self._m_retries = obs.counter("comm_retries_total", backend=b)
+        self._m_decode_seconds = obs.histogram(
+            "comm_decode_seconds",
+            buckets=obs.metrics.DECODE_SECONDS_BUCKETS, backend=b)
 
     # -- observability hooks -------------------------------------------------
     def _obs_sent(self, nbytes: int) -> None:
@@ -81,10 +93,62 @@ class BaseCommManager(abc.ABC):
 
     def stop_receive_message(self) -> None:
         self._running = False
-        self._inbox.put(None)
+        self._draining = True   # release recv threads blocked in put()
+        try:
+            self._inbox.put_nowait(None)   # wake a get() blocked on empty
+        except queue.Full:
+            pass   # bounded + full: get() returns an item, sees _running
+
+    def bound_inbox(self, maxsize: int) -> None:
+        """Swap the unbounded inbox for a bounded one BEFORE traffic
+        starts (ingestion-style consumers): a full inbox blocks
+        `_on_message`, stalling the recv thread so transport flow
+        control reaches the sender instead of decoded frames piling up
+        on the heap — the legacy (sink-less) torture arm's memory
+        bound."""
+        self._inbox = queue.Queue(maxsize=maxsize)
 
     # -- backend-side delivery ----------------------------------------------
+    def set_frame_sink(self, sink) -> None:
+        """Install a raw-frame interceptor (the async ingest path,
+        fedml_tpu/async_/lifecycle.py): inbound wire frames reach
+        `sink(payload)` BEFORE decode, so an ingest pool can
+        decode-into preallocated buffer rows off the recv thread.  The
+        sink returns None when it consumed the frame, or a decoded
+        Message to dispatch through the normal observer path.  A
+        blocking sink is the backpressure mechanism: the transport's
+        recv loop stalls, and flow control propagates to the sender."""
+        self._frame_sink = sink
+
+    def _deliver_frame(self, payload) -> None:
+        """Inbound raw-frame chokepoint shared by every codec-framed
+        backend: route to the frame sink when one is installed,
+        otherwise decode inline (timed into comm_decode_seconds) and
+        enqueue for the dispatch loop."""
+        sink = self._frame_sink
+        if sink is not None:
+            msg = sink(payload)
+            if msg is None:
+                return
+        else:
+            t0 = time.perf_counter()
+            msg = MessageCodec.decode(payload)
+            self._m_decode_seconds.observe(time.perf_counter() - t0)
+        self._on_message(msg)
+
     def _on_message(self, msg: Message) -> None:
+        if self._inbox.maxsize > 0:
+            # bounded inbox: block (= recv-thread backpressure) but wake
+            # periodically so shutdown can release us — a put() stuck
+            # forever on a full queue after the dispatch loop exited
+            # would leak every recv thread and its decoded payload
+            while not self._draining:
+                try:
+                    self._inbox.put(msg, timeout=0.2)
+                    return
+                except queue.Full:
+                    continue
+            return                          # shutting down: drop the frame
         self._inbox.put(msg)
 
     def _notify(self, msg: Message) -> None:

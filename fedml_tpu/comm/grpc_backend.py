@@ -64,7 +64,10 @@ class GrpcBackend(BaseCommManager):
 
         def handle(request: bytes, context) -> bytes:
             self._obs_received(len(request))
-            self._on_message(MessageCodec.decode(request))
+            # _deliver_frame: inline decode or the async ingest sink;
+            # a blocked sink holds this servicer thread, so gRPC's
+            # bounded executor is the backpressure
+            self._deliver_frame(request)
             return b"ok"
 
         handler = grpc.method_handlers_generic_handler(_SERVICE, {

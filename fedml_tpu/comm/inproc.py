@@ -30,19 +30,23 @@ class InProcRouter:
     def route(self, msg: Message) -> int:
         """Deliver; returns the encoded frame size (0 when encode=False
         skips the codec) so both endpoints' byte counters agree."""
-        nbytes = 0
-        if self.encode:   # exercise the wire codec even in-memory —
-            # including the v2 transport/compression features a sender
-            # opted into, so the simulation sees the same lossy values
-            # a socket deployment would
-            payload = MessageCodec.encode(msg)
-            nbytes = len(payload)
-            msg = MessageCodec.decode(payload)
         rank = msg.get_receiver_id()
         with self._lock:
             dst = self._backends.get(rank)
         if dst is None:
             raise KeyError(f"no backend registered for rank {rank}")
+        nbytes = 0
+        if self.encode:   # exercise the wire codec even in-memory —
+            # including the v2 transport/compression features a sender
+            # opted into, so the simulation sees the same lossy values
+            # a socket deployment would.  The raw frame goes through
+            # the receiver's _deliver_frame chokepoint, so an installed
+            # ingest sink (async decode pool) sees inproc traffic too.
+            payload = MessageCodec.encode(msg)
+            nbytes = len(payload)
+            dst._obs_received(nbytes)
+            dst._deliver_frame(payload)
+            return nbytes
         dst._obs_received(nbytes)
         dst._on_message(msg)
         return nbytes
@@ -56,6 +60,12 @@ class InProcBackend(BaseCommManager):
         self.rank = rank
         self.router = router
         router.register(rank, self)
+
+    @property
+    def supports_frame_sink(self) -> bool:
+        # a no-encode router hands Message objects across directly —
+        # frames never exist, so a sink would never fire
+        return bool(self.router.encode)
 
     def send_message(self, msg: Message) -> None:
         self._obs_sent(self.router.route(msg))

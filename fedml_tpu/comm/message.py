@@ -367,41 +367,17 @@ class MessageCodec:
         return b"".join(cls.encode_parts(msg)[1])
 
     # -- decode --------------------------------------------------------------
-    @staticmethod
-    def _read_buffers(payload, metas, off: int, writable: bool,
-                      out: list) -> int:
-        for m, a_out in metas:
-            dt = _np_dtype(m["dtype"])
-            count = (int(np.prod(m["shape"], dtype=np.int64))
-                     if m["shape"] else 1)
-            nbytes = count * dt.itemsize
-            if off + nbytes > len(payload):
-                raise ValueError(
-                    f"truncated frame: array needs {nbytes} bytes at "
-                    f"offset {off}, payload has {len(payload)}")
-            a = np.frombuffer(payload, dtype=dt, count=count,
-                              offset=off).reshape(m["shape"])
-            if writable and not m.get("enc"):
-                # np.frombuffer views are read-only; decoded pytree
-                # leaves must survive in-place mutation downstream.
-                # (transport-decoded arrays below are fresh already)
-                a = a.copy()
-            out[a_out] = MessageCodec._decode_transport(a, m.get("enc"))
-            off += nbytes
-        return off
-
     @classmethod
-    def decode(cls, payload: bytes, writable: bool = True) -> Message:
-        """Decode a v1 or v2 frame.  `writable=True` (default) copies
-        each array out of the frame so leaves are mutable; False keeps
-        the v1/big-buffer arrays as read-only zero-copy views into
-        `payload` (cheapest, but in-place mutation raises).  The copy
-        is a deliberate correctness default — np.frombuffer views blew
-        up downstream mutators — at the cost of one transient extra
-        copy per leaf while `payload` is still referenced; receivers of
-        very large frames that only READ the tree (or immediately
-        jnp.asarray it) can pass writable=False to keep the zero-copy
-        profile."""
+    def _frame_header(cls, payload):
+        """Shared v1/v2 frame parse: validates magic + lengths,
+        decompresses the v2 head, and returns
+
+            (header, small_src, small_off, big_off)
+
+        where `header` is the JSON header dict, `small_src`/`small_off`
+        locate the v2 head's small-array section (None/0 for v1), and
+        `big_off` is the big-buffer section's offset into `payload`.
+        Arrays then lie consecutively per section in meta order."""
         magic = bytes(payload[:4])
         if magic == cls.MAGIC:
             hoff, flags = 4, 0
@@ -420,33 +396,182 @@ class MessageCodec:
                 f"has {len(payload) - off} after the length field")
         if magic == cls.MAGIC:
             header = json.loads(payload[off:off + hlen].decode())
-            buffers: list = [None] * len(header["arrays"])
-            cls._read_buffers(payload, [(m, i) for i, m in
-                                        enumerate(header["arrays"])],
-                              off + hlen, writable, buffers)
-        else:
-            head = payload[off:off + hlen]
-            if flags & cls.FLAG_ZLIB:
-                try:
-                    head = zlib.decompress(head)
-                except zlib.error as e:
-                    raise ValueError(f"corrupt compressed head: {e}") \
-                        from None
-            if len(head) < 8:
-                raise ValueError("truncated frame: head too short")
-            jlen = int.from_bytes(head[:8], "little")
-            if 8 + jlen > len(head):
-                raise ValueError("truncated frame: head JSON overruns")
-            header = json.loads(head[8:8 + jlen].decode())
-            metas = header["arrays"]
-            buffers = [None] * len(metas)
-            # small arrays live in the head; big ones follow it
-            cls._read_buffers(head,
-                              [(m, i) for i, m in enumerate(metas)
-                               if m.get("small")], 8 + jlen, True, buffers)
-            cls._read_buffers(payload,
-                              [(m, i) for i, m in enumerate(metas)
-                               if not m.get("small")], off + hlen,
-                              writable, buffers)
+            return header, None, 0, off + hlen
+        head = payload[off:off + hlen]
+        if flags & cls.FLAG_ZLIB:
+            try:
+                head = zlib.decompress(head)
+            except zlib.error as e:
+                raise ValueError(f"corrupt compressed head: {e}") from None
+        if len(head) < 8:
+            raise ValueError("truncated frame: head too short")
+        jlen = int.from_bytes(head[:8], "little")
+        if 8 + jlen > len(head):
+            raise ValueError("truncated frame: head JSON overruns")
+        header = json.loads(head[8:8 + jlen].decode())
+        return header, head, 8 + jlen, off + hlen
+
+    @classmethod
+    def _each_array(cls, header, payload, small_src, small_off, big_off):
+        """Yield (index, meta, src, offset, dtype, count) for every
+        array in the frame, walking the small (head) and big (payload)
+        sections in meta order with bounds checks."""
+        for i, m in enumerate(header["arrays"]):
+            dt = _np_dtype(m["dtype"])
+            count = (int(np.prod(m["shape"], dtype=np.int64))
+                     if m["shape"] else 1)
+            nbytes = count * dt.itemsize
+            if m.get("small"):
+                if small_src is None:
+                    raise ValueError(
+                        "corrupt frame: v1 frames have no small-array "
+                        "head section but the header flags a small array")
+                src, off = small_src, small_off
+                small_off += nbytes
+            else:
+                src, off = payload, big_off
+                big_off += nbytes
+            if off + nbytes > len(src):
+                raise ValueError(
+                    f"truncated frame: array needs {nbytes} bytes at "
+                    f"offset {off}, payload has {len(src)}")
+            yield i, m, src, off, dt, count
+
+    @staticmethod
+    def _array_paths(tree, path="", out=None) -> dict:
+        """Array ref → codec path ("/key/sub/leaf") from the header
+        tree — the inverse of _flatten's path bookkeeping, so
+        decode_into can place each buffer without paths on the wire."""
+        if out is None:
+            out = {}
+        if isinstance(tree, dict):
+            if "__array__" in tree and len(tree) == 1:
+                out[tree["__array__"]] = path
+            elif "__tuple__" in tree and len(tree) == 1:
+                for i, v in enumerate(tree["__tuple__"]):
+                    MessageCodec._array_paths(v, f"{path}/{i}", out)
+            else:
+                for k, v in tree.items():
+                    MessageCodec._array_paths(v, f"{path}/{k}", out)
+        elif isinstance(tree, list):
+            for i, v in enumerate(tree):
+                MessageCodec._array_paths(v, f"{path}/{i}", out)
+        return out
+
+    @classmethod
+    def decode(cls, payload: bytes, writable: bool = True,
+               copy: Optional[str] = None) -> Message:
+        """Decode a v1 or v2 frame.  `writable=True` (default) copies
+        each array out of the frame so leaves are mutable; False keeps
+        the v1/big-buffer arrays as read-only zero-copy views into
+        `payload` (cheapest, but in-place mutation raises).  The copy
+        is a deliberate correctness default — np.frombuffer views blew
+        up downstream mutators — at the cost of one transient extra
+        copy per leaf while `payload` is still referenced.
+
+        `copy` is the documented name for that choice: "never" is the
+        zero-copy fast path (read-only views into `payload` for every
+        uncompressed leaf — the async server's ingest fallback uses it
+        because it re-flattens the tree immediately and never mutates),
+        "always" the mutable default.  v2 small-in-head arrays are
+        always fresh (the head is a transient buffer)."""
+        if copy is not None:
+            if copy not in ("always", "never"):
+                raise ValueError(f"unknown copy mode {copy!r} "
+                                 "(choose always or never)")
+            writable = copy == "always"
+        header, small_src, small_off, big_off = cls._frame_header(payload)
+        buffers: list = [None] * len(header["arrays"])
+        for i, m, src, off, dt, count in cls._each_array(
+                header, payload, small_src, small_off, big_off):
+            a = np.frombuffer(src, dtype=dt, count=count,
+                              offset=off).reshape(m["shape"])
+            if (writable or m.get("small")) and not m.get("enc"):
+                # np.frombuffer views are read-only; decoded pytree
+                # leaves must survive in-place mutation downstream.
+                # (transport-decoded arrays are fresh already)
+                a = a.copy()
+            buffers[i] = cls._decode_transport(a, m.get("enc"))
         params = cls._unflatten(header["tree"], buffers)
+        return Message().init(params)
+
+    @classmethod
+    def decode_into(cls, payload: bytes, out_row: np.ndarray,
+                    layout) -> Message:
+        """Decode-into fast path (ISSUE 6): validate the frame and write
+        the `layout.key` subtree's leaves — dequantized and cast to f32
+        — DIRECTLY into the preallocated flat row `out_row` at the
+        layout's precomputed offsets (fedml_tpu/async_/staleness.py
+        RowLayout: the flatten_vars_row element order), skipping the
+        intermediate pytree and the per-leaf frombuffer copy entirely.
+        One pass per leaf: a same-dtype f32 leaf is a straight memcpy
+        into the row (GIL released), other dtypes cast-into, int8
+        transport dequants through the same f64 affine as
+        _decode_transport so the row is bitwise what
+        flatten_vars_row(decode(payload)) would build.
+
+        Every param OUTSIDE the layout key decodes normally into the
+        returned Message; the layout key itself comes back as None (its
+        values live in `out_row`).  Raises ValueError on malformed
+        frames (decode's hardening) and on template mismatch — a frame
+        whose `layout.key` arrays don't exactly tile the row.  On a
+        raise, `out_row`'s contents are UNDEFINED (leaves validated
+        before the failing one were already written): callers must
+        treat the row as scratch until decode_into returns — which the
+        ingest pool does, fully rewriting its scratch rows on every
+        successful decode."""
+        if (out_row.dtype != np.float32 or out_row.ndim != 1
+                or out_row.shape[0] != layout.p):
+            raise ValueError(
+                f"decode_into row must be a [{layout.p}] f32 vector, got "
+                f"{out_row.dtype}{out_row.shape}")
+        header, small_src, small_off, big_off = cls._frame_header(payload)
+        paths = cls._array_paths(header["tree"])
+        prefix = "/" + layout.key
+        buffers: list = [None] * len(header["arrays"])
+        filled = 0
+        for i, m, src, off, dt, count in cls._each_array(
+                header, payload, small_src, small_off, big_off):
+            path = paths.get(i, "")
+            if path == prefix or path.startswith(prefix + "/"):
+                ent = layout.offsets.get(path)
+                if ent is None:
+                    raise ValueError(
+                        f"decode_into: frame array {path!r} is not in the "
+                        f"row layout (model template mismatch)")
+                dst_off, size, shape = ent
+                if count != size or tuple(m["shape"]) != shape:
+                    raise ValueError(
+                        f"decode_into: frame array {path!r} has shape "
+                        f"{tuple(m['shape'])}, layout expects {shape}")
+                view = np.frombuffer(src, dtype=dt, count=count, offset=off)
+                dst = out_row[dst_off:dst_off + size]
+                enc = m.get("enc")
+                if enc is None or enc["kind"] == "bf16":
+                    # straight memcpy for f32, single-pass cast-into
+                    # for f64/bf16/int leaves
+                    np.copyto(dst, view, casting="unsafe")
+                elif enc["kind"] == "int8":
+                    # the same f64 affine as _decode_transport, so the
+                    # row matches the legacy decode+flatten bitwise
+                    np.copyto(dst,
+                              (view.astype(np.float64) + 128.0)
+                              * enc["scale"] + enc["min"],
+                              casting="unsafe")
+                else:
+                    raise ValueError(f"unknown wire transport encoding "
+                                     f"{enc.get('kind')!r}")
+                filled += size
+            else:
+                a = np.frombuffer(src, dtype=dt, count=count,
+                                  offset=off).reshape(m["shape"])
+                if not m.get("enc"):
+                    a = a.copy()          # metadata arrays stay mutable
+                buffers[i] = cls._decode_transport(a, m.get("enc"))
+        if filled != layout.p:
+            raise ValueError(
+                f"decode_into: frame covered {filled} of {layout.p} row "
+                f"elements under {prefix!r} (model template mismatch)")
+        params = cls._unflatten(header["tree"], buffers)
+        params[layout.key] = None
         return Message().init(params)

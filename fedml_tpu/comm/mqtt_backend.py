@@ -12,6 +12,9 @@ speaks, tested against the in-repo MiniMqttBroker over TCP sockets).
 from __future__ import annotations
 
 import logging
+import os
+import time
+import zlib
 
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message
@@ -24,6 +27,7 @@ _TOPIC_C2S = "fedml_"      # client <id> → server
 
 class MqttBackend(BaseCommManager):
     backend_name = "mqtt"
+    supports_frame_sink = False      # broker path speaks decoded JSON
 
     def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
                  port: int = 1883, keepalive: int = 180,
@@ -72,10 +76,15 @@ class MqttBackend(BaseCommManager):
     def _on_mqtt_message(self, client, userdata, m) -> None:
         self._obs_received(len(m.payload))
         payload = m.payload
+        t0 = time.perf_counter()
         if payload[:4] == self._ZMAGIC:
-            import zlib
             payload = zlib.decompress(payload[4:])
-        self._on_message(Message.from_json(payload.decode()))
+        msg = Message.from_json(payload.decode())
+        # the broker path speaks JSON, not the binary frame, so its
+        # deserialize cost lands in the same comm_decode_seconds
+        # histogram the codec-framed backends feed (comm/base.py)
+        self._m_decode_seconds.observe(time.perf_counter() - t0)
+        self._on_message(msg)
 
     def send_message(self, msg: Message) -> None:
         receiver = msg.get_receiver_id()
@@ -86,8 +95,6 @@ class MqttBackend(BaseCommManager):
             # nested-list JSON weights compress hard (repeated digits);
             # the broker path is the bandwidth-starved edge leg, so the
             # opt-in pays exactly where it matters
-            import os
-            import zlib
             if os.environ.get("FEDML_WIRE_V1", "") in ("", "0"):
                 payload = self._ZMAGIC + zlib.compress(payload)
         self._mqtt.publish(topic, payload)

@@ -68,7 +68,8 @@ class NativeTcpBackend(BaseCommManager):
                 self._lib.fh_buf_free(buf)
             self._obs_received(len(payload))
             try:
-                self._on_message(MessageCodec.decode(payload))
+                # inline decode or the async ingest sink (comm/base.py)
+                self._deliver_frame(payload)
             except Exception:     # malformed frame: drop, keep serving
                 log.exception("undecodable frame (%d bytes)", length.value)
 

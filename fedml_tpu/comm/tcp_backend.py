@@ -70,7 +70,11 @@ class TcpBackend(BaseCommManager):
                 (length,) = struct.unpack("<Q", _read_exact(conn, 8))
                 payload = _read_exact(conn, length)
                 self._obs_received(len(payload))
-                self._on_message(MessageCodec.decode(payload))
+                # _deliver_frame: inline decode, or hand the raw frame
+                # to an installed ingest sink (async decode pool) — a
+                # blocked sink stalls this loop and TCP flow control
+                # backpressures the sender
+                self._deliver_frame(payload)
         except (ConnectionError, OSError):
             conn.close()
 

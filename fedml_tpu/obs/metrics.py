@@ -36,6 +36,19 @@ DEFAULT_SECONDS_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
 )
 
+# Wire-frame decode walls (`comm_decode_seconds`, comm/base.py + the
+# async ingest pool): decodes of small control frames run ~10 µs and
+# model-sized uplinks single-digit ms — the default duration buckets
+# start at 1 ms and would flatten the whole distribution into two
+# buckets, so this ladder extends three decades lower.  Shared here so
+# every backend label and the ingest pool register ONE compatible
+# histogram (the registry rejects same-name/different-bucket
+# registrations).
+DECODE_SECONDS_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
 # Staleness buckets for the async federation's `async_staleness`
 # histogram (fedml_tpu/async_): staleness is COMMIT counts, not seconds
 # — integer-valued, small in healthy runs (FedBuff's useful regime is
@@ -44,6 +57,17 @@ DEFAULT_SECONDS_BUCKETS = (
 # (the registry rejects same-name/different-bucket registrations).
 STALENESS_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
                      24.0, 32.0, 48.0, 64.0)
+
+# Canonical ladders by metric NAME: a bare histogram(name) get (no
+# buckets argument) resolves here before falling back to the default
+# seconds ladder, so get-or-create ORDER cannot decide a named
+# instrument's resolution — without this, whichever caller ran first
+# (a bare get in a test, say) would pin the default ladder and the
+# next explicit registration would raise the bucket-conflict error.
+CANONICAL_BUCKETS = {
+    "comm_decode_seconds": DECODE_SECONDS_BUCKETS,
+    "async_staleness": STALENESS_BUCKETS,
+}
 
 
 def _label_key(labels: dict) -> tuple:
@@ -199,6 +223,8 @@ class MetricsRegistry:
     def histogram(self, name: str,
                   buckets: Optional[Sequence[float]] = None,
                   **labels) -> Histogram:
+        if buckets is None:
+            buckets = CANONICAL_BUCKETS.get(name)
         kw = {} if buckets is None else {"buckets": buckets}
         h = self._get(Histogram, name, labels, **kw)
         if buckets is not None and h.buckets != tuple(sorted(buckets)):

@@ -354,3 +354,180 @@ def test_async_checkpoint_roundtrips_server_state(tmp_path):
     assert fresh.version == step + 3
     assert np.isfinite(fresh.evaluate(out)["test_loss"])
     ck.close()
+
+
+# -- ISSUE 6: streaming aggregation-on-arrival ------------------------------
+
+def _rand_rows(seed, k, p):
+    rs = np.random.RandomState(seed)
+    rows = rs.randn(k, p).astype(np.float32)
+    w = rs.randint(1, 40, k).astype(np.float32)
+    s = rs.randint(0, 6, k).astype(np.float32)
+    return rows, w, s
+
+
+@pytest.mark.parametrize("mode,n_real", [
+    ("constant", 6), ("constant", 3),          # full + partial (deadline)
+    ("polynomial", 6), ("polynomial", 3),
+])
+def test_streaming_commit_matches_drained_commit_bitwise(mode, n_real):
+    """The ISSUE-6 bitwise pin: a streaming AsyncBuffer (per-arrival
+    jitted folds) committed through make_stream_commit_fn equals the
+    drained [K, P] commit — the compiled drain-fold twin over the
+    capacity-padded matrix fed to the SAME commit program — bit for
+    bit, for constant and polynomial staleness weights, full and
+    partial (deadline, zero-weight pad lanes) buffers."""
+    from fedml_tpu.async_.staleness import (AsyncBuffer, make_drain_fold_fn,
+                                            make_fold_fn,
+                                            make_stream_commit_fn)
+    K, P = 6, 37
+    rows, w, s = _rand_rows(11 + n_real, n_real, P)
+    template = {"params": {"a": jnp.zeros((5, 7), jnp.float32),
+                           "b": jnp.zeros((2,), jnp.float32)}}
+    rs = np.random.RandomState(99)
+    variables = jax.tree.map(
+        lambda l: jnp.asarray(rs.randn(*l.shape), jnp.float32), template)
+
+    # arm 1: streaming buffer — per-arrival folds, O(P) commit
+    buf = AsyncBuffer(K, P, streaming=True, staleness_mode=mode,
+                      staleness_a=0.5)
+    for i in range(n_real):
+        buf.add(rows[i], float(w[i]), float(s[i]))
+    acc, wsum, bw, bs, n, raw = buf.take_stream()
+    assert n == n_real and raw == float(np.sum(w))
+    np.testing.assert_array_equal(bw[:n_real], w)
+    commit = make_stream_commit_fn(variables, donate=False)
+    new_stream, st = commit(variables, acc, wsum, jnp.float32(0.7))
+
+    # arm 2: drained replay — one compiled scan over the padded matrix
+    padded = np.zeros((K, P), np.float32)
+    padded[:n_real] = rows
+    pw = np.zeros((K,), np.float32)
+    pw[:n_real] = w
+    ps = np.zeros((K,), np.float32)
+    ps[:n_real] = s
+    drain = make_drain_fold_fn(mode, a=0.5)
+    dacc, dwsum = drain(jnp.asarray(padded), jnp.asarray(pw),
+                        jnp.asarray(ps))
+    np.testing.assert_array_equal(np.asarray(acc), np.asarray(dacc))
+    np.testing.assert_array_equal(np.asarray(wsum), np.asarray(dwsum))
+    new_drain, _ = commit(variables, dacc, dwsum, jnp.float32(0.7))
+    _assert_trees_bitwise(new_stream, new_drain)
+    # the arrival fold alone pins too (the scan body == the fold body)
+    fold = make_fold_fn(mode, a=0.5)
+    facc = jnp.zeros((P,), jnp.float32)
+    fwsum = jnp.zeros((), jnp.float32)
+    for i in range(n_real):
+        facc, fwsum = fold(facc, fwsum, rows[i], jnp.float32(w[i]),
+                           jnp.float32(s[i]))
+    np.testing.assert_array_equal(np.asarray(facc), np.asarray(dacc))
+    np.testing.assert_array_equal(np.asarray(fwsum), np.asarray(dwsum))
+
+
+def test_async_buffer_thread_safe_adds_and_snapshots():
+    """ISSUE-6 satellite: AsyncBuffer is internally thread-safe — 8
+    threads racing adds against state() snapshots never tear a
+    (count, weights, accumulator) triple, in both modes."""
+    import threading
+
+    for streaming in (False, True):
+        K, P = 64, 16
+        buf = AsyncBuffer(K, P, streaming=streaming)
+        rows = np.random.RandomState(3).randn(K, P).astype(np.float32)
+        torn = []
+
+        def snapshotter(stop):
+            while not stop.is_set():
+                st = buf.state()
+                n = int(st["count"])
+                # a torn snapshot would show a filled row/weight beyond
+                # count or a count beyond capacity
+                if n > K or np.count_nonzero(st["weights"]) > n:
+                    torn.append(st)
+
+        stop = threading.Event()
+        snap = threading.Thread(target=snapshotter, args=(stop,))
+        snap.start()
+        threads = [threading.Thread(
+            target=lambda lo: [buf.add(rows[i], 1.0 + i, float(i % 3))
+                               for i in range(lo, lo + 8)],
+            args=(lo,)) for lo in range(0, K, 8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        snap.join()
+        assert not torn
+        assert buf.count == K
+        if streaming:
+            acc, wsum, w, s, n, raw = buf.take_stream()
+            assert n == K
+            # fold order is thread-scheduled, so compare to tolerance
+            # (the bitwise pin lives in the deterministic test above);
+            # row i always folded with weight 1+i regardless of slot
+            expect = (rows * (1.0 + np.arange(K,
+                                              dtype=np.float32))[:, None]
+                      ).sum(0)
+            np.testing.assert_allclose(np.asarray(acc), expect,
+                                       rtol=2e-4, atol=2e-4)
+            assert float(wsum) == pytest.approx(float(np.sum(w)))
+        else:
+            got_rows, w, s, n = buf.drain()
+            assert n == K
+            # every row landed exactly once (weight 1+i names row i)
+            np.testing.assert_array_equal(got_rows[np.argsort(w)], rows)
+
+
+def test_async_buffer_streaming_checkpoint_roundtrip(tmp_path):
+    """ISSUE-6 satellite: the streaming accumulator fields (acc, wsum,
+    raw_wsum) round-trip through FedCheckpointManager extra_state
+    bit-exactly, a drain-mode checkpoint REPLAYS into a streaming
+    buffer bitwise, and a streaming checkpoint refuses to restore into
+    a drain-mode buffer (the rows are gone)."""
+    from fedml_tpu.utils.checkpoint import FedCheckpointManager
+    from fedml_tpu.async_.staleness import make_fold_fn
+
+    K, P = 4, 23
+    rows, w, s = _rand_rows(21, 3, P)
+    buf = AsyncBuffer(K, P, streaming=True, staleness_mode="polynomial",
+                      staleness_a=0.5)
+    for i in range(3):
+        buf.add(rows[i], float(w[i]), float(s[i]))
+    state = buf.state()
+    assert state["acc"].shape == (P,)
+
+    # through orbax (0-d ndarray count/wsum/raw_wsum must survive)
+    ck = FedCheckpointManager(str(tmp_path / "ing"))
+    v = {"params": jnp.zeros((2,), jnp.float32)}
+    ck.save(0, v, (), extra_state={"buffer": state})
+    _, _, _, extra = ck.restore(v, (), extra_template={"buffer": state})
+    ck.close()
+
+    fresh = AsyncBuffer(K, P, streaming=True, staleness_mode="polynomial",
+                        staleness_a=0.5)
+    fresh.load_state(extra["buffer"])
+    a0, w0, bw0, bs0, n0, raw0 = buf.take_stream()
+    a1, w1, bw1, bs1, n1, raw1 = fresh.take_stream()
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+    np.testing.assert_array_equal(np.asarray(w0), np.asarray(w1))
+    np.testing.assert_array_equal(bw0, bw1)
+    np.testing.assert_array_equal(bs0, bs1)
+    assert n0 == n1 == 3 and raw0 == raw1
+
+    # drain-mode checkpoint -> streaming buffer: replay == live folds
+    dbuf = AsyncBuffer(K, P)
+    for i in range(3):
+        dbuf.add(rows[i], float(w[i]), float(s[i]))
+    sbuf = AsyncBuffer(K, P, streaming=True, staleness_mode="polynomial",
+                       staleness_a=0.5)
+    sbuf.load_state(dbuf.state())
+    a2, w2, *_ = sbuf.take_stream()
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(w0), np.asarray(w2))
+
+    # streaming checkpoint -> drain-mode buffer: explicit refusal
+    buf2 = AsyncBuffer(K, P, streaming=True)
+    buf2.add(rows[0], 1.0, 0.0)
+    with pytest.raises(ValueError, match="not reconstructible"):
+        AsyncBuffer(K, P).load_state(buf2.state())

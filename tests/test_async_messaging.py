@@ -180,3 +180,98 @@ def test_manager_finish_from_handler_thread_does_not_self_join():
     assert done.wait(timeout=5.0)
     t.join(timeout=5.0)
     assert not t.is_alive()
+
+
+# -- ISSUE 6: parallel ingest + the torture bench ---------------------------
+
+def test_async_messaging_ingest_pool_commits_over_wire():
+    """The decode-pool path end-to-end over the inproc wire: raw frames
+    reach the sink on the router's delivery path, decode-into fills
+    scratch rows off the FSM thread, streaming folds commit — protocol
+    invariants hold and the pool drains to depth 0 at the end."""
+    cfg, trainer, data = _small_setup()
+    v, server = run_async_messaging(trainer, data, cfg, buffer_k=2,
+                                    total_commits=4, streaming=True,
+                                    ingest_pool=2, decode_into=True,
+                                    timeout_s=120)
+    assert server.version == 4
+    assert server.updates_committed >= 8
+    assert np.isfinite(float(jax.tree.leaves(v)[0].ravel()[0]))
+    assert obs.gauge("async_ingest_pool_depth").value == 0
+    # the ingest path timed its decodes
+    h = obs.histogram("comm_decode_seconds", backend="inproc")
+    assert h.cumulative()[-1][1] > 0
+
+
+def test_async_messaging_streaming_tracks_legacy_drain():
+    """Streaming aggregation-on-arrival and the PR-5 drain path agree
+    on the protocol outcome over the wire (same commit budget reached,
+    finite variables, comparable discount accounting).  The BITWISE
+    streaming-vs-drain pin lives in test_async.py; thread scheduling
+    makes wire-path arrival ORDER nondeterministic, so this asserts
+    invariants, not bits."""
+    cfg, trainer, data = _small_setup()
+    outs = {}
+    for streaming in (False, True):
+        v, server = run_async_messaging(trainer, data, cfg, buffer_k=2,
+                                        total_commits=3,
+                                        streaming=streaming, timeout_s=120)
+        assert server.version == 3
+        outs[streaming] = np.asarray(jax.tree.leaves(v)[0])
+    assert np.isfinite(outs[False]).all() and np.isfinite(outs[True]).all()
+
+
+def _torture_kw(**over):
+    kw = dict(n_clients=3, backend="INPROC", p=512, buffer_k=2, commits=4,
+              warmup_commits=1, ingest_pool=2, decode_into=True,
+              streaming=True, timeout_s=90)
+    kw.update(over)
+    return kw
+
+
+def test_ingest_torture_smoke_streaming():
+    """Fast torture smoke (3 inproc clients, 512-element rows): the
+    harness reaches its commit budget, reports the ISSUE-6 metrics, and
+    the committed variables stay finite under concurrent folds."""
+    from fedml_tpu.async_ import run_ingest_torture
+    r = run_ingest_torture(**_torture_kw())
+    assert r["finite"]
+    assert r["committed_updates_per_sec"] > 0
+    assert r["updates_committed"] >= 4 * 2 - 2   # commits x K, pads allowed
+    assert r["decode_p95_s"] >= r["decode_p50_s"] >= 0.0
+    assert r["lock_wait_seconds"] >= 0.0
+    assert r["p"] == 512 and r["n_clients"] == 3
+
+
+def test_ingest_torture_smoke_legacy_arm():
+    """The A/B's legacy arm (inline decode + drained O(K·P) commit)
+    still runs green — bench.py --mode ingest needs both arms."""
+    from fedml_tpu.async_ import run_ingest_torture
+    r = run_ingest_torture(**_torture_kw(ingest_pool=0, decode_into=False,
+                                         streaming=False))
+    assert r["finite"] and r["committed_updates_per_sec"] > 0
+    assert not r["decode_into"] and not r["streaming"]
+
+
+@pytest.mark.slow
+def test_ingest_torture_32_clients_tcp_speedup():
+    """NIGHTLY: the acceptance-gate shape — 32 concurrent TCP uplinks,
+    decode-into + streaming vs the PR-5 legacy path (faithfully
+    unbounded inbox and all).  The gate demands >=2x sustained
+    committed-updates/sec; on the 2-core CI box the measured gap is
+    >25x in every repeat (PERF.md "Uplink ingestion"), so 2x has huge
+    margin without being timing-flaky."""
+    from fedml_tpu.async_ import run_ingest_torture
+    legacy = run_ingest_torture(n_clients=32, backend="TCP", buffer_k=8,
+                                commits=10, warmup_commits=2,
+                                ingest_pool=0, decode_into=False,
+                                streaming=False, base_port=53270,
+                                timeout_s=300)
+    fast = run_ingest_torture(n_clients=32, backend="TCP", buffer_k=8,
+                              commits=10, warmup_commits=2,
+                              ingest_pool=1, decode_into=True,
+                              streaming=True, base_port=53271,
+                              timeout_s=300)
+    assert legacy["finite"] and fast["finite"]
+    assert (fast["committed_updates_per_sec"]
+            >= 2.0 * legacy["committed_updates_per_sec"]), (legacy, fast)

@@ -143,3 +143,50 @@ def test_chip_queue_carries_async_ab():
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
         "profile_bench.py lost the exp_ASYNC experiment the queue runs")
+
+
+def test_bench_json_schema_v5_carries_ingest_block():
+    """ISSUE 6: schema v5 adds the ingest-mode fields — the "ingest"
+    block from `python bench.py --mode ingest` with the legacy arm, the
+    decode-into+streaming pool arms, decode percentiles, lock-wait and
+    the speedup_vs_legacy headline the >=2x acceptance gate reads.
+    Static source check like the v3/v4 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 5, (
+        "bench schema must stay >= v5 (uplink-ingestion block)")
+    for field in ('"ingest"', '"legacy"', '"legacy_bounded_inbox"',
+                  '"arms"', "speedup_vs_legacy", "decode_p50_s",
+                  "decode_p95_s", "lock_wait_seconds",
+                  "committed_updates_per_sec"):
+        assert field in src, (
+            f"bench.py lost the v5 ingest field {field} "
+            "(see fedml_tpu/async_/torture.py and _bench_ingest)")
+    # the block's numbers come from the torture harness — names must
+    # stay in sync with its report dict
+    tort = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "async_", "torture.py")).read()
+    for field in ("committed_updates_per_sec", "decode_p50_s",
+                  "decode_p95_s", "lock_wait_seconds"):
+        assert field in tort, (
+            f"run_ingest_torture's report lost {field!r} — bench.py's "
+            "v5 ingest block reads it")
+
+
+def test_chip_queue_carries_ingest_ab():
+    """ISSUE 6: the next chip window must price the ingestion A/B —
+    scripts/run_chip_queue.sh carries the INGEST step and
+    profile_bench.py defines the exp_INGEST experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    assert "profile_bench.py INGEST" in open(queue).read(), (
+        "run_chip_queue.sh lost the INGEST uplink-ingestion A/B "
+        "(ISSUE 6 queues it for the next chip window)")
+    assert "exp_INGEST" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_INGEST experiment the queue runs")
+    import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr

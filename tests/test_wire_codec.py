@@ -214,3 +214,113 @@ def test_fedavg_messaging_transport_wiring():
     got = out.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)["params"]["w"]
     assert got.dtype == np.float32
     assert 0 < np.max(np.abs(got - w)) <= 0.01 * np.max(np.abs(w))
+
+
+# -- ISSUE 6: zero-copy fast path + decode-into -----------------------------
+
+def test_codec_decode_copy_never_pins_zero_copy():
+    """The documented `copy="never"` fast path: uncompressed f32 big
+    buffers come back as READ-ONLY views sharing memory with the frame
+    payload — buffer identity, no frombuffer copy (the async server's
+    ingest fallback relies on it, re-flattening immediately).  v2
+    small-in-head arrays are necessarily fresh (the head is transient);
+    transport-decoded arrays are fresh too."""
+    msg = Message(1, 2, 0)
+    big = np.arange(4096, dtype=np.float32).reshape(64, 64)   # > SMALL_LIMIT
+    msg.add_params("model_params", {"w": big})
+    payload = MessageCodec.encode(msg)
+    got = MessageCodec.decode(payload, copy="never").get(
+        "model_params")["w"]
+    np.testing.assert_array_equal(got, big)
+    assert not got.flags.writeable
+    assert got.base is not None
+    assert np.shares_memory(got, np.frombuffer(payload, np.uint8))
+    # copy="always" is the mutable default, spelled out
+    rw = MessageCodec.decode(payload, copy="always").get(
+        "model_params")["w"]
+    assert rw.flags.writeable
+    assert not np.shares_memory(rw, np.frombuffer(payload, np.uint8))
+    with pytest.raises(ValueError, match="copy mode"):
+        MessageCodec.decode(payload, copy="sometimes")
+
+
+def _layout_tree(seed: int):
+    """Multi-leaf f32 params tree shaped like an uplink payload (one
+    kernel big enough to be a big buffer, small bias leaves that ride
+    the v2 head)."""
+    rs = np.random.RandomState(seed)
+    return {"params": {
+        "dense": {"kernel": rs.randn(48, 16).astype(np.float32),
+                  "bias": rs.randn(16).astype(np.float32)},
+        "head": rs.randn(33).astype(np.float32),
+    }}
+
+
+def _result_msg(tree, **wire):
+    msg = Message(12, 3, 0)
+    msg.add_params("model_params", tree)
+    msg.add_params("num_samples", 17.0)
+    msg.add_params("model_version", 5)
+    for k, v in wire.items():
+        setattr(msg, k, v)
+    return msg
+
+
+@pytest.mark.parametrize("wire", [
+    {},                                                      # v1 frame
+    {"wire_compress": True},                                 # v2 zlib
+    {"wire_transport": {"model_params": "bf16"}},            # v2 bf16
+    {"wire_transport": {"model_params": "int8"},
+     "wire_compress": True},                                 # v2 int8+zlib
+])
+def test_codec_decode_into_matches_decode_flatten_bitwise(wire):
+    """decode_into writes the layout key's leaves straight into the
+    flat row at the RowLayout offsets — BITWISE what
+    flatten_vars_row(decode(payload)) builds, for v1 exact frames and
+    every v2 transport/compression combination (int8 dequants through
+    the same f64 affine as _decode_transport).  Params outside the key
+    decode normally; the key itself comes back None."""
+    from fedml_tpu.async_.staleness import RowLayout, flatten_vars_row
+
+    tree = _layout_tree(7)
+    layout = RowLayout(tree, "model_params")
+    payload = MessageCodec.encode(_result_msg(tree, **wire))
+    row = np.full((layout.p,), np.nan, np.float32)
+    out = MessageCodec.decode_into(payload, row, layout)
+    ref = flatten_vars_row(
+        MessageCodec.decode(payload).get("model_params"))
+    np.testing.assert_array_equal(row, ref)
+    assert out.get("model_params") is None
+    assert out.get("num_samples") == 17.0
+    assert out.get("model_version") == 5
+    assert out.get_sender_id() == 3
+
+
+def test_codec_decode_into_hardening():
+    """Malformed rows and template-mismatched frames raise ValueError.
+    On a raise the row's contents are documented UNDEFINED (a caller
+    reusing scratch rows must fully rewrite before trusting them —
+    the ingest pool does)."""
+    from fedml_tpu.async_.staleness import RowLayout
+
+    tree = _layout_tree(8)
+    layout = RowLayout(tree, "model_params")
+    payload = MessageCodec.encode(_result_msg(tree))
+    with pytest.raises(ValueError, match="f32 vector"):
+        MessageCodec.decode_into(payload, np.zeros((layout.p,), np.float64),
+                                 layout)
+    with pytest.raises(ValueError, match="f32 vector"):
+        MessageCodec.decode_into(payload, np.zeros((layout.p + 1,),
+                                                   np.float32), layout)
+    # a frame whose arrays don't tile the layout: template mismatch
+    other = {"params": {"dense": {"kernel": np.zeros((48, 17), np.float32),
+                                  "bias": np.zeros((16,), np.float32)},
+                        "head": np.zeros((33,), np.float32)}}
+    bad = MessageCodec.encode(_result_msg(other))
+    with pytest.raises(ValueError, match="shape|layout"):
+        MessageCodec.decode_into(bad, np.zeros((layout.p,), np.float32),
+                                 layout)
+    # decode's frame hardening carries over
+    with pytest.raises(ValueError, match="magic"):
+        MessageCodec.decode_into(b"NOPE" + payload[4:],
+                                 np.zeros((layout.p,), np.float32), layout)

@@ -298,12 +298,30 @@ def build_family_programs(donate: bool = True,
         out["async_commit"] = [
             ("commit", commit, (v, rows, w, s, jnp.float32(1.0)))]
 
+    if want("async_stream_commit"):
+        # the streaming aggregation-on-arrival commit (ISSUE 6): the
+        # [K, P] reduction already happened at arrival time (the jitted
+        # fold), so the commit is an O(P) mix of donated variables with
+        # ONE flat accumulator row — pinned at 0 copy ops: any relayout
+        # or lost alias in the hot ingestion path shows up here
+        import jax.numpy as jnp
+        from fedml_tpu.async_.staleness import (flat_dim,
+                                                make_stream_commit_fn)
+        v = trainer.init(rng, jax.numpy.asarray(
+            data.client_shards["x"][0, 0]))
+        commit = make_stream_commit_fn(v, donate=donate)
+        acc = jnp.zeros((flat_dim(v),), jnp.float32)
+        out["async_stream_commit"] = [
+            ("stream_commit", commit,
+             (v, acc, jnp.float32(8.0), jnp.float32(1.0)))]
+
     return out
 
 
 ALL_FAMILIES = ("fedavg_resident", "fedavg_streaming", "fedavg_blockstream",
                 "fednova_resident", "robust_orderstat", "robust_blockstream",
-                "hierarchical", "gossip", "async_commit")
+                "hierarchical", "gossip", "async_commit",
+                "async_stream_commit")
 
 
 def audit_families(families: list[str] | None = None,

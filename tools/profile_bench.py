@@ -947,6 +947,42 @@ def exp_ASYNC():
               flush=True)
 
 
+def exp_INGEST():
+    """Concurrent-uplink ingestion A/B (ISSUE 6): sustained
+    committed-updates/sec of the async server's decode+aggregate path
+    under 32 saturating TCP clients (fedml_tpu/async_/torture.py — no
+    training, pre-encoded 1 MiB frames, so the wall prices ingestion
+    alone).  Arms: the PR-5 legacy path faithfully (inline decode on
+    recv threads, unbounded inbox, drained O(K·P) commit), the same
+    path with only the inbox backpressure (queue-discipline isolation),
+    and decode-into + streaming aggregation-on-arrival at pool 1/4/8.
+    On a many-core server the pool sweep shows decode scaling; on a
+    2-core box it shows the lock becoming the next bottleneck (PERF.md
+    "Uplink ingestion")."""
+    from fedml_tpu.async_.torture import run_ingest_torture
+
+    arms = [("legacy pool=0", dict(ingest_pool=0, decode_into=False,
+                                   streaming=False)),
+            ("legacy bounded-inbox", dict(ingest_pool=0, decode_into=False,
+                                          streaming=False,
+                                          inbox_bound=64))]
+    arms += [(f"decode-into pool={p}",
+              dict(ingest_pool=p, decode_into=True, streaming=True))
+             for p in (1, 4, 8)]
+    base = None
+    for i, (tag, kw) in enumerate(arms):
+        r = run_ingest_torture(n_clients=32, backend="TCP", buffer_k=8,
+                               commits=30, warmup_commits=5,
+                               base_port=53500 + i, timeout_s=300, **kw)
+        ups = r["committed_updates_per_sec"]
+        base = ups if base is None else base
+        print(f"INGEST {tag}: {ups:.1f} updates/s "
+              f"({ups / base:.1f}x legacy)  decode p50/p95 "
+              f"{r['decode_p50_s'] * 1e3:.2f}/"
+              f"{r['decode_p95_s'] * 1e3:.2f} ms  lock wait "
+              f"{r['lock_wait_seconds']:.2f}s", flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
