@@ -55,7 +55,28 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     lock_wait_seconds, plus the headline "speedup_vs_legacy"
 #     (best arm / legacy — the ISSUE-6 >=2x acceptance gate); null in
 #     sync/async modes
-SCHEMA_VERSION = 5
+# v6: + "critical_path" block (ISSUE 7, fedml_tpu/obs/timeline.py):
+#     per-round stage attribution (stages {train/commit/decode/fold/
+#     wait...: seconds}, stage_totals_s/stage_share, round_wall_p50/
+#     p95_s, and p95_attribution naming the stage that explains p95
+#     round wall).  Computed from the live span tracer, so it is null
+#     unless the run is traced (FEDML_OBS_DIR); v5 readers that ignore
+#     unknown keys keep working
+SCHEMA_VERSION = 6
+
+
+def _critical_path_doc():
+    """Schema-v6 critical_path block from the live tracer (None when
+    the run is untraced — spans are the input, metrics alone cannot
+    place stages on a timeline)."""
+    from fedml_tpu import obs
+    t = obs.tracer()
+    if t is None:
+        return None
+    from fedml_tpu.obs import timeline
+    report = timeline.critical_path(t.events())
+    report.pop("rounds", None)       # per-round detail stays in obs_dir
+    return report
 
 
 def _git_sha() -> str:
@@ -193,6 +214,7 @@ def main() -> None:
             "h2d_bytes_per_round": None,
             "async": None,
             "ingest": None,
+            "critical_path": None,
             "error": "chip_unavailable",
             "detail": detail,
         })))
@@ -329,6 +351,9 @@ def main() -> None:
         "rounds": [
             {k: round(v, 4) for k, v in r.items()}
             for r in engine.transfer_stats.rounds],
+        # v6 stage attribution (per-"round" spans on this sync path);
+        # null unless the run is traced
+        "critical_path": _critical_path_doc(),
     })
     if obs.enabled():
         obs.export()                   # trace + metrics into FEDML_OBS_DIR
@@ -391,6 +416,9 @@ def _bench_async(cfg, data, trainer) -> None:
         "async": {k: (round(v, 4) if isinstance(v, float) else v)
                   for k, v in rep.items()},
         "ingest": None,
+        # v6: commit-to-commit stage attribution from the scheduler's
+        # spans (train waves / commits / eval + wait); null untraced
+        "critical_path": _critical_path_doc(),
     })
     if obs.enabled():
         obs.export()
@@ -498,6 +526,13 @@ def _bench_ingest(args) -> None:
                 best["committed_updates_per_sec"] / legacy_ups, 2)
                 if legacy_ups > 0 else None,
         },
+        # v6: the BEST arm's decode/fold/commit attribution (each
+        # torture run computes its own window-scoped report); null
+        # untraced
+        "critical_path": (
+            {k: v for k, v in best["critical_path"].items()
+             if k != "rounds"}
+            if best.get("critical_path") else None),
     })
     if obs.enabled():
         obs.export()

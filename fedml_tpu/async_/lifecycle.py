@@ -35,6 +35,7 @@ from typing import Any, Optional
 import numpy as np
 
 from fedml_tpu import obs
+from fedml_tpu.obs import propagate
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message, MessageCodec
 from fedml_tpu.async_.staleness import (AsyncBuffer, RowLayout, flat_dim,
@@ -335,12 +336,18 @@ class AsyncServerManager(ServerManager):
                     if (full.get_type()
                             != AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT):
                         # control traffic: hand to the FSM dispatch loop
+                        self.com_manager._note_frame(full)
                         self.com_manager._on_message(full)
                         return
                     np.copyto(row, flatten_vars_row(
                         full.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)))
                     msg = full
             self._m_decode.observe(time.perf_counter() - t0)
+            # trace block + piggybacked client metrics delta: the sink
+            # path bypasses _deliver_frame's inline-decode note, so the
+            # pool worker strips/accounts them here (clock offsets,
+            # trace.recv digest instant, cohort metrics fold)
+            self.com_manager._note_frame(msg)
             self._ingest_row(
                 msg.get_sender_id(), row,
                 float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES)),
@@ -532,6 +539,10 @@ class AsyncClientManager(ClientManager):
             lambda v, shard, rng: trainer.local_train(
                 v, shard, rng, self.epochs))
         self._rng = jax.random.PRNGKey(2000 + rank)
+        # mergeable-telemetry baseline: each uplink ships the registry
+        # delta since the previous uplink (obs/propagate.py), so the
+        # server's rollup sees client-side counters without a scrape
+        self._m_ship_state: Optional[dict] = None
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -571,6 +582,21 @@ class AsyncClientManager(ClientManager):
                        int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
         if self.done.is_set() or self._closed:
             return      # STOP landed during the latency sleep / train
+        if obs.enabled():
+            # piggyback this client's metrics delta on the uplink —
+            # compact (only what moved since the last ship), folded
+            # into the server registry as a cohort rollup under
+            # origin="remote" (propagate.note; delta_snapshot excludes
+            # already-merged origin-labeled series, so a shared
+            # in-process registry cannot echo the rollup back into
+            # itself).  Obs off => the frame stays byte-identical to
+            # the untraced build.  (In the in-process sim every rank
+            # shares one registry, so the shipped delta is the PROCESS
+            # delta — the per-client precision only exists in real
+            # multi-process deployments.)
+            delta, self._m_ship_state = obs.registry().delta_snapshot(
+                self._m_ship_state)
+            out.add_params(propagate.METRICS_KEY, delta)
         self.send_message(out)
 
     def _handle_stop(self, msg: Message) -> None:

@@ -372,6 +372,18 @@ class AsyncFedAvgEngine(FedAvgEngine):
         return variables
 
     # -- observability rollup -------------------------------------------------
+    def timeline_report(self) -> Optional[dict]:
+        """Round critical-path attribution over the live tracer's spans
+        (fedml_tpu/obs/timeline.py): commit-to-commit windows, per-stage
+        seconds (train/commit/eval + wait), p95 straggler attribution.
+        None when tracing is disabled (no --obs_dir) — metrics alone
+        cannot place spans on a timeline."""
+        t = obs.tracer()
+        if t is None:
+            return None
+        from fedml_tpu.obs import timeline
+        return timeline.critical_path(t.events())
+
     def staleness_percentiles(self, qs=(50, 95)) -> dict:
         s = np.asarray(self.staleness_committed or [0.0])
         return {f"p{q}": float(np.percentile(s, q)) for q in qs}

@@ -34,6 +34,8 @@ from typing import Optional
 import numpy as np
 
 from fedml_tpu import obs
+from fedml_tpu.obs import propagate
+from fedml_tpu.obs.metrics import quantile_from_cumulative
 from fedml_tpu.async_.lifecycle import AsyncMessage, AsyncServerManager
 from fedml_tpu.comm.message import Message, MessageCodec
 
@@ -71,6 +73,11 @@ def _result_frame(template, rank: int, p_seed: int) -> bytes:
     msg.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, vals)
     msg.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, 32.0)
     msg.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, 0)
+    # under tracing, frames carry the trace block a real uplink would —
+    # so the traced-vs-untraced overhead A/B (exp_TRACE) prices the
+    # block's decode + note, not just the server-side spans.  Obs off
+    # => byte-identical to the untraced build's frames.
+    propagate.stamp(msg, rank)
     return MessageCodec.encode(msg)
 
 
@@ -115,30 +122,10 @@ def _inproc_client(backend, frame: bytes, stop: threading.Event):
         pass                               # manager finished mid-frame
 
 
-# ---------------------------------------------------------------------------
-# histogram percentiles (cumulative-bucket interpolation)
-# ---------------------------------------------------------------------------
-
-def _quantile_from_cumulative(before: list, after: list, q: float) -> float:
-    """Approximate quantile of the observations BETWEEN two cumulative
-    snapshots of one histogram (linear interpolation inside the bucket,
-    lower edge 0 for the first)."""
-    deltas = [(le, a - b) for (le, a), (_, b) in zip(after, before)]
-    total = deltas[-1][1]
-    if total <= 0:
-        return 0.0
-    target = q * total
-    prev_le, prev_c = 0.0, 0
-    for le, c in deltas:
-        if c >= target:
-            if le == float("inf"):
-                return prev_le
-            span = c - prev_c
-            frac = (target - prev_c) / span if span > 0 else 1.0
-            return prev_le + frac * (le - prev_le)
-        prev_le, prev_c = (0.0 if le == float("inf") else le), c
-    return prev_le
-
+# histogram-delta percentiles: the hand-rolled cumulative-bucket
+# interpolation this module used to carry moved into the ONE shared
+# definition, obs.metrics.quantile_from_cumulative (Histogram.quantile
+# resolves there too) — bitwise-same numbers pinned in tests/test_obs.py
 
 # ---------------------------------------------------------------------------
 # the torture run
@@ -186,6 +173,11 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
             # native .so would move decode threading off-harness
             kw["force_python_tcp"] = True
 
+    tracer = obs.tracer()
+    # trace watermark: several torture arms share one process tracer
+    # (bench --mode ingest) — this run's critical path must only see
+    # its own spans
+    trace_t0 = tracer._now_us() if tracer is not None else 0.0
     hist = obs.histogram("comm_decode_seconds",
                          buckets=obs.metrics.DECODE_SECONDS_BUCKETS,
                          backend=backend.lower())
@@ -293,8 +285,8 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
         "updates_committed": updates,
         "committed_updates_per_sec": updates / dt if dt > 0 else 0.0,
         "commits_per_sec": commits / dt if dt > 0 else 0.0,
-        "decode_p50_s": _quantile_from_cumulative(hist0, hist1, 0.50),
-        "decode_p95_s": _quantile_from_cumulative(hist0, hist1, 0.95),
+        "decode_p50_s": quantile_from_cumulative(hist0, hist1, 0.50),
+        "decode_p95_s": quantile_from_cumulative(hist0, hist1, 0.95),
         "decode_samples": int(hist1[-1][1] - hist0[-1][1]),
         "metric_window": metric_window,
         "lock_wait_seconds": lock1 - lock0,
@@ -308,4 +300,11 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
     report["finite"] = bool(all(
         np.isfinite(np.asarray(leaf)).all()
         for leaf in jax.tree.leaves(server.variables)))
+    if tracer is not None:
+        # commit-to-commit stage attribution (decode/fold/commit + wait
+        # on this no-training harness) — the ISSUE-7 critical path,
+        # surfaced in bench.py's schema-v6 "critical_path" block
+        from fedml_tpu.obs import timeline
+        report["critical_path"] = timeline.critical_path(
+            [e for e in tracer.events() if e["ts"] >= trace_t0])
     return report

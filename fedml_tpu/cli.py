@@ -286,6 +286,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --obs_dir: flight-recorder dump when one "
                         "round exceeds this wall-clock (the hang/"
                         "straggler tripwire; the run is NOT killed)")
+    p.add_argument("--obs_http_port", type=int, default=None,
+                   help="serve the loopback introspection endpoint on "
+                        "this port (0 = ephemeral): /metrics Prometheus "
+                        "text, /rollup JSON, /flight dump trigger — "
+                        "long async/torture runs become inspectable "
+                        "without SIGUSR1 shell access.  Works without "
+                        "--obs_dir (metrics are always on); "
+                        "FEDML_OBS_HTTP_PORT is the env twin")
     p.add_argument("--run_dir", type=str, default="./runs")
     p.add_argument("--run_name", type=str, default=None)
     p.add_argument("--ckpt_dir", type=str, default=None)
@@ -794,6 +802,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         obs.configure(args.obs_dir)
     else:
         obs.configure_from_env()     # FEDML_OBS_DIR (tools/isolate_hang)
+    if args.obs_http_port is not None:
+        port = obs.serve_http(args.obs_http_port).port
+        logging.getLogger(__name__).info(
+            "obs introspection endpoint on http://127.0.0.1:%d "
+            "(/metrics /rollup /flight)", port)
     if args.multihost:
         from fedml_tpu.parallel.multihost import init_multihost
         init_multihost(required=True)

@@ -13,6 +13,7 @@ import queue
 import time
 
 from fedml_tpu import obs
+from fedml_tpu.obs import propagate
 from fedml_tpu.comm.message import Message, MessageCodec
 
 
@@ -59,6 +60,10 @@ class BaseCommManager(abc.ABC):
         self._m_decode_seconds = obs.histogram(
             "comm_decode_seconds",
             buckets=obs.metrics.DECODE_SECONDS_BUCKETS, backend=b)
+        # federation-wide tracing (fedml_tpu/obs/propagate.py): per-peer
+        # clock-offset estimator fed by the trace blocks send paths
+        # stamp and receive paths strip at the chokepoints below
+        self._clock = propagate.make_clock(b)
 
     # -- observability hooks -------------------------------------------------
     def _obs_sent(self, nbytes: int) -> None:
@@ -71,6 +76,22 @@ class BaseCommManager(abc.ABC):
 
     def _obs_retry(self) -> None:
         self._m_retries.inc()
+
+    # -- federation-wide tracing (ISSUE 7) -----------------------------------
+    def _stamp_frame(self, msg: Message) -> None:
+        """Outbound chokepoint twin of `_deliver_frame`: attach the
+        compact trace block (sender rank, send timestamps, span digest,
+        clock echo) BEFORE encode.  Every concrete backend calls this
+        first in `send_message`.  With tracing disabled nothing is
+        added — frames stay byte-identical to the untraced build
+        (pinned in tests/test_wire_codec.py)."""
+        propagate.stamp(msg, getattr(self, "rank", 0), clock=self._clock)
+
+    def _note_frame(self, msg: Message) -> None:
+        """Strip + account the trace block / piggybacked metrics delta
+        of an inbound Message before the FSM sees it (clock-offset
+        estimate, trace.recv instant, cohort metrics fold)."""
+        propagate.note(msg, backend=self.backend_name, clock=self._clock)
 
     # -- reference API -------------------------------------------------------
     @abc.abstractmethod
@@ -130,10 +151,14 @@ class BaseCommManager(abc.ABC):
             msg = sink(payload)
             if msg is None:
                 return
+            self._note_frame(msg)   # idempotent (note pops the params)
         else:
             t0 = time.perf_counter()
-            msg = MessageCodec.decode(payload)
+            with obs.span("comm.decode", backend=self.backend_name,
+                          nbytes=len(payload)):
+                msg = MessageCodec.decode(payload)
             self._m_decode_seconds.observe(time.perf_counter() - t0)
+            self._note_frame(msg)
         self._on_message(msg)
 
     def _on_message(self, msg: Message) -> None:

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fedml_tpu import obs
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.core.pytree import tree_weighted_mean
@@ -204,7 +205,12 @@ class FedAvgServerManager(ServerManager):
         if self._watchdog is not None:
             self._watchdog.cancel()
             self._watchdog = None
-        self.aggregator.aggregate()
+        # commit-family delimiter: fedml_tpu/obs/timeline.py windows the
+        # FSM deployment's rounds aggregate-to-aggregate, exactly like
+        # the async path's async.commit spans
+        with obs.span("fsm.aggregate", round=self.round_idx,
+                      node="server"):
+            self.aggregator.aggregate()
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.aggregator.variables)
         self.round_idx += 1
@@ -263,8 +269,14 @@ class FedAvgClientManager(ClientManager):
         shard = jax.tree.map(lambda a: jnp.asarray(a[client_idx]),
                              self.data.client_shards)
         self._rng, rng = jax.random.split(self._rng)
-        new_vars, loss, n = self._local_train(
-            jax.tree.map(jnp.asarray, variables), shard, rng)
+        # the round's client-side train wall — the stage the timeline
+        # analyzer books as `train` when this client's trace is merged
+        # with the server's (fedml_tpu/obs/timeline.py)
+        with obs.span("fsm.local_train", rank=self.rank,
+                      client=client_idx, round=round_idx):
+            new_vars, loss, n = self._local_train(
+                jax.tree.map(jnp.asarray, variables), shard, rng)
+            n.block_until_ready()
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
                       self.rank, 0)
         out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))

@@ -94,6 +94,7 @@ class GrpcBackend(BaseCommManager):
     def send_message(self, msg: Message) -> None:
         # encode applies the v2 wire features (transport dtypes, zlib
         # head); gRPC's unary call needs the one contiguous frame
+        self._stamp_frame(msg)      # trace block (no-op when obs is off)
         payload = MessageCodec.encode(msg)
         # wait_for_ready rides out the multi-process startup race (peer's
         # server not bound yet) instead of failing UNAVAILABLE immediately

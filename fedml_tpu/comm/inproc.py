@@ -48,6 +48,10 @@ class InProcRouter:
             dst._deliver_frame(payload)
             return nbytes
         dst._obs_received(nbytes)
+        # no-encode: the Message object crosses directly — strip the
+        # sender's trace stamp here (the codec-framed _deliver_frame
+        # chokepoint never runs) so handlers don't see obs params
+        dst._note_frame(msg)
         dst._on_message(msg)
         return nbytes
 
@@ -68,4 +72,5 @@ class InProcBackend(BaseCommManager):
         return bool(self.router.encode)
 
     def send_message(self, msg: Message) -> None:
+        self._stamp_frame(msg)      # trace block (no-op when obs is off)
         self._obs_sent(self.router.route(msg))

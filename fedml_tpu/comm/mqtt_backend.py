@@ -84,12 +84,14 @@ class MqttBackend(BaseCommManager):
         # deserialize cost lands in the same comm_decode_seconds
         # histogram the codec-framed backends feed (comm/base.py)
         self._m_decode_seconds.observe(time.perf_counter() - t0)
+        self._note_frame(msg)       # trace block rides the JSON too
         self._on_message(msg)
 
     def send_message(self, msg: Message) -> None:
         receiver = msg.get_receiver_id()
         topic = (_TOPIC_S2C + str(receiver) if self.rank == 0
                  else _TOPIC_C2S + str(self.rank))
+        self._stamp_frame(msg)      # trace block (no-op when obs is off)
         payload = msg.to_json().encode("utf-8")
         if getattr(msg, "wire_compress", False):
             # nested-list JSON weights compress hard (repeated digits);

@@ -100,6 +100,7 @@ class NativeTcpBackend(BaseCommManager):
         # encode applies the v2 wire features (transport dtypes, zlib
         # head); fh_send frames one contiguous buffer, so the chunked
         # send stays a pure-Python-TCP feature
+        self._stamp_frame(msg)      # trace block (no-op when obs is off)
         payload = MessageCodec.encode(msg)
         rx = msg.get_receiver_id()
         # the whole connect+send (and the dead-connection retry) runs under

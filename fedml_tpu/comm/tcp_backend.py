@@ -115,6 +115,7 @@ class TcpBackend(BaseCommManager):
         # contiguous buffer (the old encode() + concat path transiently
         # held ~3x the payload: arrays + BytesIO + the length-prefixed
         # copy)
+        self._stamp_frame(msg)      # trace block (no-op when obs is off)
         total, parts = MessageCodec.encode_parts(msg)
         sock = self._connect(msg.get_receiver_id())
         with self._conn_lock:

@@ -47,16 +47,19 @@ __all__ = [
     "FlightRecorder", "configure", "configure_from_env", "enabled",
     "obs_dir", "span", "instant", "counter", "gauge", "histogram",
     "registry", "tracer", "flight", "deadline", "dump_flight", "export",
-    "sample_device_memory", "reset",
+    "sample_device_memory", "reset", "serve_http", "http_server",
 ]
 
 ENV_VAR = "FEDML_OBS_DIR"
+ENV_HTTP = "FEDML_OBS_HTTP_PORT"
+ENV_SPILL = "FEDML_OBS_SPILL"
 
 _lock = threading.Lock()
 _registry = MetricsRegistry()
 _tracer: Optional[SpanTracer] = None
 _flight: Optional[FlightRecorder] = None
 _dir: Optional[str] = None
+_http = None
 _prev_sigusr1 = None
 _atexit_registered = False
 
@@ -73,21 +76,46 @@ def obs_dir() -> Optional[str]:
 
 def configure(directory: str, *, flight_capacity: int = 4096,
               max_events: int = 200_000, install_signal: bool = True,
-              export_at_exit: bool = True) -> None:
+              export_at_exit: bool = True,
+              spill_events: Optional[bool] = None,
+              http_port: Optional[int] = None) -> None:
     """Enable tracing + flight recording, writing artifacts under
     `directory`.  Idempotent-ish: reconfiguring swaps in a fresh tracer
-    and ring (old events already exported stay on disk)."""
+    and ring (old events already exported stay on disk).
+
+    `spill_events` (or FEDML_OBS_SPILL=1) streams every span to
+    `directory`/trace.spill.jsonl up to a byte cap — long async runs
+    keep the trace head the ring would evict.  `http_port` (or
+    FEDML_OBS_HTTP_PORT) starts the loopback introspection endpoint
+    (/metrics, /rollup, /flight — fedml_tpu/obs/httpd.py)."""
     global _tracer, _flight, _dir, _atexit_registered
     os.makedirs(directory, exist_ok=True)
+    if spill_events is None:
+        spill_events = os.environ.get(ENV_SPILL, "") not in ("", "0")
     with _lock:
+        old = _tracer
         _flight = FlightRecorder(capacity=flight_capacity)
-        _tracer = SpanTracer(max_events=max_events, flight=_flight)
+        _tracer = SpanTracer(
+            max_events=max_events,
+            spill_path=(os.path.join(directory, "trace.spill.jsonl")
+                        if spill_events else None))
+        # dumps read the tracer's tail — spans don't write-through to a
+        # second ring (that doubled the hot-path cost)
+        t = _tracer
+        _flight.source = lambda: t.tail(flight_capacity)
         _dir = directory
         if export_at_exit and not _atexit_registered:
             _atexit_registered = True
             atexit.register(_atexit_export)
+    if old is not None:
+        old.close()
     if install_signal:
         _install_sigusr1()
+    if http_port is None:
+        port = os.environ.get(ENV_HTTP)
+        http_port = int(port) if port else None
+    if http_port is not None:
+        serve_http(http_port)
 
 
 def configure_from_env() -> bool:
@@ -105,12 +133,20 @@ def reset() -> None:
     registry.  Metric handles cached by already-constructed objects
     keep writing to the OLD registry — tests reset() before building
     the objects under test."""
-    global _registry, _tracer, _flight, _dir
+    global _registry, _tracer, _flight, _dir, _http
     with _lock:
+        old_tracer, old_http = _tracer, _http
         _registry = MetricsRegistry()
         _tracer = None
         _flight = None
         _dir = None
+        _http = None
+    if old_tracer is not None:
+        old_tracer.close()
+    if old_http is not None:
+        old_http.close()
+    from fedml_tpu.obs import propagate
+    propagate.reset_clocks()
 
 
 # -- tracing -----------------------------------------------------------------
@@ -174,6 +210,38 @@ def sample_device_memory() -> None:
             gauge("device_peak_bytes_in_use",
                   device=str(d.id)).set_max(
                       stats.get("peak_bytes_in_use", live))
+
+
+# -- http introspection ------------------------------------------------------
+
+def serve_http(port: int = 0):
+    """Start (or return the already-running) loopback introspection
+    endpoint — /metrics (Prometheus text), /rollup (JSON), /flight
+    (dump trigger).  Works with metrics alone (no --obs_dir needed);
+    /flight answers 503 until configure() arms the recorder.  Returns
+    the ObsHttpServer (its `.port` is the bound port — pass 0 for an
+    ephemeral one)."""
+    global _http
+    with _lock:
+        if _http is not None:
+            if port not in (0, _http.port):
+                import sys
+                print(f"obs.serve_http: endpoint already on port "
+                      f"{_http.port}; ignoring request for {port}",
+                      file=sys.stderr)
+            return _http
+    from fedml_tpu.obs.httpd import ObsHttpServer
+    server = ObsHttpServer(port=port)
+    with _lock:
+        if _http is None:
+            _http = server
+            return server
+    server.close()                    # lost a concurrent-start race
+    return _http
+
+
+def http_server():
+    return _http
 
 
 # -- flight recorder ---------------------------------------------------------
@@ -248,9 +316,15 @@ def export() -> dict[str, str]:
 
         trace.chrome.json   Chrome trace-event file (chrome://tracing,
                             ui.perfetto.dev)
-        trace.jsonl         same spans, one JSON object per line
+        trace.jsonl         same spans, one JSON object per line, led
+                            by a __meta__ line (pid/epoch/drops) —
+                            tools/trace_timeline.py's merge input
         metrics.prom        Prometheus text exposition
         metrics.json        JSON metrics snapshot
+        clock_offsets.json  per-comm-manager peer clock offsets
+                            (obs/propagate.py), when any traffic was
+                            trace-stamped — the timeline tool's
+                            cross-process alignment input
 
     Returns {artifact: path}.  No-op ({}) when disabled."""
     t, d = _tracer, _dir
@@ -269,6 +343,13 @@ def export() -> dict[str, str]:
     with open(mj, "w") as f:
         f.write(_registry.to_json())
     out["metrics_json"] = mj
+    from fedml_tpu.obs import propagate
+    clocks = propagate.clock_exports()
+    if clocks:
+        cj = os.path.join(d, "clock_offsets.json")
+        with open(cj, "w") as f:
+            json.dump(clocks, f, indent=1)
+        out["clock_offsets"] = cj
     return out
 
 
@@ -282,10 +363,18 @@ def _atexit_export() -> None:                # pragma: no cover - exit path
 def rollup() -> dict:
     """Small summary for embedding in bench JSON lines: where the
     artifacts are plus the headline counters."""
+    t = _tracer
     return {
         "obs_dir": _dir,
-        "spans_recorded": (0 if _tracer is None
-                           else len(_tracer.events()) + _tracer.dropped),
+        "spans_recorded": (0 if t is None
+                           else len(t.events()) + t.dropped),
+        # ring evictions, surfaced here so a truncated trace can never
+        # masquerade as a complete one (ISSUE-7 satellite) — with the
+        # spill accounting that says how much of the head survived
+        "spans_dropped": 0 if t is None else t.dropped,
+        "spans_spilled": 0 if t is None else t.spilled,
+        "spill_truncated": 0 if t is None else t.spill_truncated,
+        "http_port": None if _http is None else _http.port,
         "jit_compile_total": counter("jit_compile_total").value,
         "jit_compile_seconds_total":
             counter("jit_compile_seconds_total").value,

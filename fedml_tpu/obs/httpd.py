@@ -1,0 +1,76 @@
+"""Stdlib-HTTP introspection endpoint — poke a long run without shell
+access to its pid (ISSUE 7).
+
+SIGUSR1 flight dumps (fedml_tpu/obs/flight.py) assume an operator can
+signal the process; a torture run inside a container, a driver-launched
+bench, or a remote async server often cannot be signaled.  One daemon
+ThreadingHTTPServer (zero dependencies) serves:
+
+    /metrics   Prometheus text exposition (the always-on registry)
+    /rollup    obs.rollup() JSON — headline counters + artifact paths
+    /flight    POST/GET: trigger a flight-recorder dump, return its path
+
+Enable with ``FEDML_OBS_HTTP_PORT=<port>`` (picked up by
+``obs.configure``/``configure_from_env``), the CLI's
+``--obs_http_port``, or programmatically via ``obs.serve_http(port)``.
+Port 0 binds an ephemeral port — the chosen one is on
+``ObsHttpServer.port`` and in ``obs.rollup()``.  Binds 127.0.0.1 only:
+this is an operator loopback hatch, not a service."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class ObsHttpServer:
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        from fedml_tpu import obs
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):                        # noqa: N802 (stdlib)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/metrics":
+                    self._send(200,
+                               obs.registry().to_prometheus().encode(),
+                               "text/plain; version=0.0.4")
+                elif path == "/rollup":
+                    self._send(200, json.dumps(obs.rollup()).encode(),
+                               "application/json")
+                elif path == "/flight":
+                    dump = obs.dump_flight("http_trigger")
+                    body = {"dump": dump,
+                            "error": (None if dump is not None
+                                      else "obs not configured "
+                                           "(no --obs_dir)")}
+                    self._send(200 if dump is not None else 503,
+                               json.dumps(body).encode(),
+                               "application/json")
+                else:
+                    self._send(404, b'{"error": "unknown path"}',
+                               "application/json")
+
+            do_POST = do_GET
+
+            def log_message(self, *a):               # silence stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-http",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

@@ -21,6 +21,16 @@ Design constraints:
 * Prometheus semantics: counters only go up, labels are stable
   identities (get-or-create returns the same object), histograms are
   cumulative-bucket.
+* Mergeable (ISSUE 7): a registry can emit a compact snapshot DELTA
+  (`delta_snapshot`) and fold a peer's delta into itself
+  (`merge_delta`) — counters add, gauges max, histograms bucket-wise
+  add.  Those are the only commutative/associative choices, so merge
+  order across a federation's uplinks cannot change the rollup (laws
+  pinned in tests/test_obs.py).  Client registries ship deltas
+  piggybacked on uplink frames (fedml_tpu/obs/propagate.py) and fold
+  into the server registry under an `origin` label — a COHORT rollup,
+  never per-client labels, so server memory stays O(metrics) at a
+  million clients.
 """
 from __future__ import annotations
 
@@ -67,7 +77,42 @@ STALENESS_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
 CANONICAL_BUCKETS = {
     "comm_decode_seconds": DECODE_SECONDS_BUCKETS,
     "async_staleness": STALENESS_BUCKETS,
+    # one-way frame transit estimates (obs/propagate.py): LAN transits
+    # are sub-ms like decodes, WAN ones spill into the seconds tail
+    "trace_transit_seconds": DECODE_SECONDS_BUCKETS,
 }
+
+
+def quantile_from_cumulative(before, after, q: float) -> float:
+    """Approximate quantile of the observations BETWEEN two cumulative
+    snapshots of one histogram (`Histogram.cumulative()` lists), with
+    linear interpolation inside the bucket (lower edge 0 for the
+    first).  `before` may be None/empty for an all-time quantile.  The
+    ONE definition of histogram-delta percentiles — the torture bench's
+    decode p50/p95 and `Histogram.quantile` both resolve here (bitwise
+    pinned in tests/test_obs.py)."""
+    if not before:
+        before = [(le, 0) for le, _ in after]
+    deltas = [(le, a - b) for (le, a), (_, b) in zip(after, before)]
+    total = deltas[-1][1]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_le, prev_c = 0.0, 0
+    for le, c in deltas:
+        if c >= target:
+            if le == float("inf"):
+                return prev_le
+            span = c - prev_c
+            frac = (target - prev_c) / span if span > 0 else 1.0
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_c = (0.0 if le == float("inf") else le), c
+    return prev_le
+
+
+# label key merge_delta stamps on folded-in peer series; delta_snapshot
+# refuses to re-ship series carrying it (echo-loop guard)
+MERGE_ORIGIN_LABEL = "origin"
 
 
 def _label_key(labels: dict) -> tuple:
@@ -188,6 +233,33 @@ class Histogram:
         out.append((float("inf"), acc + counts[-1]))
         return out
 
+    def quantile(self, q: float, since=None) -> float:
+        """Approximate q-quantile of this histogram's observations —
+        all-time, or of the window SINCE a `cumulative()` snapshot
+        (the torture bench's warmup-excluded percentiles)."""
+        return quantile_from_cumulative(since, self.cumulative(), q)
+
+    def raw_state(self) -> tuple[list[int], float, int]:
+        """(per-bucket counts incl. +Inf, sum, count) — one consistent
+        read, for delta/merge bookkeeping."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def merge_counts(self, counts: Sequence[int], vsum: float,
+                     vcount: int) -> None:
+        """Bucket-wise add of a peer delta (same ladder — callers go
+        through MetricsRegistry.merge_delta, which resolves the ladder
+        before handing over)."""
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram {self.name}: merge of {len(counts)} buckets "
+                f"into {len(self._counts)}")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self._sum += vsum
+            self._count += int(vcount)
+
 
 class MetricsRegistry:
     """Get-or-create registry keyed on (name, sorted labels).  Asking for
@@ -239,6 +311,95 @@ class MetricsRegistry:
     def metrics(self) -> list:
         with self._lock:
             return list(self._metrics.values())
+
+    # -- snapshot-delta merge protocol (ISSUE 7) -----------------------------
+    # Merge semantics, the only commutative/associative choices:
+    #   counters   add
+    #   gauges     max  (peak semantics — "last" would depend on merge
+    #                    order, which a federation cannot promise)
+    #   histograms bucket-wise add (same ladder enforced)
+    # so  merge(a, merge(b, c)) == merge(merge(a, b), c)  and an empty
+    # delta is the identity — pinned in tests/test_obs.py.
+
+    def delta_snapshot(self, prev: Optional[dict] = None, *,
+                       include_merged: bool = False
+                       ) -> tuple[dict, dict]:
+        """One atomic pass over the registry: returns
+        ``(delta_doc, state)`` where `delta_doc` is the compact
+        JSON-able delta SINCE `prev` (a `state` from an earlier call;
+        None = since birth) and `state` is the new baseline.  Metrics
+        whose delta is empty (unmoved counters/gauges, histograms with
+        no new observations) are omitted — an idle client ships bytes
+        proportional to what it DID, not to what exists.  Series that
+        carry the merge-side ``origin`` label are SKIPPED by default:
+        they were folded in from a peer's delta, and re-shipping them
+        from a shared in-process registry would echo the rollup back
+        into itself (quadratic inflation).  An intermediate aggregator
+        re-exporting its fold up a hierarchy (client → edge → server)
+        passes ``include_merged=True`` — associativity of that
+        re-export is pinned in tests/test_obs.py."""
+        prev = prev or {}
+        entries, state = [], {}
+        for m in self.metrics():
+            if not include_merged and any(
+                    k == MERGE_ORIGIN_LABEL for k, _ in m.labels):
+                continue            # already-merged rollup, never re-ship
+            key = (m.name, m.labels)
+            labels = {k: v for k, v in m.labels}
+            if m.kind == "histogram":
+                counts, vsum, vcount = m.raw_state()
+                state[key] = (counts, vsum, vcount)
+                p_counts, p_sum, p_count = prev.get(
+                    key, ([0] * len(counts), 0.0, 0))
+                d_counts = [c - p for c, p in zip(counts, p_counts)]
+                if vcount - p_count <= 0:
+                    continue
+                entries.append({
+                    "name": m.name, "labels": labels, "kind": "histogram",
+                    "buckets": list(m.buckets), "counts": d_counts,
+                    "sum": vsum - p_sum, "count": vcount - p_count})
+            else:
+                v = m.value
+                state[key] = v
+                if m.kind == "counter":
+                    d = v - prev.get(key, 0.0)
+                    if d <= 0:
+                        continue
+                    entries.append({"name": m.name, "labels": labels,
+                                    "kind": "counter", "value": d})
+                else:
+                    if key in prev and v == prev[key]:
+                        continue
+                    entries.append({"name": m.name, "labels": labels,
+                                    "kind": "gauge", "value": v})
+        return {"schema": 1, "metrics": entries}, state
+
+    def merge_delta(self, delta: Optional[dict], **extra_labels) -> None:
+        """Fold a peer's `delta_snapshot` doc into this registry.
+        `extra_labels` are merged over the shipped labels — callers
+        pass a LOW-CARDINALITY ``origin`` (e.g. ``origin="remote"``),
+        never a per-client id: the million-client constraint is
+        O(metrics) server memory, cohort rollups instead of per-rank
+        label explosion.  The ``origin`` key also marks the series as
+        merged-in, which is what keeps delta_snapshot from re-shipping
+        it (the shared-registry echo-loop guard)."""
+        if not delta or not delta.get("metrics"):
+            return                      # empty delta is the merge identity
+        for e in delta["metrics"]:
+            labels = dict(e.get("labels", {}))
+            labels.update(extra_labels)
+            kind = e["kind"]
+            if kind == "counter":
+                self.counter(e["name"], **labels).inc(float(e["value"]))
+            elif kind == "gauge":
+                self.gauge(e["name"], **labels).set_max(float(e["value"]))
+            elif kind == "histogram":
+                h = self.histogram(e["name"], buckets=e["buckets"],
+                                   **labels)
+                h.merge_counts(e["counts"], float(e["sum"]),
+                               int(e["count"]))
+            else:
+                raise ValueError(f"unknown metric kind {kind!r} in delta")
 
     # -- exporters -----------------------------------------------------------
     def to_prometheus(self) -> str:

@@ -13,23 +13,43 @@ from ts/dur containment.
 Overhead when tracing is enabled: two perf_counter calls plus one
 locked deque append per span.  The event buffer is a fixed-size ring
 (default 200k events) so a week-long run cannot OOM the host; drops are
-counted and surfaced in the export.  When observability is disabled the
-tracer is never constructed at all — `obs.span()` returns a shared
-no-op (see fedml_tpu/obs/__init__.py).
+counted and surfaced in every export path (Chrome metadata, the JSONL
+meta line, `obs.rollup()`).  Long async/torture runs that must not lose
+the trace head can additionally enable the streaming JSONL **spill**: every
+event is appended to a side file as it is recorded, up to a byte cap
+(`spill_limit_bytes`), after which truncation is counted instead of
+silently eating disk — ring (tail) + spill (head) together lose nothing
+until the cap.  When observability is disabled the tracer is never
+constructed at all — `obs.span()` returns a shared no-op (see
+fedml_tpu/obs/__init__.py).
+
+Cross-process federation (ISSUE 7): `export_jsonl` leads with one
+`__meta__` line (pid, epoch_unix, drop/spill accounting) so
+tools/trace_timeline.py can rebase each process's perf_counter-relative
+timestamps onto the unix clock and merge many processes into one
+timeline; `digest()` is the compact per-round span summary
+(name → [count, total_us]) the wire codec piggybacks on frames
+(fedml_tpu/obs/propagate.py) so a client's stage walls reach the server
+even when its trace file is never collected.
 """
 from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import json
 import os
 import threading
 import time
 from typing import Iterator, Optional
 
+DEFAULT_SPILL_LIMIT = 256 * 1024 * 1024      # bytes of spill JSONL
+
 
 class SpanTracer:
-    def __init__(self, max_events: int = 200_000, flight=None):
+    def __init__(self, max_events: int = 200_000,
+                 spill_path: Optional[str] = None,
+                 spill_limit_bytes: int = DEFAULT_SPILL_LIMIT):
         self._lock = threading.Lock()
         self._events: collections.deque = collections.deque(
             maxlen=max_events)
@@ -39,17 +59,48 @@ class SpanTracer:
         # log timestamps (stored in export metadata)
         self.epoch_unix = time.time()
         self.pid = os.getpid()
-        self._flight = flight
+        # incremental per-name aggregate — digest() must not walk a
+        # 200k-event ring on the frame-send hot path
+        self._agg: dict[str, list] = {}
+        self._spill_lock = threading.Lock()
+        self._spill_f = None
+        self._spill_bytes = 0
+        self._spill_limit = spill_limit_bytes
+        self._spilled = 0
+        self._spill_truncated = 0
+        self.spill_path = spill_path
+        if spill_path is not None:
+            self._spill_f = open(spill_path, "a", buffering=1)
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._epoch) * 1e6
 
     def _record(self, ev: dict) -> None:
+        # serialize for the spill BEFORE taking the event lock: the
+        # json.dumps + line-buffered write must not serialize every
+        # tracing thread through disk I/O (the spill has its own lock,
+        # so the spill-off hot path stays two perf_counters + one
+        # locked append)
+        line = json.dumps(ev) + "\n" if self._spill_f is not None else None
         with self._lock:
             self._events.append(ev)
             self._recorded += 1
-        if self._flight is not None:
-            self._flight.record("span", ev)
+            a = self._agg.get(ev["name"])
+            if a is None:
+                self._agg[ev["name"]] = [1, ev.get("dur", 0.0)]
+            else:
+                a[0] += 1
+                a[1] += ev.get("dur", 0.0)
+        if line is not None:
+            with self._spill_lock:
+                if self._spill_f is None:       # closed under our feet
+                    return
+                if self._spill_bytes < self._spill_limit:
+                    self._spill_bytes += len(line)
+                    self._spilled += 1
+                    self._spill_f.write(line)
+                else:
+                    self._spill_truncated += 1
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[None]:
@@ -73,10 +124,51 @@ class SpanTracer:
         with self._lock:
             return list(self._events)
 
+    def tail(self, n: int) -> list[dict]:
+        """Most recent `n` events (oldest first) — the flight
+        recorder's dump payload.  Spans are NOT write-through-copied
+        into the flight ring (that doubled the hot-path cost); dumps
+        read this tail instead, which holds strictly more context
+        (max_events vs the old 4096-event flight ring)."""
+        with self._lock:
+            if n >= len(self._events):
+                return list(self._events)
+            return list(itertools.islice(
+                self._events, len(self._events) - n, None))
+
     @property
     def dropped(self) -> int:
         with self._lock:
             return self._recorded - len(self._events)
+
+    @property
+    def spilled(self) -> int:
+        """Events persisted to the spill file (0 when spill is off)."""
+        with self._spill_lock:
+            return self._spilled
+
+    @property
+    def spill_truncated(self) -> int:
+        """Events the spill byte-cap refused (still in the ring until
+        evicted — the cap bounds disk, the ring bounds memory)."""
+        with self._spill_lock:
+            return self._spill_truncated
+
+    def digest(self, top: int = 8) -> dict[str, list]:
+        """Compact span summary for piggybacking on wire frames:
+        {name: [count, total_us]} for the `top` names by total wall.
+        O(#distinct names), not O(events) — safe on the send path."""
+        with self._lock:
+            items = sorted(self._agg.items(), key=lambda kv: -kv[1][1])
+        return {name: [int(c), round(float(t), 1)]
+                for name, (c, t) in items[:top]}
+
+    def _meta(self) -> dict:
+        return {"pid": self.pid, "epoch_unix": self.epoch_unix,
+                "dropped_events": self.dropped,
+                "spilled_events": self.spilled,
+                "spill_truncated": self.spill_truncated,
+                "spill_path": self.spill_path}
 
     # -- exporters -----------------------------------------------------------
     def export_chrome(self, path: str) -> str:
@@ -93,7 +185,9 @@ class SpanTracer:
         doc = {"traceEvents": meta + events,
                "displayTimeUnit": "ms",
                "otherData": {"epoch_unix": self.epoch_unix,
-                             "dropped_events": self.dropped}}
+                             "dropped_events": self.dropped,
+                             "spilled_events": self.spilled,
+                             "spill_truncated": self.spill_truncated}}
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
@@ -101,12 +195,26 @@ class SpanTracer:
         return path
 
     def export_jsonl(self, path: str) -> str:
+        """One JSON object per line; the FIRST line is a `__meta__`
+        record (pid, epoch_unix, drop/spill accounting) that
+        tools/trace_timeline.py uses to clock-align this process's
+        events with other processes' exports."""
+        with self._spill_lock:
+            if self._spill_f is not None:
+                self._spill_f.flush()
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
+            f.write(json.dumps({"__meta__": self._meta()}) + "\n")
             for ev in self.events():
                 f.write(json.dumps(ev) + "\n")
         os.replace(tmp, path)
         return path
+
+    def close(self) -> None:
+        with self._spill_lock:
+            if self._spill_f is not None:
+                self._spill_f.close()
+                self._spill_f = None
 
 
 class _NoopSpan:

@@ -275,3 +275,88 @@ def test_ingest_torture_32_clients_tcp_speedup():
     assert legacy["finite"] and fast["finite"]
     assert (fast["committed_updates_per_sec"]
             >= 2.0 * legacy["committed_updates_per_sec"]), (legacy, fast)
+
+
+# -- ISSUE 7: federation-wide tracing acceptance -----------------------------
+
+def _timeline_tool(*argv):
+    """Invoke tools/trace_timeline.py's main() in-process."""
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_timeline.py")
+    spec = importlib.util.spec_from_file_location("trace_timeline", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(list(argv))
+
+
+def _traced_async_acceptance(tmp_path, backend, **backend_kw):
+    """ISSUE-7 acceptance body: a traced async run over `backend`, then
+    tools/trace_timeline.py on its obs dir — the merged Chrome trace
+    must load, the critical path must cover every commit, and each
+    round's stage sum must land within 10% of the measured round wall
+    (exact by construction: the residual books as `wait`)."""
+    import json
+    import os
+    obs.reset()
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    try:
+        cfg, trainer, data = _small_setup(n_clients=2)
+        v, server = run_async_messaging(
+            trainer, data, cfg, buffer_k=2, total_commits=3,
+            worker_num=2, backend=backend, timeout_s=120, **backend_kw)
+        assert server.version == 3
+        assert np.isfinite(float(jax.tree.leaves(v)[0].ravel()[0]))
+        # trace blocks crossed the wire and were stripped + accounted
+        bname = server.com_manager.backend_name
+        assert obs.counter("trace_frames_total",
+                           backend=bname).value > 0
+        # the clients' piggybacked metric deltas folded as ONE cohort
+        # label set (origin="remote"), not per-client labels
+        remote = [k for k in obs.registry().snapshot()
+                  if 'origin="remote"' in k]
+        assert remote, "no piggybacked client metrics folded"
+        paths = obs.export()
+        assert "jsonl_trace" in paths
+        rc = _timeline_tool(str(tmp_path))
+        assert rc == 0
+        merged = json.load(open(tmp_path / "merged.chrome.json"))
+        names = {e.get("name") for e in merged["traceEvents"]}
+        assert "async.commit" in names and "trace.recv" in names
+        # the synthetic critical-path lanes render next to raw spans
+        assert any(
+            e.get("ph") == "M"
+            and (e.get("args") or {}).get("name") == "round critical path"
+            for e in merged["traceEvents"])
+        report = json.load(open(tmp_path / "critical_path.json"))
+        assert report["n_rounds"] == 3
+        for r in report["rounds"]:
+            stage_sum = sum(r["stages"].values())
+            assert abs(stage_sum - r["wall_s"]) <= 0.10 * r["wall_s"], r
+        # the federated stages appear: client train + server commit
+        assert report["stage_totals_s"].get("train", 0) > 0
+        assert report["stage_totals_s"].get("commit", 0) > 0
+        assert report["p95_attribution"]["stage"] in report[
+            "stage_totals_s"]
+        return report
+    finally:
+        obs.reset()
+
+
+def test_trace_timeline_acceptance_inproc(tmp_path):
+    _traced_async_acceptance(tmp_path, "INPROC")
+
+
+def test_trace_timeline_acceptance_tcp(tmp_path):
+    """The same acceptance over real sockets: trace blocks ride TCP
+    frames, the per-peer clock sync sees both directions (server
+    dispatches + client uplinks), and the timeline tool merges the
+    single-process trace of a multi-socket run."""
+    report = _traced_async_acceptance(
+        tmp_path, "TCP", force_python_tcp=True,
+        ip_config={0: "127.0.0.1", 1: "127.0.0.1", 2: "127.0.0.1"},
+        base_port=53290)
+    # sockets add genuine transit: some wall books as wait
+    assert "wait" in report["stage_totals_s"]

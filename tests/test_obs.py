@@ -135,10 +135,15 @@ def test_chrome_trace_export_shape_and_nesting(tmp_path):
     assert o["ts"] <= i["ts"]
     assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
     assert i["args"] == {"phase": "aggregate"}
-    # jsonl twin: one object per line, same span count
+    # jsonl twin: a __meta__ header line (pid/epoch for the timeline
+    # merge tool), then one object per event
     jl = tr.export_jsonl(str(tmp_path / "trace.jsonl"))
     lines = [json.loads(ln) for ln in open(jl)]
-    assert len(lines) == 3
+    assert len(lines) == 4
+    meta = lines[0]["__meta__"]
+    assert meta["pid"] == os.getpid()
+    assert meta["dropped_events"] == 0
+    assert abs(meta["epoch_unix"] - time.time()) < 60
 
 
 def test_tracer_background_thread_lands_on_same_timeline(tmp_path):
@@ -364,3 +369,378 @@ def test_ingest_instruments_and_spans(clean_obs, tmp_path):
     names = {e["name"] for e in events}
     assert "ingest.torture" in names
     assert "ingest.decode" in names and "ingest.fold" in names
+
+
+# -- ISSUE 7: mergeable telemetry --------------------------------------------
+
+def _toy_registry(c=0.0, g=0.0, obs_vals=()):
+    reg = MetricsRegistry()
+    if c:
+        reg.counter("t_total", backend="x").inc(c)
+    if g:
+        reg.gauge("t_peak").set(g)
+    for v in obs_vals:
+        reg.histogram("t_seconds", buckets=(0.5, 1.0, 2.0)).observe(v)
+    return reg
+
+
+def _merged(*deltas):
+    reg = MetricsRegistry()
+    for d in deltas:
+        reg.merge_delta(d, origin="remote")
+    return reg.snapshot()
+
+
+def test_registry_merge_laws():
+    """The merge protocol's algebra (ISSUE 7): counters add, gauges
+    max, histograms bucket-wise add — so the fold is commutative and
+    associative (uplink arrival order cannot change the rollup) and an
+    empty delta is the identity."""
+    da, _ = _toy_registry(c=3, g=5.0, obs_vals=(0.25, 1.5)).delta_snapshot()
+    db, _ = _toy_registry(c=4, g=2.0, obs_vals=(0.75,)).delta_snapshot()
+    dc, _ = _toy_registry(c=1, g=9.0, obs_vals=(3.0,)).delta_snapshot()
+    # commutative
+    assert _merged(da, db) == _merged(db, da)
+    # associative: (a+b)+c == a+(b+c) — re-export the partial fold as a
+    # delta (include_merged=True: the hierarchical-aggregator path) and
+    # fold the remaining one in, both groupings
+    ab_reg = MetricsRegistry()
+    ab_reg.merge_delta(da, origin="remote")
+    ab_reg.merge_delta(db, origin="remote")
+    ab, _ = ab_reg.delta_snapshot(include_merged=True)
+    bc_reg = MetricsRegistry()
+    bc_reg.merge_delta(db, origin="remote")
+    bc_reg.merge_delta(dc, origin="remote")
+    bc, _ = bc_reg.delta_snapshot(include_merged=True)
+    assert _merged(ab, dc) == _merged(da, bc) == _merged(da, db, dc)
+    # echo-loop guard: by DEFAULT a fold is never re-shipped — a shared
+    # in-process registry (sim: client and server ranks share one) must
+    # not ship the server's own rollup back as "client" telemetry
+    echo, _ = ab_reg.delta_snapshot()
+    assert echo["metrics"] == []
+    # identity: the empty delta changes nothing (idempotent fold)
+    empty, _ = MetricsRegistry().delta_snapshot()
+    assert empty["metrics"] == []
+    assert _merged(da, empty) == _merged(da)
+    # the merged values are what the semantics promise
+    snap = _merged(da, db, dc)
+    assert snap['t_total{backend="x",origin="remote"}'] == 8.0
+    assert snap['t_peak{origin="remote"}'] == 9.0          # max, not last
+    assert snap['t_seconds{origin="remote"}']["count"] == 4
+
+
+def test_registry_delta_is_compact_and_windowed():
+    """delta_snapshot ships only what MOVED since the baseline — an
+    idle client's uplink carries an empty metrics block."""
+    reg = MetricsRegistry()
+    c = reg.counter("moves_total")
+    h = reg.histogram("h_seconds", buckets=(1.0,))
+    c.inc(2)
+    h.observe(0.5)
+    d1, state = reg.delta_snapshot()
+    assert {e["name"] for e in d1["metrics"]} == {"moves_total",
+                                                 "h_seconds"}
+    d2, state = reg.delta_snapshot(state)
+    assert d2["metrics"] == []                 # nothing moved
+    c.inc(5)
+    d3, state = reg.delta_snapshot(state)
+    assert d3["metrics"] == [{"name": "moves_total", "labels": {},
+                              "kind": "counter", "value": 5.0}]
+    # histogram deltas are window counts, not cumulative re-ships
+    h.observe(3.0)
+    d4, _ = reg.delta_snapshot(state)
+    (entry,) = d4["metrics"]
+    assert entry["count"] == 1 and entry["sum"] == 3.0
+
+
+def _legacy_quantile(before, after, q):
+    """The exact PR-6 hand-rolled torture implementation, kept here as
+    the bitwise pin for the deduped obs.metrics.quantile_from_cumulative
+    (and Histogram.quantile) — same numbers, to the bit."""
+    deltas = [(le, a - b) for (le, a), (_, b) in zip(after, before)]
+    total = deltas[-1][1]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_le, prev_c = 0.0, 0
+    for le, c in deltas:
+        if c >= target:
+            if le == float("inf"):
+                return prev_le
+            span = c - prev_c
+            frac = (target - prev_c) / span if span > 0 else 1.0
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_c = (0.0 if le == float("inf") else le), c
+    return prev_le
+
+
+def test_histogram_quantile_matches_legacy_torture_math():
+    from fedml_tpu.obs.metrics import quantile_from_cumulative
+    reg = MetricsRegistry()
+    h = reg.histogram("q_seconds", buckets=(0.001, 0.01, 0.1, 1.0))
+    rs = np.random.RandomState(7)
+    before = h.cumulative()
+    for v in rs.lognormal(-4.0, 2.0, size=500):
+        h.observe(float(v))
+    after = h.cumulative()
+    for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+        assert (quantile_from_cumulative(before, after, q)
+                == _legacy_quantile(before, after, q))      # bitwise
+        assert h.quantile(q, since=before) == _legacy_quantile(
+            before, after, q)
+    # all-time quantile == since-empty window
+    assert h.quantile(0.5) == quantile_from_cumulative(None, after, 0.5)
+    # empty window stays 0.0, not NaN
+    assert h.quantile(0.95, since=after) == 0.0
+
+
+# -- ISSUE 7: tracer spill + digest ------------------------------------------
+
+def test_tracer_spill_keeps_head_ring_keeps_tail(tmp_path):
+    """Satellite: a tiny ring drops the head, but the spill JSONL keeps
+    it (up to the byte cap) — together nothing is lost, and the drop /
+    spill accounting is surfaced in the export meta."""
+    spill = str(tmp_path / "spill.jsonl")
+    tr = SpanTracer(max_events=5, spill_path=spill)
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+    assert tr.dropped == 15 and tr.spilled == 20
+    names = [json.loads(ln)["name"] for ln in open(spill)]
+    assert names[:5] == ["s0", "s1", "s2", "s3", "s4"]      # head kept
+    assert len(names) == 20
+    jl = tr.export_jsonl(str(tmp_path / "t.jsonl"))
+    meta = json.loads(open(jl).readline())["__meta__"]
+    assert meta["dropped_events"] == 15
+    assert meta["spilled_events"] == 20 and meta["spill_truncated"] == 0
+    tr.close()
+
+
+def test_tracer_spill_cap_counts_truncation(tmp_path):
+    tr = SpanTracer(max_events=100,
+                    spill_path=str(tmp_path / "s.jsonl"),
+                    spill_limit_bytes=300)
+    for i in range(50):
+        tr.instant(f"e{i}")
+    assert tr.spill_truncated > 0
+    assert tr.spilled + tr.spill_truncated == 50
+    # the cap bounds the file: nothing written past it
+    assert os.path.getsize(tmp_path / "s.jsonl") <= 300 + 200
+    tr.close()
+
+
+def test_tracer_digest_aggregates_without_walking_the_ring():
+    tr = SpanTracer(max_events=4)          # evictions must not lose agg
+    for _ in range(10):
+        with tr.span("hot"):
+            pass
+    with tr.span("cold"):
+        time.sleep(0.002)
+    d = tr.digest(top=8)
+    assert d["hot"][0] == 10
+    assert d["cold"][0] == 1 and d["cold"][1] >= 1000      # >= 1ms in us
+    assert list(d) == sorted(d, key=lambda k: -d[k][1])    # by total
+
+
+def test_rollup_surfaces_drops(clean_obs, tmp_path):
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False, max_events=3)
+    for i in range(9):
+        with obs.span(f"r{i}"):
+            pass
+    ru = obs.rollup()
+    assert ru["spans_dropped"] == 6
+    assert ru["spans_recorded"] == 9
+
+
+# -- ISSUE 7: http introspection endpoint ------------------------------------
+
+def test_http_endpoint_metrics_rollup_flight(clean_obs, tmp_path):
+    import urllib.request
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    obs.counter("http_hits_total", backend="t").inc(3)
+    srv = obs.serve_http(0)
+    assert srv is obs.serve_http(0)            # idempotent singleton
+    base = f"http://127.0.0.1:{srv.port}"
+    prom = urllib.request.urlopen(f"{base}/metrics").read().decode()
+    assert 'http_hits_total{backend="t"} 3' in prom
+    ru = json.loads(urllib.request.urlopen(f"{base}/rollup").read())
+    assert ru["http_port"] == srv.port
+    fl = json.loads(urllib.request.urlopen(f"{base}/flight").read())
+    assert fl["dump"] and os.path.exists(fl["dump"])       # dump trigger
+    assert json.load(open(fl["dump"]))["reason"] == "http_trigger"
+    try:
+        urllib.request.urlopen(f"{base}/nope")
+        assert False, "unknown path must 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    # clean_obs reset() closes the server; verify it actually dies
+    obs.reset()
+    try:
+        urllib.request.urlopen(f"{base}/metrics", timeout=2)
+        assert False, "server survived reset()"
+    except Exception:
+        pass
+
+
+# -- ISSUE 7: trace propagation ----------------------------------------------
+
+def test_trace_block_propagates_and_aligns_clocks(clean_obs, tmp_path):
+    """Stamped frames carry rank/timestamps/digest + the clock echo;
+    the receiver strips the block before the FSM sees it, estimates the
+    peer offset (≈0 in-process), and records the trace.recv instant
+    with the shipped digest."""
+    from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.obs import propagate
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    router = InProcRouter()
+    a, b = InProcBackend(0, router), InProcBackend(1, router)
+    got = []
+    b._on_message = lambda m: got.append(m)
+    a._on_message = lambda m: got.append(m)
+    with obs.span("warm"):
+        pass
+    a.send_message(Message(1, 0, 1))
+    b.send_message(Message(1, 1, 0))           # echo direction
+    a.send_message(Message(1, 0, 1))           # now carries the echo
+    assert len(got) == 3
+    assert all(propagate.TRACE_KEY not in m.msg_params for m in got)
+    assert obs.counter("trace_frames_total",
+                       backend="inproc").value == 3
+    recvs = [e for e in obs.tracer().events()
+             if e["name"] == "trace.recv"]
+    assert len(recvs) == 3
+    assert recvs[0]["args"]["peer"] == 0
+    assert "warm" in recvs[0]["args"]["digest"]            # shipped spans
+    # same process, same clock: the symmetric estimate lands near zero
+    offs = b._clock.offsets()
+    assert 0 in offs and abs(offs[0]) < 0.5
+    # exported for the timeline tool
+    paths = obs.export()
+    clocks = json.load(open(paths["clock_offsets"]))
+    assert any(c["rank"] == 0 and "1" in c["offsets_s"] for c in clocks)
+
+
+def test_metrics_delta_piggyback_folds_as_cohort(clean_obs, tmp_path):
+    """An uplink's __fedml_metrics__ delta folds into the receiving
+    registry under origin="remote" — ONE label set regardless of how
+    many peers ship (the million-client memory constraint)."""
+    from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.obs import propagate
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    router = InProcRouter()
+    a, b = InProcBackend(0, router), InProcBackend(1, router)
+    b._on_message = lambda m: None
+    for sender_rank in (3, 4):                 # two "clients", one label
+        reg = MetricsRegistry()
+        reg.counter("client_steps_total").inc(7)
+        delta, _ = reg.delta_snapshot()
+        m = Message(1, sender_rank, 1)
+        m.add_params(propagate.METRICS_KEY, delta)
+        a.send_message(m)
+    folded = obs.counter("client_steps_total", origin="remote")
+    assert folded.value == 14                  # cohort rollup, summed
+    keys = [k for k in obs.registry().snapshot()
+            if k.startswith("client_steps_total")]
+    assert len(keys) == 1                      # no per-client labels
+
+
+def test_obs_disabled_send_receive_adds_nothing(clean_obs):
+    """With obs disabled, stamp/note are no-ops: no trace params appear
+    and no spans/instants are recorded (frame byte-identity is pinned
+    in test_wire_codec.py)."""
+    from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.obs import propagate
+    router = InProcRouter()
+    a, b = InProcBackend(0, router), InProcBackend(1, router)
+    got = []
+    b._on_message = lambda m: got.append(m)
+    a.send_message(Message(1, 0, 1))
+    assert propagate.TRACE_KEY not in got[0].msg_params
+    assert obs.tracer() is None
+    assert obs.counter("trace_frames_total", backend="inproc").value == 0
+
+
+# -- ISSUE 7: round critical-path analyzer -----------------------------------
+
+def _mk_span(name, ts_ms, dur_ms, tid=1, **args):
+    return {"name": name, "ph": "X", "ts": ts_ms * 1000.0,
+            "dur": dur_ms * 1000.0, "pid": 1, "tid": tid, "args": args}
+
+
+def test_critical_path_stage_claims_and_wait_residual():
+    """Synthetic two-round async trace: nesting attributes to the most
+    specific stage, the unclaimed remainder books as wait, and stage
+    sums equal round walls exactly (the acceptance's <=10% bound is met
+    by construction)."""
+    from fedml_tpu.obs import timeline
+    events = [
+        # round 0: train 0-40, decode 45-50 nested in fold 45-55,
+        # commit 55-60 -> wait = 60 - 40 - 10 - 5 - 5
+        _mk_span("async.wave", 0, 40, wave=0),
+        _mk_span("ingest.fold", 45, 10, tid=2),
+        _mk_span("ingest.decode", 45, 5, tid=3),
+        _mk_span("async.commit", 55, 5, version=0),
+        # round 1: two CONCURRENT decodes (union, not sum), commit
+        _mk_span("ingest.decode", 70, 10, tid=2),
+        _mk_span("ingest.decode", 75, 10, tid=3),
+        _mk_span("async.commit", 90, 10, version=1),
+    ]
+    rep = timeline.critical_path(events)
+    assert rep["n_rounds"] == 2
+    r0, r1 = rep["rounds"]
+    assert r0["round"] == 0 and r1["round"] == 1
+    s0 = r0["stages"]
+    assert abs(s0["train"] - 0.040) < 1e-9
+    assert abs(s0["decode"] - 0.005) < 1e-9        # nested: decode wins
+    assert abs(s0["fold"] - 0.005) < 1e-9          # fold keeps the rest
+    assert abs(s0["commit"] - 0.005) < 1e-9
+    assert abs(s0["wait"] - 0.005) < 1e-9
+    s1 = r1["stages"]
+    assert abs(s1["decode"] - 0.015) < 1e-9        # union of overlap
+    for r in rep["rounds"]:
+        assert abs(sum(r["stages"].values()) - r["wall_s"]) < 1e-9
+    assert rep["p95_attribution"]["stage"] in ("train", "wait")
+
+
+def test_critical_path_sync_round_spans():
+    from fedml_tpu.obs import timeline
+    events = [
+        _mk_span("round", 0, 100, round=0),
+        _mk_span("round.block_step", 10, 80, tid=2),
+        _mk_span("round", 100, 50, round=1),
+    ]
+    rep = timeline.critical_path(events)
+    assert rep["n_rounds"] == 2
+    assert rep["rounds"][0]["stages"]["train"] == 0.08
+    assert rep["rounds"][0]["dominant"] == "train"
+
+
+def test_timeline_merge_rebases_processes_onto_one_clock(tmp_path):
+    """Two processes' jsonl exports (distinct epochs) merge onto the
+    unix clock; the clock-offset correction shifts the peer."""
+    from fedml_tpu.obs import timeline
+    ja, jb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    with open(ja, "w") as f:
+        f.write(json.dumps({"__meta__": {"pid": 1,
+                                         "epoch_unix": 1000.0}}) + "\n")
+        f.write(json.dumps(_mk_span("async.commit", 0, 10,
+                                    version=0)) + "\n")
+    with open(jb, "w") as f:
+        f.write(json.dumps({"__meta__": {"pid": 2,
+                                         "epoch_unix": 999.0}}) + "\n")
+        f.write(json.dumps(_mk_span("async.local_train", 500, 400,
+                                    tid=9)) + "\n")
+    (ma, ea), (mb, eb) = (timeline.load_trace_jsonl(ja),
+                          timeline.load_trace_jsonl(jb))
+    merged = timeline.merge_traces([(ma, ea, 0.0), (mb, eb, 0.5)])
+    by = {e["name"]: e for e in merged}
+    # a's commit at unix 1000.000s; b's train at 999 + 0.5 + 0.5 = 1000s
+    assert abs(by["async.commit"]["ts"] - 1000.0 * 1e6) < 1
+    assert abs(by["async.local_train"]["ts"] - 1000.0 * 1e6) < 1

@@ -190,3 +190,52 @@ def test_chip_queue_carries_ingest_ab():
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr
+
+
+def test_bench_json_schema_v6_carries_critical_path():
+    """ISSUE 7: schema v6 adds the "critical_path" block — per-round
+    stage attribution from the span timeline (stage_totals_s,
+    stage_share, round_wall_p50/p95_s, p95_attribution) on every bench
+    mode, null when the run is untraced.  Static source check like the
+    v3/v4/v5 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 6, (
+        "bench schema must stay >= v6 (critical_path block)")
+    for field in ('"critical_path"', "_critical_path_doc"):
+        assert field in src, (
+            f"bench.py lost the v6 critical-path field {field} "
+            "(see fedml_tpu/obs/timeline.py)")
+    # the block's fields come from the analyzer — names must stay in
+    # sync with timeline.critical_path's report dict
+    tl = open(os.path.join(os.path.dirname(__file__), "..",
+                           "fedml_tpu", "obs", "timeline.py")).read()
+    for field in ("stage_totals_s", "stage_share", "round_wall_p95_s",
+                  "p95_attribution"):
+        assert field in tl, (
+            f"timeline.critical_path lost {field!r} — bench.py's v6 "
+            "critical_path block reads it")
+    # and the CLI tool that renders it must exist
+    assert os.path.exists(os.path.join(
+        os.path.dirname(__file__), "..", "tools", "trace_timeline.py")), (
+        "tools/trace_timeline.py (the merge/report CLI) is gone")
+
+
+def test_chip_queue_carries_trace_ab():
+    """ISSUE 7: the next chip window must price the tracing overhead —
+    scripts/run_chip_queue.sh carries the TRACE step (traced vs
+    untraced ingest torture, < 5% gate) and profile_bench.py defines
+    the exp_TRACE experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    assert "profile_bench.py TRACE" in open(queue).read(), (
+        "run_chip_queue.sh lost the TRACE traced-vs-untraced overhead "
+        "A/B (ISSUE 7 queues it for the next chip window)")
+    assert "exp_TRACE" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_TRACE experiment the queue runs")
+    import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr

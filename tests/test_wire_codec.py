@@ -324,3 +324,77 @@ def test_codec_decode_into_hardening():
     with pytest.raises(ValueError, match="magic"):
         MessageCodec.decode_into(b"NOPE" + payload[4:],
                                  np.zeros((layout.p,), np.float32), layout)
+
+
+# -- ISSUE 7: obs-off frames stay byte-identical to the untraced build -------
+
+def _frame_variants(seed=0):
+    """One message per wire shape the pin must cover: plain v1, v2
+    bf16-transport, v2 int8-transport, v2 zlib-compressed."""
+    def mk():
+        m = Message(3, 2, 1)
+        m.add_params("model_params", _rand_tree(seed))
+        return m
+    v1 = mk()
+    bf16 = mk()
+    bf16.set_wire_transport("model_params", "bf16")
+    int8 = mk()
+    int8.set_wire_transport("model_params", "int8")
+    z = mk()
+    z.wire_compress = True
+    return {"v1": v1, "v2_bf16": bf16, "v2_int8": int8, "v2_zlib": z}
+
+
+def test_obs_disabled_frames_byte_identical_across_variants(tmp_path):
+    """The ISSUE-7 acceptance pin: trace stamping happens at the comm
+    send chokepoint (BaseCommManager._stamp_frame) and is gated on the
+    tracer — with obs DISABLED the stamp is a no-op, so every frame
+    shape (v1, v2 bf16/int8 transport, v2 zlib) encodes byte-identical
+    to the pre-stamp encoding.  With obs ENABLED the stamp adds exactly
+    the __fedml_trace__ param and nothing else."""
+    from fedml_tpu import obs
+    from fedml_tpu.obs import propagate
+    obs.reset()
+    try:
+        for name, msg in _frame_variants().items():
+            baseline = MessageCodec.encode(msg)
+            propagate.stamp(msg, rank=2)           # obs off: must no-op
+            assert propagate.TRACE_KEY not in msg.msg_params, name
+            assert MessageCodec.encode(msg) == baseline, (
+                f"{name}: obs-disabled stamp changed the frame bytes")
+        obs.configure(str(tmp_path), install_signal=False,
+                      export_at_exit=False)
+        for name, msg in _frame_variants().items():
+            before_keys = set(msg.msg_params)
+            propagate.stamp(msg, rank=2)
+            assert set(msg.msg_params) == before_keys | {
+                propagate.TRACE_KEY}, name
+            out = MessageCodec.decode(MessageCodec.encode(msg))
+            blk = out.get(propagate.TRACE_KEY)
+            assert blk["r"] == 2 and "t" in blk, name   # block round-trips
+    finally:
+        obs.reset()
+
+
+def test_obs_disabled_backend_send_is_byte_identical(tmp_path):
+    """Same pin one level up, through a real backend send path: the
+    inproc router's encoded frame with obs disabled equals a plain
+    MessageCodec.encode of the same params."""
+    from fedml_tpu import obs
+    from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+    obs.reset()
+    seen = {}
+
+    class Capture(InProcRouter):
+        def route(self, msg):
+            payload = MessageCodec.encode(msg)
+            seen["frame"] = payload
+            return len(payload)
+
+    router = Capture()
+    be = InProcBackend(0, router)
+    msg = Message(1, 0, 0)
+    msg.add_params("w", np.arange(4, dtype=np.float32))
+    ref = MessageCodec.encode(msg)
+    be.send_message(msg)
+    assert seen["frame"] == ref

@@ -983,6 +983,78 @@ def exp_INGEST():
               f"{r['lock_wait_seconds']:.2f}s", flush=True)
 
 
+def exp_TRACE(reps: int = 4):
+    """Federation-tracing overhead A/B (ISSUE 7): the ingest torture
+    (32 TCP clients, decode-into + streaming, pool 8) untraced vs under
+    a live span tracer WITH trace-stamped frames (every uplink carries
+    the trace block, every receive feeds the clock-offset estimator and
+    records spans) — the acceptance gate is < 5% throughput regression.
+
+    Identical back-to-back torture arms have measured 20%+ apart on the
+    shared CPU box (PERF.md "Uplink ingestion" saw 28-80x spreads on
+    its headline too), and the FIRST arms of a process run 30-50% slow
+    (jit compile, allocator/TCP warmup) regardless of tracing.  A
+    single sequential pair cannot price a 5% effect, so the protocol
+    is PAIRED: one discarded warmup arm of each flavor, then `reps`
+    (untraced, traced) pairs alternating which arm goes first each rep
+    so slow drift cancels, and the headline is the MEDIAN of the
+    per-pair overhead ratios.  Prints the last traced arm's
+    critical-path attribution table, the same stage breakdown
+    bench.py's schema-v6 `critical_path` block records."""
+    import statistics
+    import tempfile
+    from fedml_tpu import obs
+    from fedml_tpu.obs import timeline
+    from fedml_tpu.async_.torture import run_ingest_torture
+
+    if obs.enabled():
+        print("TRACE: obs already enabled — the 'untraced' arm would be "
+              "traced too; unset FEDML_OBS_DIR", flush=True)
+        return
+    kw = dict(n_clients=32, backend="TCP", buffer_k=8, commits=30,
+              warmup_commits=5, ingest_pool=8, decode_into=True,
+              streaming=True, timeout_s=300)
+    obs_dir = tempfile.mkdtemp(prefix="fedml_trace_ab_")
+    port = [53700]
+
+    def run_arm(traced: bool):
+        port[0] += 1
+        if not traced:
+            return run_ingest_torture(base_port=port[0], **kw)
+        obs.configure(obs_dir, install_signal=False,
+                      export_at_exit=False)
+        try:
+            r = run_ingest_torture(base_port=port[0], **kw)
+            obs.export()
+        finally:
+            obs.reset()
+        return r
+
+    run_arm(False)                   # process warmup, both flavors —
+    run_arm(True)                    # timings discarded
+    ratios, traced_last = [], None
+    for rep in range(reps):
+        order = (False, True) if rep % 2 == 0 else (True, False)
+        pair = {}
+        for traced in order:
+            pair[traced] = run_arm(traced)
+        if pair[True].get("critical_path"):
+            traced_last = pair[True]
+        u0 = pair[False]["committed_updates_per_sec"]
+        u1 = pair[True]["committed_updates_per_sec"]
+        ratios.append(1.0 - u1 / u0 if u0 > 0 else 0.0)
+        print(f"TRACE pair {rep + 1}/{reps} "
+              f"({'U,T' if order[0] is False else 'T,U'}): "
+              f"untraced {u0:.1f}  traced {u1:.1f} updates/s  "
+              f"overhead {ratios[-1]:+.1%}", flush=True)
+    med = statistics.median(ratios)
+    print(f"TRACE median overhead {med:+.1%} over {reps} paired reps "
+          f"(gate < 5%; artifacts in {obs_dir})", flush=True)
+    if traced_last:
+        print(timeline.format_report(traced_last["critical_path"]),
+              flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)

@@ -1,0 +1,103 @@
+"""Merge per-process obs traces into one clock-aligned Chrome trace and
+print the round critical-path / straggler-attribution report (ISSUE 7).
+
+    PYTHONPATH=/root/repo python tools/trace_timeline.py OBS_DIR \
+        [OBS_DIR ...] [--out merged.chrome.json] \
+        [--report critical_path.json]
+
+Each OBS_DIR is a --obs_dir / FEDML_OBS_DIR directory left by one
+process (server, client, bench, torture run): its `trace.jsonl` leads
+with a __meta__ line (pid + epoch_unix) and, when frames were
+trace-stamped, `clock_offsets.json` holds the per-peer clock offsets
+the comm layer estimated from piggybacked timestamps
+(fedml_tpu/obs/propagate.py).  The tool:
+
+  1. rebases every process's spans onto the unix clock, shifting
+     non-reference processes by the reference's (rank-0 dir's)
+     estimated offset for their rank;
+  2. writes ONE merged Chrome trace (chrome://tracing, ui.perfetto.dev)
+     with a synthetic "round critical path" process whose per-stage
+     lanes render each round's attribution next to the raw spans;
+  3. computes the per-round critical path (dispatch → train → uplink →
+     decode → fold → commit, residual = wait/transit; stage sum ==
+     round wall by construction) and prints the straggler report:
+     which stage explains p95 round wall (fedml_tpu/obs/timeline.py).
+
+A bare trace.jsonl path works too (spill files included — they have no
+meta line and are taken as already-aligned).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from fedml_tpu.obs import timeline  # noqa: E402
+
+
+def _load_source(path: str):
+    """(meta, events, clocks) from an obs dir or a bare jsonl file."""
+    if os.path.isdir(path):
+        jsonl = os.path.join(path, "trace.jsonl")
+        if not os.path.exists(jsonl):
+            raise SystemExit(f"{path}: no trace.jsonl (was the run "
+                             "exported? obs.export() writes it)")
+        meta, events = timeline.load_trace_jsonl(jsonl)
+        clocks = []
+        cj = os.path.join(path, "clock_offsets.json")
+        if os.path.exists(cj):
+            clocks = json.load(open(cj))
+        return meta, events, clocks
+    meta, events = timeline.load_trace_jsonl(path)
+    return meta, events, []
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "trace_timeline", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("sources", nargs="+",
+                    help="obs dirs (or trace.jsonl files) to merge")
+    ap.add_argument("--out", default=None,
+                    help="merged Chrome trace path (default: "
+                         "<first dir>/merged.chrome.json)")
+    ap.add_argument("--report", default=None,
+                    help="critical-path JSON path (default: "
+                         "<first dir>/critical_path.json)")
+    args = ap.parse_args(argv)
+
+    loaded = [_load_source(s) for s in args.sources]
+    offsets = timeline.dir_offsets([(m, c) for m, _e, c in loaded])
+    merged = timeline.merge_traces(
+        (meta, events, off)
+        for (meta, events, _c), off in zip(loaded, offsets))
+    if not merged:
+        raise SystemExit("no span events in any source — was the run "
+                         "traced (--obs_dir / FEDML_OBS_DIR)?")
+    report = timeline.critical_path(merged)
+    report["sources"] = [
+        {"path": s, "pid": m.get("pid"), "events": len(e),
+         "dropped_events": m.get("dropped_events", 0),
+         "clock_offset_s": off}
+        for s, (m, e, _c), off in zip(args.sources, loaded, offsets)]
+
+    base = (args.sources[0] if os.path.isdir(args.sources[0])
+            else os.path.dirname(args.sources[0]) or ".")
+    out = args.out or os.path.join(base, "merged.chrome.json")
+    rep = args.report or os.path.join(base, "critical_path.json")
+    timeline.export_chrome(merged, out, report=report)
+    with open(rep, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"merged {len(merged)} events from {len(loaded)} trace(s) "
+          f"-> {out}")
+    print(f"critical path -> {rep}")
+    print(timeline.format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
