@@ -62,7 +62,16 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     round wall).  Computed from the live span tracer, so it is null
 #     unless the run is traced (FEDML_OBS_DIR); v5 readers that ignore
 #     unknown keys keep working
-SCHEMA_VERSION = 6
+# v7: + "chaos" block (`python bench.py --mode chaos`, ISSUE 8 —
+#     fedml_tpu/comm/chaos.py + reliability.py over the ingest torture):
+#     a "clean" reliable arm, a goodput-vs-fault-rate "curve" (loss/
+#     dup/corrupt sweeps, each row carrying the rates,
+#     committed_updates_per_sec, goodput_ratio vs clean, and the
+#     retries/dups_suppressed/quarantined/recv_thread_deaths counters),
+#     and a "mixed" arm (5% loss + 1% dup + 0.5% corrupt — the
+#     acceptance shape) with its goodput_vs_clean headline; null in
+#     other modes, so v6 readers keep working
+SCHEMA_VERSION = 7
 
 
 def _critical_path_doc():
@@ -172,7 +181,7 @@ def _probe_with_retry() -> tuple[bool, str]:
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser("bench")
-    ap.add_argument("--mode", choices=("sync", "async", "ingest"),
+    ap.add_argument("--mode", choices=("sync", "async", "ingest", "chaos"),
                     default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
@@ -183,7 +192,11 @@ def main() -> None:
                          "(fedml_tpu/async_/torture.py) — sustained "
                          "committed-updates/sec of the server's "
                          "decode+aggregate path under N saturating "
-                         "clients, legacy vs decode-into+streaming A/B")
+                         "clients, legacy vs decode-into+streaming A/B; "
+                         "chaos: the same torture under seeded wire "
+                         "faults (fedml_tpu/comm/chaos.py) with the "
+                         "reliability envelope on — goodput-vs-fault-"
+                         "rate curves for loss/dup/corrupt")
     ap.add_argument("--ingest_clients", type=int, default=32,
                     help="ingest mode: concurrent uplink clients")
     ap.add_argument("--ingest_backend", default="TCP",
@@ -194,6 +207,17 @@ def main() -> None:
                          "for the decode-into+streaming arms")
     ap.add_argument("--ingest_commits", type=int, default=30,
                     help="ingest mode: timed commits per arm")
+    ap.add_argument("--chaos_clients", type=int, default=32,
+                    help="chaos mode: concurrent reliable uplink clients")
+    ap.add_argument("--chaos_backend", default="TCP",
+                    choices=("TCP", "GRPC", "INPROC"),
+                    help="chaos mode: transport under fault injection")
+    ap.add_argument("--chaos_commits", type=int, default=12,
+                    help="chaos mode: timed commits per arm (the curve "
+                         "runs ~8 arms; keep this moderate)")
+    ap.add_argument("--chaos_seed", type=int, default=0,
+                    help="chaos mode: fault-injection seed (same seed = "
+                         "same per-stream injected-event trace)")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -214,6 +238,7 @@ def main() -> None:
             "h2d_bytes_per_round": None,
             "async": None,
             "ingest": None,
+            "chaos": None,
             "critical_path": None,
             "error": "chip_unavailable",
             "detail": detail,
@@ -231,6 +256,9 @@ def main() -> None:
     obs.configure_from_env()
     if args.mode == "ingest":
         _bench_ingest(args)
+        return
+    if args.mode == "chaos":
+        _bench_chaos(args)
         return
     import jax.numpy as jnp
 
@@ -335,6 +363,7 @@ def main() -> None:
         "mode": "sync",
         "async": None,
         "ingest": None,
+        "chaos": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -416,6 +445,7 @@ def _bench_async(cfg, data, trainer) -> None:
         "async": {k: (round(v, 4) if isinstance(v, float) else v)
                   for k, v in rep.items()},
         "ingest": None,
+        "chaos": None,
         # v6: commit-to-commit stage attribution from the scheduler's
         # spans (train waves / commits / eval + wait); null untraced
         "critical_path": _critical_path_doc(),
@@ -533,6 +563,116 @@ def _bench_ingest(args) -> None:
             {k: v for k, v in best["critical_path"].items()
              if k != "rounds"}
             if best.get("critical_path") else None),
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+# chaos-mode shape: every arm runs the reliable ingest torture (window-
+# limited FMLR uplink pushers, decode-into + streaming, pool 4) so the
+# curve isolates the FAULTS, not a transport change; 12 commits/arm
+# keeps the ~8-arm sweep around a few minutes on a small box.
+CHAOS_INGEST_POOL = 4
+CHAOS_WARMUP_COMMITS = 2
+CHAOS_CURVE_RATES = (0.05, 0.10, 0.20)
+CHAOS_MIXED = {"drop": 0.05, "dup": 0.01, "corrupt": 0.005}
+
+
+def _bench_chaos(args) -> None:
+    """Goodput-vs-fault-rate curves (ISSUE 8): the concurrent-uplink
+    ingest torture with the reliability envelope ON, under seeded
+    wire-level fault injection (fedml_tpu/comm/chaos.py) at the
+    server's receive chokepoint.  Arms: a clean reliable baseline, a
+    sweep of loss (drop), duplicate and corrupt rates at 5/10/20%, and
+    the acceptance-shaped "mixed" arm (5% loss + 1% dup + 0.5%
+    corrupt).  Every row reports committed-updates/sec, the goodput
+    ratio vs the clean arm, and the retry/dedup/quarantine/recv-death
+    counters — the ≥0.5x-of-clean, zero-recv-deaths gate's raw
+    numbers."""
+    from fedml_tpu import obs
+    from fedml_tpu.async_.torture import run_ingest_torture
+
+    port = int(os.environ.get("BENCH_CHAOS_PORT", "53400"))
+    arm_no = [0]
+
+    def run(tag, chaos=None):
+        arm_no[0] += 1
+        rep = run_ingest_torture(
+            n_clients=args.chaos_clients, backend=args.chaos_backend,
+            buffer_k=INGEST_BUFFER_K, commits=args.chaos_commits,
+            warmup_commits=CHAOS_WARMUP_COMMITS,
+            ingest_pool=CHAOS_INGEST_POOL, decode_into=True,
+            streaming=True, base_port=port + arm_no[0], timeout_s=600,
+            reliable=True, chaos=chaos, chaos_seed=args.chaos_seed)
+        print(f"{tag}: {rep['committed_updates_per_sec']:.1f} updates/s  "
+              f"retries {rep['retries']:.0f}  dups suppressed "
+              f"{rep['dups_suppressed']:.0f}  quarantined "
+              f"{rep['quarantined']:.0f}  recv deaths "
+              f"{rep['recv_thread_deaths']:.0f}", file=sys.stderr)
+        return rep
+
+    def row(rep, clean_ups, **rates):
+        return {
+            "drop": rates.get("drop", 0.0),
+            "dup": rates.get("dup", 0.0),
+            "corrupt": rates.get("corrupt", 0.0),
+            "committed_updates_per_sec": round(
+                rep["committed_updates_per_sec"], 4),
+            "goodput_ratio": round(
+                rep["committed_updates_per_sec"] / clean_ups, 4)
+                if clean_ups > 0 else None,
+            "retries": rep["retries"],
+            "dups_suppressed": rep["dups_suppressed"],
+            "quarantined": rep["quarantined"],
+            "abandoned": rep["abandoned"],
+            "recv_thread_deaths": rep["recv_thread_deaths"],
+            "chaos_injected": rep["chaos_injected"],
+        }
+
+    clean = run("clean reliable")
+    clean_ups = clean["committed_updates_per_sec"]
+    curve = []
+    for key in ("drop", "dup", "corrupt"):
+        for rate in CHAOS_CURVE_RATES:
+            rep = run(f"{key}_{int(rate * 100)}", {key: rate})
+            curve.append(row(rep, clean_ups, **{key: rate}))
+    mixed = run("mixed (5% loss + 1% dup + 0.5% corrupt)",
+                dict(CHAOS_MIXED))
+    doc = _stamp({
+        "metric": (f"async_chaos_{args.chaos_backend.lower()}_"
+                   f"{args.chaos_clients}clients_"
+                   "committed_updates_per_sec"),
+        "value": round(mixed["committed_updates_per_sec"], 4),
+        "unit": "updates/sec",
+        # the in-schema comparison is the clean reliable arm
+        "vs_baseline": None,
+        "mode": "chaos",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": None,
+        "chaos": {
+            "backend": clean["backend"],
+            "n_clients": clean["n_clients"],
+            "buffer_k": clean["buffer_k"],
+            "p": clean["p"],
+            "frame_bytes": clean["frame_bytes"],
+            "commits": clean["commits"],
+            "seed": args.chaos_seed,
+            "clean": row(clean, clean_ups),
+            "curve": curve,
+            "mixed": row(mixed, clean_ups, **CHAOS_MIXED),
+            "goodput_vs_clean": round(
+                mixed["committed_updates_per_sec"] / clean_ups, 4)
+                if clean_ups > 0 else None,
+        },
+        "critical_path": (
+            {k: v for k, v in mixed["critical_path"].items()
+             if k != "rounds"}
+            if mixed.get("critical_path") else None),
     })
     if obs.enabled():
         obs.export()

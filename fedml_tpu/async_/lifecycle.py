@@ -177,7 +177,25 @@ class AsyncServerManager(ServerManager):
 
     `redispatch=False` (torture-bench mode) never sends downlinks:
     clients push uplinks at their own rate and the server only ingests
-    and commits."""
+    and commits.
+
+    Robustness (ISSUE 8).  `reliable=True` envelopes the transport
+    (comm/reliability.py) — the receive chokepoint's (sender, seq)
+    dedup ledger then guards `_ingest_row`: a retried or duplicated
+    uplink is suppressed BEFORE decode, so the streaming accumulator
+    under a dup-storm is bitwise the clean run's (pinned in
+    tests/test_chaos.py).  `min_quorum` makes deadline commits
+    partition-aware: a deadline with fewer than `min_quorum` buffered
+    results redispatches and re-arms instead of committing a
+    near-empty buffer; commits that do fire below capacity are counted
+    in `async_degraded_commits_total`.  `checkpoint_dir` +
+    `checkpoint_every` save (version, variables, buffer state,
+    counters) through orbax after every Nth commit, and `resume=True`
+    restores the latest checkpoint at construction — the
+    crash-resume path: kill the server mid-round, rebuild it with
+    `resume=True` on the same port, `send_start()` re-handshakes every
+    client at the restored version and the run completes (pinned over
+    real TCP in tests/test_async_messaging.py)."""
 
     def __init__(self, init_variables: Pytree, total_commits: int,
                  buffer_k: int, rank: int = 0, size: int = 1,
@@ -185,9 +203,14 @@ class AsyncServerManager(ServerManager):
                  staleness_a: float = 0.5, staleness_b: float = 4.0,
                  mix: float = 1.0, deadline_s: Optional[float] = None,
                  streaming: bool = True, ingest_pool: int = 0,
-                 decode_into: bool = True, redispatch: bool = True, **kw):
+                 decode_into: bool = True, redispatch: bool = True,
+                 reliable: bool = False, min_quorum: int = 1,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, resume: bool = False, **kw):
         super().__init__(rank, size, backend, **kw)
         import jax
+        if reliable:
+            self.com_manager.enable_reliability()
         self.variables = jax.tree.map(np.asarray, init_variables)
         self.total_commits = total_commits
         self.buffer_k = buffer_k
@@ -196,9 +219,11 @@ class AsyncServerManager(ServerManager):
         self.streaming = streaming
         self.decode_into = decode_into
         self.redispatch = redispatch
+        self.min_quorum = max(1, int(min_quorum))
         self.ingest_pool = int(ingest_pool)
         self.version = 0
         self.partial_commits = 0
+        self.degraded_commits = 0            # deadline commits below K
         self.updates_committed = 0
         self.staleness_seen: list[float] = []
         self.commit_walls: list[float] = []      # perf_counter per commit
@@ -227,6 +252,7 @@ class AsyncServerManager(ServerManager):
             "async_staleness", buckets=obs.metrics.STALENESS_BUCKETS)
         self._m_commits = obs.counter("async_commits_total")
         self._m_deadline = obs.counter("async_deadline_commits_total")
+        self._m_degraded = obs.counter("async_degraded_commits_total")
         self._m_redispatch = obs.counter("async_redispatch_total")
         self._m_lock_wait = obs.counter("async_lock_wait_seconds")
         self._m_pool_depth = obs.gauge("async_ingest_pool_depth")
@@ -234,6 +260,32 @@ class AsyncServerManager(ServerManager):
             "comm_decode_seconds",
             buckets=obs.metrics.DECODE_SECONDS_BUCKETS,
             backend=self.com_manager.backend_name)
+        # crash-resume (ISSUE 8): per-commit orbax checkpoints of the
+        # full server round state — restore happens BEFORE the ingest
+        # pool exists, so no frame can race the rebuild
+        self._ckpt = None
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        if checkpoint_dir:
+            from fedml_tpu.utils.checkpoint import FedCheckpointManager
+            self._ckpt = FedCheckpointManager(checkpoint_dir, max_to_keep=3)
+            if resume and self._ckpt.latest_round() is not None:
+                step, variables, _s, extra = self._ckpt.restore(
+                    self.variables, (), extra_template=self._ckpt_state())
+                self.version = int(step)
+                self.variables = jax.tree.map(np.asarray, variables)
+                self.buffer.load_state(
+                    jax.tree.map(np.asarray, extra["buffer"]))
+                self.updates_committed = int(extra["updates_committed"])
+                self.partial_commits = int(extra["partial_commits"])
+                self.degraded_commits = int(extra["degraded_commits"])
+                rel = self.com_manager._rel_ep
+                if rel is not None and "reliable" in extra:
+                    rel.import_seq_state(
+                        jax.tree.map(np.asarray, extra["reliable"]))
+                log.info("async server resumed from checkpoint: version "
+                         "%d, %d updates committed, buffer %d/%d",
+                         self.version, self.updates_committed,
+                         self.buffer.count, self.buffer_k)
         self._layout = RowLayout(self.variables,
                                  AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)
         self._pool = None
@@ -264,6 +316,48 @@ class AsyncServerManager(ServerManager):
             self._ingest_sem = threading.BoundedSemaphore(
                 2 * self.ingest_pool)
             self.com_manager.set_frame_sink(self._ingest_frame)
+
+    # -- crash-resume --------------------------------------------------------
+    def _ckpt_state(self) -> dict:
+        """extra_state pytree for FedCheckpointManager: the buffer's
+        own checkpointable snapshot (accumulator or rows — the PR-5/6
+        state), the round counters (0-d ndarrays for orbax), and the
+        reliability endpoint's per-peer seq/ledger state — without it a
+        resumed server would re-fold an uplink whose ACK died with the
+        crash (double-count) and its re-handshake downlinks would be
+        suppressed as replays by the surviving clients' ledgers."""
+        rel = self.com_manager._rel_ep
+        rel_state = (rel.export_seq_state(self.size) if rel is not None
+                     else {"seq": np.zeros((self.size,), np.int64),
+                           "seen": np.full((self.size,), -1, np.int64)})
+        return {"buffer": self.buffer.state(),
+                "updates_committed": np.asarray(self.updates_committed,
+                                                np.int64),
+                "partial_commits": np.asarray(self.partial_commits,
+                                              np.int64),
+                "degraded_commits": np.asarray(self.degraded_commits,
+                                               np.int64),
+                "reliable": rel_state}
+
+    def _save_checkpoint_locked(self) -> None:
+        with obs.span("async.checkpoint", version=self.version):
+            self._ckpt.save(self.version, self.variables, (),
+                            extra_state=self._ckpt_state())
+
+    def crash(self) -> None:
+        """Chaos/test hook: die abruptly — no STOP broadcast, no final
+        commit.  The deadline watchdog is cancelled and the transport
+        torn down mid-round; clients keep running against a dead
+        server (their reliable resends carry the gap) until a new
+        server constructed with `resume=True` re-handshakes them."""
+        with self._lock:
+            if self._watchdog is not None:
+                self._watchdog.cancel()
+                self._watchdog = None
+            self.done.set()             # sink + _ingest_row drop frames
+        log.warning("async server CRASH at version %d (buffer %d/%d)",
+                    self.version, self.buffer.count, self.buffer_k)
+        self.finish()
 
     # -- dispatch ------------------------------------------------------------
     def send_start(self) -> None:
@@ -408,10 +502,13 @@ class AsyncServerManager(ServerManager):
             self._watchdog = None
             if self.done.is_set() or self.version != armed_version:
                 return                      # committed normally meanwhile
-            if self.buffer.count == 0:
-                # nothing arrived a whole deadline long: presume every
-                # outstanding dispatch crashed, retry them all (the
-                # lifecycle's rejoin path), keep the heartbeat alive
+            if self.buffer.count < self.min_quorum:
+                # not enough arrived a whole deadline long (empty, or
+                # below the partition quorum): presume the outstanding
+                # dispatches crashed/partitioned, retry them all (the
+                # lifecycle's rejoin path), keep the heartbeat alive —
+                # committing a sub-quorum buffer would let one surviving
+                # client steer the model during a partition
                 if self.redispatch:
                     self._redispatch_locked(
                         [r for r, v in self._outstanding.items()
@@ -460,6 +557,16 @@ class AsyncServerManager(ServerManager):
         if deadline_fired:
             self.partial_commits += 1
             self._m_deadline.inc()
+            if n_real < self.buffer_k:
+                # quorum-degraded: the round committed with fewer than
+                # a full buffer (partition / mass crash) — visible in
+                # the rollup, not silent
+                self.degraded_commits += 1
+                self._m_degraded.inc()
+        if self._ckpt is not None and (
+                self.version % self.checkpoint_every == 0
+                or self.version >= self.total_commits):
+            self._save_checkpoint_locked()
         if self.version >= self.total_commits:
             self.done.set()
             return True
@@ -514,6 +621,15 @@ class AsyncServerManager(ServerManager):
                 "async-ingest")
             self._pool.shutdown(wait=not on_worker)
         super().finish()
+        if self._ckpt is not None:
+            # release the orbax manager (its background machinery must
+            # not linger on a directory a resumed successor reopens)
+            try:
+                self._ckpt.close()
+            except Exception:
+                log.warning("checkpoint manager close failed",
+                            exc_info=True)
+            self._ckpt = None
 
 
 class AsyncClientManager(ClientManager):
@@ -526,9 +642,15 @@ class AsyncClientManager(ClientManager):
 
     def __init__(self, trainer, data, epochs: int, rank: int, size: int,
                  backend: str = "INPROC",
-                 lifecycle: Optional[ClientLifecycle] = None, **kw):
+                 lifecycle: Optional[ClientLifecycle] = None,
+                 reliable: bool = False, **kw):
         super().__init__(rank, size, backend, **kw)
         import jax
+        if reliable:
+            # enveloped uplinks: a server restart mid-upload is carried
+            # by the endpoint's backoff resend instead of an exception
+            # killing this client's handler thread
+            self.com_manager.enable_reliability()
         self.trainer = trainer
         self.data = data
         self.epochs = epochs
@@ -614,13 +736,20 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
                         staleness_a: float = 0.5, staleness_b: float = 4.0,
                         mix: float = 1.0, deadline_s: Optional[float] = None,
                         streaming: bool = True, ingest_pool: int = 0,
-                        decode_into: bool = True,
+                        decode_into: bool = True, reliable: bool = False,
+                        chaos=None, min_quorum: int = 1,
                         timeout_s: float = 600.0, **backend_kw):
     """Launch the async server + one lifecycle-simulated client per rank
     (threads for INPROC; for TCP/GRPC run one rank per process and call
     the managers directly).  Returns (variables, server) after
     `total_commits` commits.  A stall past `timeout_s` dumps the flight
-    recorder — the scheduler-deadlock artifact — before raising."""
+    recorder — the scheduler-deadlock artifact — before raising.
+
+    `reliable=True` envelopes every manager's transport (exactly-once
+    ingestion under retries/duplicates); `chaos` installs a
+    comm.chaos.ChaosPolicy on the SERVER's backend (uplink faults —
+    the torture direction); `min_quorum` gates deadline commits under
+    partition."""
     import jax
     import jax.numpy as jnp
     from fedml_tpu.comm.inproc import InProcRouter
@@ -645,9 +774,13 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
         staleness_mode=staleness_mode, staleness_a=staleness_a,
         staleness_b=staleness_b, mix=mix, deadline_s=deadline_s,
         streaming=streaming, ingest_pool=ingest_pool,
-        decode_into=decode_into, **kw)
+        decode_into=decode_into, reliable=reliable,
+        min_quorum=min_quorum, **kw)
+    if chaos is not None:
+        server.com_manager.install_chaos(chaos)
     clients = [AsyncClientManager(trainer, data, cfg.epochs, r, size,
-                                  backend, lifecycle=lifecycle, **kw)
+                                  backend, lifecycle=lifecycle,
+                                  reliable=reliable, **kw)
                for r in range(1, size)]
     threads = [c.run_async() for c in clients] + [server.run_async()]
     server.send_start()

@@ -450,9 +450,15 @@ class AsyncBuffer:
                             f"async buffer shape mismatch: checkpoint acc "
                             f"{acc.shape} vs configured ({self.p},) "
                             f"(model changed)")
-                    self.acc = jnp.asarray(acc)
-                    self.wsum = jnp.asarray(
-                        np.asarray(state["wsum"], np.float32))
+                    # copy=True, NOT asarray: on CPU jax may alias the
+                    # numpy/orbax buffer zero-copy, and the next add()
+                    # DONATES acc to the jitted fold — donating memory
+                    # jax does not own corrupts the heap (empirically: a
+                    # deferred glibc abort in a later commit on this
+                    # toolchain, surfaced by the crash-resume e2e)
+                    self.acc = jnp.array(acc, copy=True)
+                    self.wsum = jnp.array(
+                        np.asarray(state["wsum"], np.float32), copy=True)
                     self.raw_wsum = float(state.get(
                         "raw_wsum", float(np.sum(self.weights))))
                 elif "rows" in state:

@@ -37,7 +37,11 @@ from fedml_tpu import obs
 from fedml_tpu.obs import propagate
 from fedml_tpu.obs.metrics import quantile_from_cumulative
 from fedml_tpu.async_.lifecycle import AsyncMessage, AsyncServerManager
+from fedml_tpu.comm import reliability
+from fedml_tpu.comm.chaos import ChaosConfig, ChaosPolicy
 from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.comm.reliability import BackoffPolicy, ReliableEndpoint
+from fedml_tpu.comm.tcp_backend import _read_exact
 
 log = logging.getLogger(__name__)
 
@@ -122,6 +126,100 @@ def _inproc_client(backend, frame: bytes, stop: threading.Event):
         pass                               # manager finished mid-frame
 
 
+# ---------------------------------------------------------------------------
+# reliable client drivers (ISSUE 8) — window-limited uplink pushers that
+# speak the FMLR envelope: each send gets a fresh per-peer seq, acks
+# retire the window, losses/corruption resend on the backoff schedule
+# ---------------------------------------------------------------------------
+
+def _reliable_send_loop(ep: ReliableEndpoint, frame: bytes,
+                        stop: threading.Event, window: int):
+    while not stop.is_set():
+        if ep.pending() >= window:
+            time.sleep(0.0005)             # acks retire the window
+            continue
+        ep.send(0, frame)
+
+
+def _reliable_tcp_client(host: str, port: int, frame: bytes,
+                         stop: threading.Event, rank: int,
+                         backoff: Optional[BackoffPolicy], window: int):
+    s = socket.create_connection((host, port), timeout=30)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    slock = threading.Lock()
+
+    def send_raw(peer: int, wire: bytes) -> None:
+        with slock:
+            s.sendall(struct.pack("<Q", len(wire)))
+            s.sendall(wire)
+
+    ep = ReliableEndpoint(rank, send_raw, policy=backoff,
+                          name=f"torture-{rank}")
+
+    def reader():                          # acks ride the same socket
+        try:
+            while not stop.is_set():
+                (n,) = struct.unpack("<Q", _read_exact(s, 8))
+                ep.on_wire(_read_exact(s, n))
+        except (OSError, ConnectionError, struct.error):
+            pass                           # server closed
+
+    threading.Thread(target=reader, daemon=True).start()
+    try:
+        _reliable_send_loop(ep, frame, stop, window)
+    except OSError:
+        pass
+    finally:
+        ep.close()
+        s.close()
+
+
+def _reliable_grpc_client(host: str, port: int, frame: bytes,
+                          stop: threading.Event, rank: int,
+                          backoff: Optional[BackoffPolicy], window: int):
+    import grpc
+    from fedml_tpu.comm.grpc_backend import _METHOD, _OPTS
+    ch = grpc.insecure_channel(f"{host}:{port}", options=_OPTS)
+    stub = ch.unary_unary(_METHOD)
+
+    def send_raw(peer: int, wire: bytes) -> None:
+        # the unary response IS the reply channel: the server's ack or
+        # nack comes back as the RPC result
+        resp = stub(bytes(wire), timeout=60, wait_for_ready=True)
+        if resp and bytes(resp[:4]) == reliability.MAGIC:
+            ep.on_wire(resp)
+
+    ep = ReliableEndpoint(rank, send_raw, policy=backoff,
+                          name=f"torture-{rank}")
+    try:
+        _reliable_send_loop(ep, frame, stop, window)
+    except grpc.RpcError:
+        pass                               # server stopped
+    finally:
+        ep.close()
+        ch.close()
+
+
+def _reliable_inproc_client(backend, frame: bytes, stop: threading.Event,
+                            rank: int, backoff: Optional[BackoffPolicy],
+                            window: int):
+    def send_raw(peer: int, wire: bytes) -> None:
+        backend._obs_received(len(wire))
+        # reply routes the server's ack straight back into this
+        # client's endpoint — the in-memory twin of the TCP reverse
+        # channel
+        backend._deliver_frame(wire, reply=ep.on_wire)
+
+    ep = ReliableEndpoint(rank, send_raw, policy=backoff,
+                          name=f"torture-{rank}")
+    try:
+        _reliable_send_loop(ep, frame, stop, window)
+    except Exception:
+        pass                               # manager finished mid-frame
+    finally:
+        ep.close()
+
+
 # histogram-delta percentiles: the hand-rolled cumulative-bucket
 # interpolation this module used to carry moved into the ONE shared
 # definition, obs.metrics.quantile_from_cumulative (Histogram.quantile
@@ -138,7 +236,11 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
                        streaming: bool = True, base_port: int = 53200,
                        timeout_s: float = 300.0,
                        inbox_bound: Optional[int] = None,
-                       template: Optional[dict] = None) -> dict:
+                       template: Optional[dict] = None,
+                       reliable: bool = False,
+                       chaos: Optional[dict] = None, chaos_seed: int = 0,
+                       reliable_backoff: Optional[BackoffPolicy] = None,
+                       window: int = 4) -> dict:
     """Saturate one server with `n_clients` concurrent uplinks until
     `warmup_commits + commits` commits land; returns the ingestion
     report.  `streaming=False, ingest_pool=0, decode_into=False` is the
@@ -150,7 +252,17 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
     `commits` moderate).  `inbox_bound` bounds the inbox for sink-less
     (pool 0) configurations, blocking the recv threads when full so
     transport flow control backpressures the senders — the A/B's
-    queue-discipline isolation arm."""
+    queue-discipline isolation arm.
+
+    Chaos + reliability (ISSUE 8, `bench.py --mode chaos`):
+    `reliable=True` swaps the spam clients for window-limited FMLR
+    uplink pushers (per-seq envelopes, ack-retired windows, backoff
+    resend) and envelopes the server; `chaos` (a dict of
+    comm.chaos.ChaosConfig rates, e.g. {"drop": 0.05, "dup": 0.01,
+    "corrupt": 0.005}) installs a seeded injector on the server's
+    receive path.  The report then carries the injected-event rollup
+    plus retry/dedup/quarantine/recv-death counters — the
+    goodput-vs-fault-rate curve's raw material."""
     import jax
     import jax.numpy as jnp
 
@@ -184,12 +296,24 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
     lock_wait = obs.counter("async_lock_wait_seconds")
     recv = obs.counter("comm_received_bytes_total",
                        backend=backend.lower())
+    # robustness counters (ISSUE 8): deltas over the run feed the chaos
+    # report — process-wide totals (server endpoint + torture clients)
+    rob = {name: obs.counter(f"comm_{name}_total") for name in (
+        "reliable_retries", "reliable_acks",
+        "reliable_dups_suppressed", "frames_quarantined",
+        "reliable_abandoned", "recv_thread_deaths")}
+    rob0 = {k: c.value for k, c in rob.items()}
 
+    policy = None
+    if chaos:
+        policy = ChaosPolicy(ChaosConfig(seed=chaos_seed, **chaos))
     server = AsyncServerManager(
         template, total, buffer_k, 0, n_clients + 1, backend,
         staleness_mode="constant", mix=1.0, streaming=streaming,
         ingest_pool=ingest_pool, decode_into=decode_into,
-        redispatch=False, **kw)
+        redispatch=False, reliable=reliable, **kw)
+    if policy is not None:
+        server.com_manager.install_chaos(policy)
     if inbox_bound is not None and ingest_pool == 0:
         server.com_manager.bound_inbox(inbox_bound)
     server.run_async()
@@ -206,7 +330,23 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
                   pool=ingest_pool, decode_into=decode_into,
                   streaming=streaming):
         for r, frame in enumerate(frames, start=1):
-            if backend == "TCP":
+            if reliable:
+                if backend == "TCP":
+                    t = threading.Thread(
+                        target=_reliable_tcp_client,
+                        args=("127.0.0.1", base_port, frame, stop, r,
+                              reliable_backoff, window), daemon=True)
+                elif backend == "GRPC":
+                    t = threading.Thread(
+                        target=_reliable_grpc_client,
+                        args=("127.0.0.1", base_port, frame, stop, r,
+                              reliable_backoff, window), daemon=True)
+                else:
+                    t = threading.Thread(
+                        target=_reliable_inproc_client,
+                        args=(server.com_manager, frame, stop, r,
+                              reliable_backoff, window), daemon=True)
+            elif backend == "TCP":
                 t = threading.Thread(target=_tcp_client,
                                      args=("127.0.0.1", base_port, frame,
                                            stop), daemon=True)
@@ -294,6 +434,24 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
         "clients_alive_at_end": clients_alive,
         "staleness_p95": float(np.percentile(
             np.asarray(server.staleness_seen or [0.0]), 95)),
+        # ISSUE-8 robustness accounting: injected faults + what the
+        # reliability layer did about them (full-run deltas — faults
+        # during warmup count too; the goodput ratio compares arms
+        # under IDENTICAL accounting, so the window mismatch cancels)
+        "reliable": bool(reliable),
+        "chaos": dict(chaos) if chaos else None,
+        "chaos_injected": policy.summary() if policy is not None else None,
+        "retries": rob["reliable_retries"].value
+                   - rob0["reliable_retries"],
+        "acks": rob["reliable_acks"].value - rob0["reliable_acks"],
+        "dups_suppressed": rob["reliable_dups_suppressed"].value
+                           - rob0["reliable_dups_suppressed"],
+        "quarantined": rob["frames_quarantined"].value
+                       - rob0["frames_quarantined"],
+        "abandoned": rob["reliable_abandoned"].value
+                     - rob0["reliable_abandoned"],
+        "recv_thread_deaths": rob["recv_thread_deaths"].value
+                              - rob0["recv_thread_deaths"],
     }
     # the torture server's final variables must be finite — a NaN here
     # means the fold/commit math broke under concurrency

@@ -111,6 +111,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deployment mode: zlib-compress the wire "
                         "frame's header+small-array section (lossless; "
                         "wire codec v2)")
+    # chaos + reliability (ISSUE 8, comm/chaos.py + comm/reliability.py)
+    p.add_argument("--reliable", action="store_true",
+                   help="deployment mode: envelope frames with the "
+                        "reliability layer (per-peer seq + CRC32, "
+                        "ack/nack, backoff resend, duplicate "
+                        "suppression) — exactly-once ingestion over "
+                        "lossy links; FEDML_RELIABLE=0 force-disables "
+                        "it process-wide (the escape hatch)")
+    p.add_argument("--chaos_drop", type=float, default=0.0,
+                   help="deployment mode: P(inbound frame dropped) — "
+                        "seeded wire-level fault injection "
+                        "(comm/chaos.py); pair with --reliable to "
+                        "exercise the resend path")
+    p.add_argument("--chaos_dup", type=float, default=0.0,
+                   help="deployment mode: P(inbound frame duplicated)")
+    p.add_argument("--chaos_corrupt", type=float, default=0.0,
+                   help="deployment mode: P(inbound frame byte-flipped "
+                        "— quarantined + nacked under --reliable)")
+    p.add_argument("--chaos_delay", type=float, default=0.0,
+                   help="deployment mode: P(inbound frame delayed "
+                        "~exp(10ms))")
+    p.add_argument("--chaos_seed", type=int, default=0,
+                   help="fault-injection seed: same seed = same "
+                        "per-stream injected-event trace")
     # async federation (fedml_tpu/async_): buffered staleness-aware
     # commits over a seeded client-lifecycle simulator — FedBuff-style
     # semi-async (commit on K buffered results or a deadline), FedAsync
@@ -735,6 +759,20 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
     ip_config = {r: "127.0.0.1" for r in range(size)}
     kw = dict(ip_config=ip_config, base_port=args.base_port)
 
+    def _harden(manager) -> None:
+        """ISSUE 8: opt this rank's transport into the reliability
+        envelope and/or install the seeded fault injector — both
+        CLI-driven so robustness scenarios are a flag, not a code
+        edit."""
+        if args.reliable:
+            manager.com_manager.enable_reliability()
+        rates = {k: getattr(args, f"chaos_{k}")
+                 for k in ("drop", "dup", "corrupt", "delay")}
+        if any(v > 0.0 for v in rates.values()):
+            from fedml_tpu.comm.chaos import ChaosConfig, ChaosPolicy
+            manager.com_manager.install_chaos(
+                ChaosPolicy(ChaosConfig(seed=args.chaos_seed, **rates)))
+
     from fedml_tpu.utils.context import graceful_abort
 
     if args.deploy == "server":
@@ -748,6 +786,7 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
             model_transport=(None if args.wire_transport == "none"
                              else args.wire_transport),
             wire_compress=args.wire_compress, **kw)
+        _harden(server)
         with graceful_abort(server):
             server.run_async()
             server.send_init_msg()
@@ -769,6 +808,7 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
                                  args.comm_backend,
                                  total_rounds=cfg.comm_round,
                                  wire_compress=args.wire_compress, **kw)
+    _harden(client)
     with graceful_abort(client):
         client.run()        # blocks until total_rounds uploads are done
     return 0

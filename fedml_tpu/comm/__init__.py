@@ -20,5 +20,7 @@ Differences from the reference, by design:
 """
 from fedml_tpu.comm.message import Message, MessageCodec
 from fedml_tpu.comm.base import BaseCommManager, Observer
+from fedml_tpu.comm.chaos import ChaosConfig, ChaosPolicy
 from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
 from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.reliability import BackoffPolicy, ReliableEndpoint

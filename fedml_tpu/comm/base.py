@@ -9,12 +9,16 @@ get instead of the reference's 0.3 s polling loop
 from __future__ import annotations
 
 import abc
+import logging
 import queue
 import time
 
 from fedml_tpu import obs
 from fedml_tpu.obs import propagate
+from fedml_tpu.comm import reliability
 from fedml_tpu.comm.message import Message, MessageCodec
+
+log = logging.getLogger(__name__)
 
 
 class Observer(abc.ABC):
@@ -41,6 +45,11 @@ class BaseCommManager(abc.ABC):
     # (broker JSON, no-encode inproc) must override with False so ingest
     # pools fall back to inline decode instead of idling silently
     supports_frame_sink = True
+    # True when the backend can carry the reliability envelope (raw
+    # binary frames + a way to push acks back): MQTT speaks broker JSON
+    # (the broker's QoS is its reliability story) and a no-encode inproc
+    # router never materializes frames — both override with False
+    supports_reliability = True
 
     def __init__(self):
         self._observers: list[Observer] = []
@@ -48,6 +57,9 @@ class BaseCommManager(abc.ABC):
         self._running = False
         self._draining = False
         self._frame_sink = None
+        self._chaos = None              # ChaosPolicy (install_chaos)
+        self._rel_ep = None             # lazy ReliableEndpoint
+        self._reliable_tx = False       # sends are enveloped when True
         b = self.backend_name
         self._m_sent_msgs = obs.counter("comm_sent_messages_total",
                                         backend=b)
@@ -57,6 +69,14 @@ class BaseCommManager(abc.ABC):
         self._m_recv_bytes = obs.counter("comm_received_bytes_total",
                                          backend=b)
         self._m_retries = obs.counter("comm_retries_total", backend=b)
+        # robustness accounting (ISSUE 8): frames dropped at the bounded
+        # inbox during shutdown drain, frames quarantined instead of
+        # killing a recv thread, and recv threads that DID die (the
+        # chaos acceptance gate demands this stays 0)
+        self._m_dropped = obs.counter("comm_frames_dropped_total",
+                                      backend=b)
+        self._m_quarantined = obs.counter("comm_frames_quarantined_total")
+        self._m_recv_deaths = obs.counter("comm_recv_thread_deaths_total")
         self._m_decode_seconds = obs.histogram(
             "comm_decode_seconds",
             buckets=obs.metrics.DECODE_SECONDS_BUCKETS, backend=b)
@@ -77,15 +97,91 @@ class BaseCommManager(abc.ABC):
     def _obs_retry(self) -> None:
         self._m_retries.inc()
 
+    # -- chaos + reliability (ISSUE 8) ---------------------------------------
+    def install_chaos(self, policy) -> None:
+        """Install a seeded fault injector (comm/chaos.py) at this
+        backend's two frame chokepoints: the send gate in _stamp_frame
+        and the raw-frame receive path in _deliver_frame.  One policy
+        may be shared across backends."""
+        if not self.supports_frame_sink and self.backend_name != "mqtt":
+            # a no-encode inproc router hands Message objects across —
+            # frames never exist, so wire-level faults cannot apply
+            log.warning(
+                "chaos installed on %s, but this backend never "
+                "materializes wire frames — only the send gate "
+                "(partition/drop/delay) applies", self.backend_name)
+        self._chaos = policy
+
+    def enable_reliability(self, policy=None) -> bool:
+        """Opt this backend's SENDS into the reliability envelope
+        (comm/reliability.py): per-peer seq + CRC32, ack/nack, backoff
+        resend.  Receives always unwrap envelopes regardless (mixed
+        deployments interoperate).  Returns False — and stays on the
+        byte-identical pre-PR wire — under the FEDML_RELIABLE=0 escape
+        hatch or on backends that can't carry the envelope."""
+        if reliability.escape_hatch_off():
+            log.info(
+                "FEDML_RELIABLE=0: reliability envelope disabled on %s",
+                self.backend_name)
+            return False
+        if not self.supports_reliability:
+            log.warning(
+                "reliability requested on %s, which cannot carry the "
+                "envelope (broker JSON / no-encode router) — sends stay "
+                "fire-and-forget", self.backend_name)
+            return False
+        self._reliability_endpoint(policy)
+        self._reliable_tx = True
+        return True
+
+    def _reliability_endpoint(self, policy=None):
+        """Lazy per-backend ReliableEndpoint — created on enable, or on
+        the first inbound FMLR frame from an enveloping peer (so acks
+        and the dedup ledger work even when this side's own sends are
+        plain)."""
+        if self._rel_ep is None:
+            self._rel_ep = reliability.ReliableEndpoint(
+                getattr(self, "rank", 0), self._raw_send, policy=policy,
+                name=self.backend_name)
+        return self._rel_ep
+
+    def _raw_send(self, receiver: int, wire: bytes) -> None:
+        """Raw wire write of pre-assembled bytes to a peer — the resend
+        thread's and the ack path's transmit primitive.  Codec-framed
+        backends override; the base refuses (MQTT / no-encode inproc
+        never carry envelopes)."""
+        raise NotImplementedError(
+            f"{self.backend_name} has no raw-frame send path")
+
+    def _chaos_disconnect(self, msg: Message) -> bool:
+        """Backend hook for the disconnect-mid-frame fault: transmit a
+        deliberately torn frame and kill the connection (TCP overrides).
+        Returns False when unsupported — the gate degrades the fault to
+        a drop."""
+        return False
+
     # -- federation-wide tracing (ISSUE 7) -----------------------------------
-    def _stamp_frame(self, msg: Message) -> None:
-        """Outbound chokepoint twin of `_deliver_frame`: attach the
-        compact trace block (sender rank, send timestamps, span digest,
-        clock echo) BEFORE encode.  Every concrete backend calls this
-        first in `send_message`.  With tracing disabled nothing is
-        added — frames stay byte-identical to the untraced build
-        (pinned in tests/test_wire_codec.py)."""
+    def _stamp_frame(self, msg: Message) -> bool:
+        """Outbound chokepoint twin of `_deliver_frame`: the chaos send
+        gate (partition / per-peer drop / delay / disconnect-mid-frame),
+        then the compact trace block (sender rank, send timestamps,
+        span digest, clock echo) BEFORE encode.  Every concrete backend
+        calls this first in `send_message` and returns without sending
+        when it yields False.  With tracing disabled nothing is added —
+        frames stay byte-identical to the untraced build (pinned in
+        tests/test_wire_codec.py)."""
+        chaos = self._chaos
+        if chaos is not None:
+            act, delay = chaos.plan_send(msg.get_receiver_id())
+            if act in ("drop", "partition"):
+                return False
+            if act == "delay":
+                time.sleep(min(delay, 1.0))
+            elif act == "disconnect":
+                self._chaos_disconnect(msg)
+                return False        # the frame died mid-wire either way
         propagate.stamp(msg, getattr(self, "rank", 0), clock=self._clock)
+        return True
 
     def _note_frame(self, msg: Message) -> None:
         """Strip + account the trace block / piggybacked metrics delta
@@ -115,6 +211,8 @@ class BaseCommManager(abc.ABC):
     def stop_receive_message(self) -> None:
         self._running = False
         self._draining = True   # release recv threads blocked in put()
+        if self._rel_ep is not None:
+            self._rel_ep.close()           # stop the resend thread
         try:
             self._inbox.put_nowait(None)   # wake a get() blocked on empty
         except queue.Full:
@@ -141,11 +239,29 @@ class BaseCommManager(abc.ABC):
         recv loop stalls, and flow control propagates to the sender."""
         self._frame_sink = sink
 
-    def _deliver_frame(self, payload) -> None:
+    def _deliver_frame(self, payload, reply=None) -> None:
         """Inbound raw-frame chokepoint shared by every codec-framed
-        backend: route to the frame sink when one is installed,
-        otherwise decode inline (timed into comm_decode_seconds) and
-        enqueue for the dispatch loop."""
+        backend: chaos receive faults first (drop/dup/reorder/delay/
+        corrupt on the raw bytes), then per surviving frame the
+        reliability envelope (CRC quarantine, dedup ledger, ack via
+        `reply` — the transport's reverse channel — or _raw_send), then
+        the frame sink when one is installed, otherwise inline decode
+        (timed into comm_decode_seconds) and the dispatch queue.  A
+        frame the codec rejects is QUARANTINED (counted + logged), never
+        an exception up the recv thread."""
+        chaos = self._chaos
+        if chaos is not None:
+            for p in chaos.filter_recv(payload):
+                self._deliver_one(p, reply)
+            return
+        self._deliver_one(payload, reply)
+
+    def _deliver_one(self, payload, reply=None) -> None:
+        if bytes(payload[:4]) == reliability.MAGIC:
+            payload = self._reliability_endpoint().on_wire(payload,
+                                                           reply=reply)
+            if payload is None:
+                return              # ack/nack, suppressed dup, quarantine
         sink = self._frame_sink
         if sink is not None:
             msg = sink(payload)
@@ -154,9 +270,18 @@ class BaseCommManager(abc.ABC):
             self._note_frame(msg)   # idempotent (note pops the params)
         else:
             t0 = time.perf_counter()
-            with obs.span("comm.decode", backend=self.backend_name,
-                          nbytes=len(payload)):
-                msg = MessageCodec.decode(payload)
+            try:
+                with obs.span("comm.decode", backend=self.backend_name,
+                              nbytes=len(payload)):
+                    msg = MessageCodec.decode(payload)
+            except Exception as e:
+                # corrupt/alien frame with no envelope to nack through:
+                # quarantine instead of killing the recv thread
+                self._m_quarantined.inc()
+                log.warning(
+                    "%s: undecodable frame (%d bytes) quarantined: %s",
+                    self.backend_name, len(payload), e)
+                return
             self._m_decode_seconds.observe(time.perf_counter() - t0)
             self._note_frame(msg)
         self._on_message(msg)
@@ -173,7 +298,11 @@ class BaseCommManager(abc.ABC):
                     return
                 except queue.Full:
                     continue
-            return                          # shutting down: drop the frame
+            # shutting down: drop the frame — COUNTED, so the rollup
+            # shows how much shutdown loss the drain swallowed instead
+            # of it vanishing silently (ISSUE-8 satellite)
+            self._m_dropped.inc()
+            return
         self._inbox.put(msg)
 
     def _notify(self, msg: Message) -> None:

@@ -19,15 +19,25 @@ from __future__ import annotations
 
 import csv
 import logging
+import os
+import time
 from concurrent import futures
-from typing import Union
+from typing import Optional, Union
 
 import grpc
 
+from fedml_tpu.comm import reliability
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.comm.reliability import BackoffPolicy
 
 log = logging.getLogger(__name__)
+
+# per-send RPC deadline: the old hard-coded timeout=1800 with no retry
+# (ISSUE-8 satellite) — now a constructor knob with an env override for
+# deployments that can't touch the construction site
+ENV_SEND_TIMEOUT = "FEDML_GRPC_TIMEOUT_S"
+DEFAULT_SEND_TIMEOUT_S = 1800.0
 
 _SERVICE = "fedml_tpu.Comm"
 _METHOD = f"/{_SERVICE}/SendMessage"
@@ -54,11 +64,23 @@ class GrpcBackend(BaseCommManager):
     backend_name = "grpc"
 
     def __init__(self, rank: int, ip_config: Union[str, dict],
-                 base_port: int = 50000, max_workers: int = 8):
+                 base_port: int = 50000, max_workers: int = 8,
+                 send_timeout_s: Optional[float] = None,
+                 send_backoff: Optional[BackoffPolicy] = None):
         super().__init__()
         self.rank = rank
         self.ip_config = load_ip_config(ip_config)
         self.base_port = base_port
+        env_t = os.environ.get(ENV_SEND_TIMEOUT)
+        self.send_timeout_s = float(
+            send_timeout_s if send_timeout_s is not None
+            else (env_t if env_t else DEFAULT_SEND_TIMEOUT_S))
+        # transient-failure retry for plain (non-enveloped) sends —
+        # drawn from the same BackoffPolicy the reliability layer and
+        # the TCP/native connect loops use, not another ad-hoc sleep
+        self.send_backoff = send_backoff if send_backoff is not None \
+            else BackoffPolicy(base_s=0.5, mult=2.0, max_s=8.0,
+                               jitter=0.25, max_attempts=4)
         self._channels: dict[int, grpc.Channel] = {}
         self._stubs: dict[int, grpc.UnaryUnaryMultiCallable] = {}
 
@@ -66,9 +88,17 @@ class GrpcBackend(BaseCommManager):
             self._obs_received(len(request))
             # _deliver_frame: inline decode or the async ingest sink;
             # a blocked sink holds this servicer thread, so gRPC's
-            # bounded executor is the backpressure
-            self._deliver_frame(request)
-            return b"ok"
+            # bounded executor is the backpressure.  The unary RESPONSE
+            # is the reliability reply channel: when the frame carried
+            # the FMLR envelope, the ack/nack rides back as the RPC
+            # result instead of b"ok".
+            out: list[bytes] = []
+            try:
+                self._deliver_frame(request, reply=out.append)
+            except Exception:
+                self._m_recv_deaths.inc()
+                log.exception("grpc servicer died on an unexpected error")
+            return out[0] if out else b"ok"
 
         handler = grpc.method_handlers_generic_handler(_SERVICE, {
             "SendMessage": grpc.unary_unary_rpc_method_handler(handle),
@@ -91,15 +121,44 @@ class GrpcBackend(BaseCommManager):
             self._stubs[receiver] = ch.unary_unary(_METHOD)
         return self._stubs[receiver]
 
+    def _raw_send(self, receiver: int, wire: bytes) -> None:
+        """Raw transmit for the reliability layer; the unary response
+        carries the peer's ack/nack, fed straight back into the
+        endpoint (so a successful RPC usually clears the outstanding
+        entry synchronously)."""
+        resp = self._stub(receiver)(bytes(wire),
+                                    timeout=self.send_timeout_s,
+                                    wait_for_ready=True)
+        if resp and bytes(resp[:4]) == reliability.MAGIC:
+            self._reliability_endpoint().on_wire(resp)
+
     def send_message(self, msg: Message) -> None:
         # encode applies the v2 wire features (transport dtypes, zlib
         # head); gRPC's unary call needs the one contiguous frame
-        self._stamp_frame(msg)      # trace block (no-op when obs is off)
+        if not self._stamp_frame(msg):
+            return                  # chaos send gate dropped the frame
         payload = MessageCodec.encode(msg)
+        rx = msg.get_receiver_id()
+        if self._reliable_tx:
+            wire = self._reliability_endpoint().send(rx, payload)
+            self._obs_sent(len(wire))
+            return
         # wait_for_ready rides out the multi-process startup race (peer's
-        # server not bound yet) instead of failing UNAVAILABLE immediately
-        self._stub(msg.get_receiver_id())(payload, timeout=1800,
-                                          wait_for_ready=True)
+        # server not bound yet) instead of failing UNAVAILABLE immediately;
+        # transient RpcErrors retry on the shared backoff schedule
+        # (ISSUE-8 satellite: was a hard-coded timeout=1800, no retry)
+        attempt = 0
+        while True:
+            try:
+                self._stub(rx)(payload, timeout=self.send_timeout_s,
+                               wait_for_ready=True)
+                break
+            except grpc.RpcError:
+                attempt += 1
+                if attempt >= self.send_backoff.max_attempts:
+                    raise
+                self._obs_retry()
+                time.sleep(self.send_backoff.delay(attempt))
         self._obs_sent(len(payload))
 
     def close(self) -> None:

@@ -27,6 +27,17 @@ class InProcRouter:
         with self._lock:
             self._backends[rank] = backend
 
+    def deliver_raw(self, rank: int, wire: bytes) -> None:
+        """Raw-frame delivery (the reliability layer's resends/acks):
+        the pre-assembled wire bytes go straight through the receiver's
+        _deliver_frame chokepoint, same as an encoded route()."""
+        with self._lock:
+            dst = self._backends.get(rank)
+        if dst is None:
+            raise KeyError(f"no backend registered for rank {rank}")
+        dst._obs_received(len(wire))
+        dst._deliver_frame(wire)
+
     def route(self, msg: Message) -> int:
         """Deliver; returns the encoded frame size (0 when encode=False
         skips the codec) so both endpoints' byte counters agree."""
@@ -71,6 +82,22 @@ class InProcBackend(BaseCommManager):
         # frames never exist, so a sink would never fire
         return bool(self.router.encode)
 
+    @property
+    def supports_reliability(self) -> bool:
+        # same constraint: the envelope wraps wire frames, which a
+        # no-encode router never materializes
+        return bool(self.router.encode)
+
+    def _raw_send(self, receiver: int, wire: bytes) -> None:
+        self.router.deliver_raw(receiver, wire)
+
     def send_message(self, msg: Message) -> None:
-        self._stamp_frame(msg)      # trace block (no-op when obs is off)
+        if not self._stamp_frame(msg):
+            return                  # chaos send gate dropped the frame
+        if self._reliable_tx:
+            payload = MessageCodec.encode(msg)
+            wire = self._reliability_endpoint().send(
+                msg.get_receiver_id(), payload)
+            self._obs_sent(len(wire))
+            return
         self._obs_sent(self.router.route(msg))

@@ -38,7 +38,9 @@ def _build_backend(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
     if b == "GRPC":
         from fedml_tpu.comm.grpc_backend import GrpcBackend
         return GrpcBackend(rank, kw["ip_config"],
-                           base_port=kw.get("base_port", 50000))
+                           base_port=kw.get("base_port", 50000),
+                           send_timeout_s=kw.get("send_timeout_s"),
+                           send_backoff=kw.get("send_backoff"))
     if b == "NATIVE_TCP":
         # explicit selection may compile the library on first use
         from fedml_tpu.comm.native_tcp import NativeTcpBackend

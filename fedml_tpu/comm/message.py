@@ -383,6 +383,15 @@ class MessageCodec:
             hoff, flags = 4, 0
         elif magic == cls.MAGIC_V2:
             hoff, flags = 5, payload[4]
+        elif magic == b"FMLR":
+            # a reliability envelope (comm/reliability.py) reached the
+            # codec un-unwrapped — the receive chokepoint normally
+            # strips it; name the layer so the misroute is debuggable
+            raise ValueError(
+                "bad frame magic b'FMLR': reliability envelope not "
+                "unwrapped (route the frame through "
+                "BaseCommManager._deliver_frame or "
+                "ReliableEndpoint.on_wire before decode)")
         else:
             raise ValueError(f"bad frame magic {magic!r} (expected "
                              f"{cls.MAGIC!r} or {cls.MAGIC_V2!r})")

@@ -28,6 +28,7 @@ _TOPIC_C2S = "fedml_"      # client <id> → server
 class MqttBackend(BaseCommManager):
     backend_name = "mqtt"
     supports_frame_sink = False      # broker path speaks decoded JSON
+    supports_reliability = False     # the broker's QoS is its ack story
 
     def __init__(self, rank: int, size: int, host: str = "127.0.0.1",
                  port: int = 1883, keepalive: int = 180,
@@ -75,23 +76,39 @@ class MqttBackend(BaseCommManager):
 
     def _on_mqtt_message(self, client, userdata, m) -> None:
         self._obs_received(len(m.payload))
-        payload = m.payload
-        t0 = time.perf_counter()
-        if payload[:4] == self._ZMAGIC:
-            payload = zlib.decompress(payload[4:])
-        msg = Message.from_json(payload.decode())
-        # the broker path speaks JSON, not the binary frame, so its
-        # deserialize cost lands in the same comm_decode_seconds
-        # histogram the codec-framed backends feed (comm/base.py)
-        self._m_decode_seconds.observe(time.perf_counter() - t0)
-        self._note_frame(msg)       # trace block rides the JSON too
-        self._on_message(msg)
+        # chaos injection (ISSUE 8): the broker path never reaches
+        # _deliver_frame, so the injector's receive faults apply to the
+        # JSON payload bytes right here — the same one-policy torture
+        # the codec-framed backends get
+        chaos = self._chaos
+        payloads = (chaos.filter_recv(m.payload) if chaos is not None
+                    else (m.payload,))
+        for payload in payloads:
+            t0 = time.perf_counter()
+            try:
+                if payload[:4] == self._ZMAGIC:
+                    payload = zlib.decompress(payload[4:])
+                msg = Message.from_json(payload.decode())
+            except Exception as e:
+                # corrupt broker payload: quarantine (metric + log),
+                # never kill paho's network thread
+                self._m_quarantined.inc()
+                log.warning("mqtt: undecodable payload (%d bytes) "
+                            "quarantined: %s", len(payload), e)
+                continue
+            # the broker path speaks JSON, not the binary frame, so its
+            # deserialize cost lands in the same comm_decode_seconds
+            # histogram the codec-framed backends feed (comm/base.py)
+            self._m_decode_seconds.observe(time.perf_counter() - t0)
+            self._note_frame(msg)   # trace block rides the JSON too
+            self._on_message(msg)
 
     def send_message(self, msg: Message) -> None:
         receiver = msg.get_receiver_id()
         topic = (_TOPIC_S2C + str(receiver) if self.rank == 0
                  else _TOPIC_C2S + str(self.rank))
-        self._stamp_frame(msg)      # trace block (no-op when obs is off)
+        if not self._stamp_frame(msg):
+            return                  # chaos send gate dropped the frame
         payload = msg.to_json().encode("utf-8")
         if getattr(msg, "wire_compress", False):
             # nested-list JSON weights compress hard (repeated digits);

@@ -21,9 +21,15 @@ from typing import Union
 
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.comm.reliability import BackoffPolicy
 from fedml_tpu.native import load_library
 
 log = logging.getLogger(__name__)
+
+# launch-race connect retry — the shared backoff schedule (ISSUE 8),
+# bounded by the caller's retry_for deadline
+_CONNECT_BACKOFF = BackoffPolicy(base_s=0.2, mult=1.5, max_s=2.0,
+                                 jitter=0.2, max_attempts=1_000_000)
 
 
 def native_available() -> bool:
@@ -71,6 +77,11 @@ class NativeTcpBackend(BaseCommManager):
                 # inline decode or the async ingest sink (comm/base.py)
                 self._deliver_frame(payload)
             except Exception:     # malformed frame: drop, keep serving
+                # _deliver_frame quarantines codec errors itself now;
+                # anything that still lands here is an unexpected
+                # delivery-path failure — counted like a thread death
+                # would be (the loop survives, the signal must not hide)
+                self._m_recv_deaths.inc()
                 log.exception("undecodable frame (%d bytes)", length.value)
 
     def _connect_locked(self, receiver: int, retry_for: float = 30.0):
@@ -82,6 +93,7 @@ class NativeTcpBackend(BaseCommManager):
             # because this transport serializes sends by design (see
             # send_message) and the race only exists at launch.
             deadline = time.monotonic() + retry_for
+            attempt = 0
             while True:
                 c = self._lib.fh_connect(host, self.base_port + receiver)
                 if c:
@@ -92,20 +104,14 @@ class NativeTcpBackend(BaseCommManager):
                         f"{self.ip_config[receiver]}:"
                         f"{self.base_port + receiver}")
                 self._obs_retry()
-                time.sleep(0.2)
+                attempt += 1
+                time.sleep(_CONNECT_BACKOFF.delay(attempt))
             self._conns[receiver] = c
         return c
 
-    def send_message(self, msg: Message) -> None:
-        # encode applies the v2 wire features (transport dtypes, zlib
-        # head); fh_send frames one contiguous buffer, so the chunked
-        # send stays a pure-Python-TCP feature
-        self._stamp_frame(msg)      # trace block (no-op when obs is off)
-        payload = MessageCodec.encode(msg)
-        rx = msg.get_receiver_id()
-        # the whole connect+send (and the dead-connection retry) runs under
-        # _conn_lock, like the pure-Python spec's sendall — so a failing
-        # sender can never fh_conn_close a handle another thread is using
+    def _send_wire_locked_retry(self, rx: int, payload: bytes) -> None:
+        """connect + fh_send with the one-shot stale-handle retry, all
+        under _conn_lock (see send_message)."""
         with self._conn_lock:
             conn = self._connect_locked(rx)
             if self._lib.fh_send(conn, payload, len(payload)) != 0:
@@ -116,6 +122,29 @@ class NativeTcpBackend(BaseCommManager):
                 conn = self._connect_locked(rx)
                 if self._lib.fh_send(conn, payload, len(payload)) != 0:
                     raise ConnectionError(f"send to rank {rx} failed")
+
+    def _raw_send(self, receiver: int, wire: bytes) -> None:
+        """Reliability transmit primitive: every native peer listens, so
+        acks/resends dial the peer's own server (there is no in-band
+        reply channel in the fh_* API)."""
+        self._send_wire_locked_retry(receiver, bytes(wire))
+
+    def send_message(self, msg: Message) -> None:
+        # encode applies the v2 wire features (transport dtypes, zlib
+        # head); fh_send frames one contiguous buffer, so the chunked
+        # send stays a pure-Python-TCP feature
+        if not self._stamp_frame(msg):
+            return                  # chaos send gate dropped the frame
+        payload = MessageCodec.encode(msg)
+        rx = msg.get_receiver_id()
+        if self._reliable_tx:
+            wire = self._reliability_endpoint().send(rx, payload)
+            self._obs_sent(len(wire))
+            return
+        # the whole connect+send (and the dead-connection retry) runs under
+        # _conn_lock, like the pure-Python spec's sendall — so a failing
+        # sender can never fh_conn_close a handle another thread is using
+        self._send_wire_locked_retry(rx, payload)
         self._obs_sent(len(payload))
 
     def close(self) -> None:

@@ -8,6 +8,16 @@ MessageCodec bytes.
 When the native C++ transport (fedml_tpu/native/) is built, `TcpBackend`
 transparently uses it for the socket loop; this pure-Python path is the
 fallback and the behavioral spec.
+
+Reliability (ISSUE 8): with `enable_reliability()` the frame rides the
+FMLR envelope and acks flow back over the SAME connection the data
+arrived on (`_recv_loop` hands `_deliver_frame` a reply callable) — so a
+client that only dials out still gets its acks; outbound connections
+additionally get a reader thread so dial-out acks for OUR enveloped
+sends are seen too.  Resends reuse `_raw_send`, which invalidates the
+cached connection on failure and redials — a server restart (the
+crash-resume scenario) is survived by the backoff schedule, not by the
+caller.
 """
 from __future__ import annotations
 
@@ -20,8 +30,15 @@ from typing import Union
 
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.comm.reliability import BackoffPolicy
 
 log = logging.getLogger(__name__)
+
+# THE connect-retry schedule (replaces the ad-hoc 0.2 s sleep loop):
+# effectively unbounded attempts — the caller's retry_for deadline is
+# the bound, the policy only shapes the delays
+_CONNECT_BACKOFF = BackoffPolicy(base_s=0.2, mult=1.5, max_s=2.0,
+                                 jitter=0.2, max_attempts=1_000_000)
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -46,6 +63,11 @@ class TcpBackend(BaseCommManager):
         self.base_port = base_port
         self._conns: dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
+        # accepted (inbound) connections, closed on close(): leaving
+        # them established would hold the listen port hostage against a
+        # same-port restart — the crash-resume rebind — and leave peers
+        # talking into a half-dead socket
+        self._accepted: set[socket.socket] = set()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("0.0.0.0", base_port + rank))
@@ -61,10 +83,22 @@ class TcpBackend(BaseCommManager):
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            with self._conn_lock:
+                self._accepted.add(conn)
             threading.Thread(target=self._recv_loop, args=(conn,),
                              daemon=True).start()
 
     def _recv_loop(self, conn: socket.socket) -> None:
+        # reply channel: acks/nacks ride back over the connection the
+        # frame came in on — the only route to a peer that never
+        # listens (the torture spam clients)
+        wlock = threading.Lock()
+
+        def reply(wire: bytes) -> None:
+            with wlock:
+                conn.sendall(struct.pack("<Q", len(wire)))
+                conn.sendall(wire)
+
         try:
             while self._alive:
                 (length,) = struct.unpack("<Q", _read_exact(conn, 8))
@@ -74,9 +108,19 @@ class TcpBackend(BaseCommManager):
                 # to an installed ingest sink (async decode pool) — a
                 # blocked sink stalls this loop and TCP flow control
                 # backpressures the sender
-                self._deliver_frame(payload)
+                self._deliver_frame(payload, reply=reply)
         except (ConnectionError, OSError):
             conn.close()
+        except Exception:
+            # the chaos acceptance gate: NOTHING that escapes the
+            # delivery path may silently kill a recv thread — count it
+            # so "zero recv-thread deaths" is assertable
+            self._m_recv_deaths.inc()
+            log.exception("tcp recv loop died on an unexpected error")
+            conn.close()
+        finally:
+            with self._conn_lock:
+                self._accepted.discard(conn)
 
     def _connect(self, receiver: int, retry_for: float = 60.0) -> socket.socket:
         with self._conn_lock:
@@ -85,20 +129,26 @@ class TcpBackend(BaseCommManager):
             return s
         # multi-process launches race: the peer's listener may not be bound
         # yet (run_fedavg_grpc.sh starts all ranks at once), so refused
-        # connections retry with backoff — OUTSIDE the lock, so one slow
-        # peer cannot stall sends to the others (or close())
+        # connections retry on the shared backoff schedule — OUTSIDE the
+        # lock, so one slow peer cannot stall sends to the others (or
+        # close())
         deadline = time.monotonic() + retry_for
+        attempt = 0
         while True:
             try:
                 s = socket.create_connection(
                     (self.ip_config[receiver], self.base_port + receiver),
                     timeout=30)
                 break
-            except ConnectionRefusedError:
+            except (ConnectionRefusedError, ConnectionResetError,
+                    TimeoutError):
+                # transient launch/restart races only — a gaierror
+                # (typo'd host) must fail fast, not burn the deadline
                 if time.monotonic() >= deadline:
                     raise
                 self._obs_retry()
-                time.sleep(0.2)
+                attempt += 1
+                time.sleep(_CONNECT_BACKOFF.delay(attempt))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         with self._conn_lock:
             racer = self._conns.get(receiver)
@@ -106,7 +156,54 @@ class TcpBackend(BaseCommManager):
                 s.close()
                 return racer
             self._conns[receiver] = s
+        if self._reliable_tx:
+            # dial-out connections need a reader: the peer's acks for
+            # our enveloped frames come back over this socket
+            threading.Thread(target=self._recv_loop, args=(s,),
+                             daemon=True).start()
         return s
+
+    def _raw_send(self, receiver: int, wire: bytes) -> None:
+        """Raw framed write (reliability resends + acks).  A transport
+        failure invalidates the cached connection — the NEXT attempt
+        redials, which is how a restarted peer (crash-resume) is
+        rejoined — and re-raises for the resend scheduler."""
+        sock = self._connect(receiver, retry_for=5.0)
+        try:
+            with self._conn_lock:
+                sock.sendall(struct.pack("<Q", len(wire)))
+                sock.sendall(wire)
+        except OSError:
+            with self._conn_lock:
+                if self._conns.get(receiver) is sock:
+                    self._conns.pop(receiver, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+
+    def _chaos_disconnect(self, msg: Message) -> bool:
+        """Disconnect-mid-frame fault: send the length prefix plus HALF
+        the frame, then hard-close the connection.  The receiver's
+        _read_exact dies with ConnectionError (that conn only) and the
+        next real send redials — the torn-wire case the reliability
+        resend exists for, so under the envelope the frame is registered
+        first and recovers."""
+        rx = msg.get_receiver_id()
+        payload = MessageCodec.encode(msg)
+        if self._reliable_tx:
+            payload = self._reliability_endpoint().wrap(rx, payload)
+        try:
+            sock = self._connect(rx, retry_for=5.0)
+            with self._conn_lock:
+                sock.sendall(struct.pack("<Q", len(payload)))
+                sock.sendall(payload[:max(1, len(payload) // 2)])
+                self._conns.pop(rx, None)
+            sock.close()
+        except OSError:
+            pass                     # the fault IS a broken connection
+        return True
 
     def send_message(self, msg: Message) -> None:
         # chunked streaming send: the codec hands back a frame prefix +
@@ -115,9 +212,19 @@ class TcpBackend(BaseCommManager):
         # contiguous buffer (the old encode() + concat path transiently
         # held ~3x the payload: arrays + BytesIO + the length-prefixed
         # copy)
-        self._stamp_frame(msg)      # trace block (no-op when obs is off)
+        if not self._stamp_frame(msg):
+            return                   # chaos send gate dropped the frame
+        rx = msg.get_receiver_id()
+        if self._reliable_tx:
+            # the envelope needs the whole frame (CRC + resend buffer),
+            # so the reliable path joins the parts; first transmit +
+            # retries live in the endpoint
+            payload = MessageCodec.encode(msg)
+            wire = self._reliability_endpoint().send(rx, payload)
+            self._obs_sent(len(wire))
+            return
         total, parts = MessageCodec.encode_parts(msg)
-        sock = self._connect(msg.get_receiver_id())
+        sock = self._connect(rx)
         with self._conn_lock:
             sock.sendall(struct.pack("<Q", total))
             for part in parts:
@@ -126,8 +233,23 @@ class TcpBackend(BaseCommManager):
 
     def close(self) -> None:
         self._alive = False
+        # shutdown BEFORE close: close() alone does not interrupt the
+        # accept(2) the _accept_loop thread is blocked in, and the
+        # in-flight syscall keeps the kernel socket alive and LISTENING
+        # — which held the port hostage against a same-port restart
+        # (the crash-resume rebind) even with the fd closed
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass                    # never listened / already dead
         self._listener.close()
         with self._conn_lock:
             for s in self._conns.values():
                 s.close()
             self._conns.clear()
+            for s in list(self._accepted):
+                try:
+                    s.close()       # releases the listen port for a
+                except OSError:     # same-port restart (crash-resume)
+                    pass
+            self._accepted.clear()

@@ -360,3 +360,131 @@ def test_trace_timeline_acceptance_tcp(tmp_path):
         base_port=53290)
     # sockets add genuine transit: some wall books as wait
     assert "wait" in report["stage_totals_s"]
+
+
+# -- ISSUE 8: chaos-hardened federation --------------------------------------
+
+def test_chaos_torture_smoke_reliable_tcp():
+    """Fast chaos smoke over real sockets: 3 reliable uplink pushers vs
+    10% loss + 5% dup + 5% corrupt injected at the server's receive
+    chokepoint — every commit lands, the variables stay finite, faults
+    were actually injected, and ZERO recv threads died (quarantine +
+    resend carried the faults)."""
+    from fedml_tpu.async_ import run_ingest_torture
+    from fedml_tpu.comm.reliability import BackoffPolicy
+    r = run_ingest_torture(
+        n_clients=3, backend="TCP", p=512, buffer_k=2, commits=4,
+        warmup_commits=1, ingest_pool=2, decode_into=True,
+        streaming=True, base_port=53340, timeout_s=120, reliable=True,
+        chaos={"drop": 0.10, "dup": 0.05, "corrupt": 0.05},
+        reliable_backoff=BackoffPolicy(base_s=0.05, max_s=0.5))
+    assert r["finite"]
+    assert r["committed_updates_per_sec"] > 0
+    assert r["recv_thread_deaths"] == 0, r
+    assert sum(r["chaos_injected"].values()) >= 1, r["chaos_injected"]
+    assert r["acks"] > 0                    # the envelope round-tripped
+    assert r["reliable"] and r["chaos"]["drop"] == 0.10
+
+
+def test_chaos_torture_dedup_protects_commit_count():
+    """dup-heavy chaos (30% duplicate) with the ledger on: every commit
+    still aggregates exactly buffer_k DISTINCT updates — duplicates are
+    suppressed at the chokepoint (counted), never folded twice."""
+    from fedml_tpu.async_ import run_ingest_torture
+    from fedml_tpu.comm.reliability import BackoffPolicy
+    r = run_ingest_torture(
+        n_clients=3, backend="INPROC", p=512, buffer_k=2, commits=4,
+        warmup_commits=1, ingest_pool=0, decode_into=False,
+        streaming=True, timeout_s=90, reliable=True,
+        chaos={"dup": 0.30},
+        reliable_backoff=BackoffPolicy(base_s=0.05, max_s=0.5))
+    assert r["finite"]
+    assert r["dups_suppressed"] >= 1, r
+    assert r["recv_thread_deaths"] == 0
+
+
+@pytest.mark.slow
+def test_chaos_torture_32_clients_tcp_goodput_gate():
+    """NIGHTLY acceptance (ISSUE 8): 32 reliable TCP uplink clients
+    under 5% loss + 1% dup + 0.5% corrupt — all rounds commit,
+    committed-updates/sec >= 0.5x the clean reliable arm, and zero
+    recv-thread deaths."""
+    from fedml_tpu.async_ import run_ingest_torture
+    kw = dict(n_clients=32, backend="TCP", buffer_k=8, commits=10,
+              warmup_commits=2, ingest_pool=4, decode_into=True,
+              streaming=True, timeout_s=600, reliable=True)
+    clean = run_ingest_torture(base_port=53350, **kw)
+    fault = run_ingest_torture(
+        base_port=53352,
+        chaos={"drop": 0.05, "dup": 0.01, "corrupt": 0.005}, **kw)
+    assert clean["finite"] and fault["finite"]
+    assert fault["recv_thread_deaths"] == 0, fault
+    assert sum(fault["chaos_injected"].values()) >= 1
+    assert (fault["committed_updates_per_sec"]
+            >= 0.5 * clean["committed_updates_per_sec"]), (clean, fault)
+
+
+def test_async_crash_resume_over_tcp(tmp_path):
+    """ISSUE-8 crash-resume e2e over real TCP: kill the async server
+    mid-round (no STOP broadcast, transport torn down), rebuild it on
+    the SAME port from the orbax checkpoint, and the surviving clients
+    re-handshake — the run completes its full commit budget with finite
+    params.  The clients' reliable resends carry the dead-server
+    window."""
+    import tempfile
+    cfg, trainer, data = _small_setup(n_clients=2)
+    import jax.numpy as jnp
+    from fedml_tpu.async_.lifecycle import (AsyncClientManager,
+                                            AsyncServerManager)
+    init_vars = trainer.init(jax.random.PRNGKey(cfg.seed),
+                             jnp.asarray(data.client_shards["x"][0, 0]))
+    ip = {0: "127.0.0.1", 1: "127.0.0.1", 2: "127.0.0.1"}
+    kw = dict(ip_config=ip, base_port=53360, force_python_tcp=True)
+    ckpt = str(tmp_path / "ckpt")
+
+    server1 = AsyncServerManager(init_vars, 6, 2, 0, 3, "TCP",
+                                 deadline_s=3.0, reliable=True,
+                                 checkpoint_dir=ckpt, checkpoint_every=1,
+                                 **kw)
+    clients = [AsyncClientManager(trainer, data, cfg.epochs, r, 3, "TCP",
+                                  reliable=True, **kw) for r in (1, 2)]
+    threads = [c.run_async() for c in clients]
+    server1.run_async()
+    server1.send_start()
+    try:
+        deadline = time.time() + 90
+        while server1.version < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert server1.version >= 2, "server never reached crash point"
+        server1.crash()                     # mid-round, no STOP, no commit
+        time.sleep(0.3)
+
+        # the rebind can race the dying listener's last accept for a
+        # moment — retry briefly, like a process supervisor would
+        server2 = None
+        for _ in range(20):
+            try:
+                server2 = AsyncServerManager(
+                    init_vars, 6, 2, 0, 3, "TCP", deadline_s=3.0,
+                    reliable=True, checkpoint_dir=ckpt,
+                    checkpoint_every=1, resume=True, **kw)
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert server2 is not None, "same-port rebind never succeeded"
+        assert server2.version >= 2, "resume lost the committed rounds"
+        server2.run_async()
+        server2.send_start()                # re-handshake every client
+        assert server2.done.wait(timeout=180), (
+            f"resumed run stalled at version {server2.version}/6")
+        assert server2.version == 6
+        assert server2.updates_committed > 0
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(server2.variables))
+    finally:
+        for c in clients:
+            c.finish()
+        server2 = locals().get("server2")
+        if server2 is not None:
+            server2.finish()
+        server1.finish()

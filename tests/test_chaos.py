@@ -1,0 +1,412 @@
+"""Chaos + reliability layer tests (ISSUE 8, fedml_tpu/comm/chaos.py +
+reliability.py).
+
+The three acceptance pins live here:
+  * seed-determinism — identical injected-event traces across two
+    policies with the same seed, different traces across seeds;
+  * dup-storm bitwise — every uplink delivered TWICE through the
+    receive chokepoint with the dedup ledger on produces a streaming
+    accumulator (and committed variables) BITWISE equal to the clean
+    single-delivery run;
+  * quarantine — corrupt frames (enveloped or not) are counted and
+    nacked/dropped, never an exception up the recv thread.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu import obs
+from fedml_tpu.comm import (BackoffPolicy, ChaosConfig, ChaosPolicy,
+                            InProcBackend, InProcRouter, Message,
+                            MessageCodec, ReliableEndpoint)
+from fedml_tpu.comm import reliability
+
+
+# -- chaos policy ------------------------------------------------------------
+
+def _drive(policy, frames=400, peers=(1, 2, 3)):
+    """Deterministic single-threaded drive: recv draws plus send-gate
+    draws for a few peers, in a fixed order."""
+    pay = b"FML1" + bytes(64)
+    for i in range(frames):
+        list(policy.filter_recv(pay))
+        policy.plan_send(peers[i % len(peers)])
+
+
+def test_chaos_policy_seed_deterministic():
+    """The ISSUE-8 determinism pin: same seed + same per-stream frame
+    order => identical injected-event traces; a different seed
+    differs."""
+    mk = lambda seed: ChaosPolicy(ChaosConfig(
+        drop=0.1, dup=0.1, reorder=0.05, corrupt=0.1, disconnect=0.05,
+        delay=0.0, seed=seed))
+    a, b, c = mk(7), mk(7), mk(8)
+    for p in (a, b, c):
+        _drive(p)
+    assert a.trace() == b.trace(), "same seed diverged"
+    assert a.trace() != c.trace(), "different seeds agreed"
+    assert a.summary() == b.summary()
+    # every configured kind fired at these rates over 400 frames
+    assert set(a.summary()) >= {"drop", "dup", "corrupt"}
+
+
+def test_chaos_recv_faults_through_backend():
+    """drop=1.0 delivers nothing; dup=1.0 without the dedup ledger
+    delivers every frame twice — injected at the _deliver_frame
+    chokepoint, not in the test."""
+    router = InProcRouter()
+    src, dst = InProcBackend(1, router), InProcBackend(0, router)
+    msg = Message(1, 1, 0)
+    msg.add_params("w", np.arange(4, dtype=np.float32))
+
+    dst.install_chaos(ChaosPolicy(ChaosConfig(drop=1.0, seed=0)))
+    src.send_message(msg)
+    assert dst._inbox.qsize() == 0
+
+    dst.install_chaos(ChaosPolicy(ChaosConfig(dup=1.0, seed=0)))
+    src.send_message(msg)
+    assert dst._inbox.qsize() == 2
+
+
+def test_chaos_partition_blocks_sends_until_heal():
+    """The send gate: partitioned peers receive nothing; heal()
+    restores delivery (and doesn't consume the stream's schedule)."""
+    router = InProcRouter()
+    src, dst = InProcBackend(1, router), InProcBackend(0, router)
+    pol = ChaosPolicy(ChaosConfig(seed=0))
+    src.install_chaos(pol)
+    msg = Message(1, 1, 0)
+    msg.add_params("w", np.ones(2, np.float32))
+
+    pol.partition(0)
+    src.send_message(msg)
+    assert dst._inbox.qsize() == 0
+    assert pol.summary().get("partition", 0) == 1
+    pol.heal()
+    src.send_message(msg)
+    assert dst._inbox.qsize() == 1
+
+
+def test_chaos_disconnect_mid_frame_tcp():
+    """The torn-wire fault over real sockets: the sender transmits half
+    a frame and kills the connection; the receiver's recv loop dies on
+    THAT conn only (ConnectionError path, not a counted thread death)
+    and the next clean send — over a fresh dial — still lands."""
+    from fedml_tpu.comm.tcp_backend import TcpBackend
+    ip = {0: "127.0.0.1", 1: "127.0.0.1"}
+    a = TcpBackend(1, ip, base_port=54030)
+    b = TcpBackend(0, ip, base_port=54030)
+    deaths = obs.counter("comm_recv_thread_deaths_total")
+    d0 = deaths.value
+    try:
+        pol = ChaosPolicy(ChaosConfig(disconnect=1.0, seed=0))
+        a.install_chaos(pol)
+        msg = Message(1, 1, 0)
+        msg.add_params("w", np.arange(64, dtype=np.float32))
+        a.send_message(msg)                  # torn mid-frame
+        assert pol.summary().get("disconnect", 0) == 1
+        a.install_chaos(None)                # chaos off: clean resend
+        a.send_message(msg)
+        got = b._inbox.get(timeout=10)
+        assert np.array_equal(got.get("w"),
+                              np.arange(64, dtype=np.float32))
+        time.sleep(0.1)
+        assert deaths.value == d0, "torn frame killed a recv thread"
+    finally:
+        a.close()
+        b.close()
+
+
+# -- backoff policy ----------------------------------------------------------
+
+def test_backoff_policy_schedule():
+    """Delays grow geometrically to the cap, jitter stays inside its
+    band, and two same-seed policies agree (the chaos benches must be
+    repeatable)."""
+    p = BackoffPolicy(base_s=0.1, mult=2.0, max_s=0.5, jitter=0.2,
+                      max_attempts=5, seed=3)
+    q = BackoffPolicy(base_s=0.1, mult=2.0, max_s=0.5, jitter=0.2,
+                      max_attempts=5, seed=3)
+    da = [p.delay(i) for i in range(1, 8)]
+    db = [q.delay(i) for i in range(1, 8)]
+    assert da == db
+    for i, d in enumerate(da, start=1):
+        nominal = min(0.1 * 2.0 ** (i - 1), 0.5)
+        assert nominal * 0.8 <= d <= nominal * 1.2, (i, d)
+    nz = BackoffPolicy(base_s=0.1, jitter=0.0)
+    assert nz.delay(1) == pytest.approx(0.1)
+    assert nz.delay(10) == pytest.approx(nz.max_s)
+
+
+# -- reliable endpoint -------------------------------------------------------
+
+def test_reliable_roundtrip_ack_dedup_and_crc():
+    """One envelope end-to-end: the inner frame survives bitwise, the
+    ack retires the outstanding entry, a replay is suppressed (and
+    re-acked), and a corrupt envelope is quarantined + nacked."""
+    acker = []
+    rx = ReliableEndpoint(0, lambda p, w: acker.append(w), name="rx")
+    tx = ReliableEndpoint(7, lambda p, w: None, name="tx",
+                          policy=BackoffPolicy(base_s=5.0))
+    try:
+        msg = Message(3, 7, 0)
+        msg.add_params("w", np.arange(8, dtype=np.float32))
+        frame = MessageCodec.encode(msg)
+        wire = tx.send(0, frame)
+        assert tx.pending() == 1
+        inner = rx.on_wire(wire, reply=tx.on_wire)
+        assert inner == frame                 # bitwise through the envelope
+        assert tx.pending() == 0              # ack retired it
+        dups0 = obs.counter(
+            "comm_reliable_dups_suppressed_total").value
+        reacks = []
+        assert rx.on_wire(wire, reply=reacks.append) is None
+        assert obs.counter(
+            "comm_reliable_dups_suppressed_total").value == dups0 + 1
+        assert reacks, "replay was not re-acked"
+
+        quar0 = obs.counter("comm_frames_quarantined_total").value
+        bad = bytearray(wire)
+        bad[reliability.HEADER_LEN + 10] ^= 0xFF
+        nacks = []
+        assert rx.on_wire(bytes(bad), reply=nacks.append) is None
+        assert obs.counter(
+            "comm_frames_quarantined_total").value == quar0 + 1
+        assert nacks and bytes(nacks[0][:4]) == reliability.MAGIC
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_reliable_endpoint_resends_until_ack():
+    """A flaky transport (first two transmits vanish) is carried by the
+    backoff resend: the receiver eventually acks and the outstanding
+    window drains."""
+    rx_wires = []
+    rx = ReliableEndpoint(0, lambda p, w: None, name="rx")
+    attempts = {"n": 0}
+
+    def flaky_send(peer, wire):
+        attempts["n"] += 1
+        if attempts["n"] <= 2:
+            raise ConnectionError("injected transport loss")
+        inner = rx.on_wire(wire, reply=lambda w: tx.on_wire(w))
+        if inner is not None:
+            rx_wires.append(inner)
+
+    tx = ReliableEndpoint(1, flaky_send, name="tx",
+                          policy=BackoffPolicy(base_s=0.03, mult=1.5,
+                                               max_s=0.1, jitter=0.0,
+                                               max_attempts=20))
+    try:
+        frame = b"FML1" + bytes(32)
+        tx.send(0, frame)
+        assert tx.flush(timeout=5.0), "resend never got acked"
+        assert rx_wires == [frame]
+        assert attempts["n"] >= 3
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_reliable_abandons_after_max_attempts():
+    """A peer that never acks must not grow the outstanding map
+    forever: the frame is abandoned (counted) after max_attempts."""
+    tx = ReliableEndpoint(1, lambda p, w: None, name="tx",
+                          policy=BackoffPolicy(base_s=0.01, mult=1.0,
+                                               max_s=0.01, jitter=0.0,
+                                               max_attempts=3))
+    try:
+        ab0 = obs.counter("comm_reliable_abandoned_total").value
+        tx.send(0, b"FML1" + bytes(8))
+        deadline = time.monotonic() + 5.0
+        while tx.pending() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert tx.pending() == 0
+        assert obs.counter(
+            "comm_reliable_abandoned_total").value == ab0 + 1
+    finally:
+        tx.close()
+
+
+def test_plain_corrupt_frame_quarantined_not_raised():
+    """No envelope, garbage bytes: the receive chokepoint quarantines
+    (metric + log) instead of raising through the recv thread — the
+    pre-PR behavior was a decode ValueError killing the transport
+    loop."""
+    router = InProcRouter()
+    dst = InProcBackend(0, router)
+    quar0 = obs.counter("comm_frames_quarantined_total").value
+    dst._deliver_frame(b"GARBAGE-NOT-A-FRAME")          # must not raise
+    assert obs.counter(
+        "comm_frames_quarantined_total").value == quar0 + 1
+    assert dst._inbox.qsize() == 0
+
+
+def test_reliability_escape_hatch_env(monkeypatch):
+    """FEDML_RELIABLE=0 wins over an explicit enable: sends stay
+    un-enveloped (byte-identity is pinned in test_wire_codec.py)."""
+    monkeypatch.setenv(reliability.ENV_RELIABLE, "0")
+    router = InProcRouter()
+    be = InProcBackend(0, router)
+    assert be.enable_reliability() is False
+    assert be._reliable_tx is False
+
+
+# -- the dup-storm bitwise pin ----------------------------------------------
+
+def _storm_server(buffer_k, template, router):
+    from fedml_tpu.async_.lifecycle import AsyncServerManager
+    return AsyncServerManager(template, 1, buffer_k, 0, 2, "INPROC",
+                              staleness_mode="constant", mix=1.0,
+                              streaming=True, redispatch=False,
+                              reliable=True, router=router)
+
+
+def test_dup_storm_accumulator_bitwise_equals_clean():
+    """THE exactly-once pin: every uplink delivered TWICE through the
+    receive chokepoint (the retry-storm shape), with the (sender, seq)
+    dedup ledger guarding _ingest_row — the streaming accumulator and
+    the committed variables are BITWISE the clean single-delivery
+    run's."""
+    import jax
+    from fedml_tpu.async_.lifecycle import AsyncMessage
+    from fedml_tpu.async_.torture import make_template
+
+    template = make_template(512)
+    K = 4
+    rs = np.random.RandomState(0)
+    frames = []
+    for r in range(1, K + 1):
+        vals = jax.tree.map(
+            lambda a: rs.randn(*a.shape).astype(np.float32), template)
+        m = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, r, 0)
+        m.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, vals)
+        m.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, float(r))
+        m.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, 0)
+        frames.append(MessageCodec.encode(m))
+
+    def run(dup_storm: bool):
+        server = _storm_server(K, template, InProcRouter())
+        server.run_async()
+        try:
+            # one endpoint per simulated client rank, fresh seqs
+            eps = [ReliableEndpoint(r, lambda p, w: None,
+                                    policy=BackoffPolicy(base_s=60.0))
+                   for r in range(1, K + 1)]
+            for ep, frame in zip(eps, frames):
+                wire = ep.wrap(0, frame)
+                copies = 2 if dup_storm else 1
+                for _ in range(copies):
+                    server.com_manager._deliver_frame(
+                        wire, reply=lambda w: None)
+            for ep in eps:
+                ep.close()
+            assert server.done.wait(timeout=30), "commit never fired"
+            return jax.tree.map(np.asarray, server.variables)
+        finally:
+            server.finish()
+
+    clean = run(dup_storm=False)
+    storm = run(dup_storm=True)
+    import jax
+    for a, b in zip(jax.tree.leaves(clean), jax.tree.leaves(storm)):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- quorum-degraded commits under partition ---------------------------------
+
+def test_quorum_gates_deadline_commit():
+    """min_quorum=2: a deadline with ONE buffered result re-arms
+    instead of committing; once a second result lands the next deadline
+    commits — counted as quorum-degraded (below-capacity)."""
+    import jax
+    from fedml_tpu.async_.lifecycle import AsyncMessage, AsyncServerManager
+    from fedml_tpu.async_.staleness import flatten_vars_row
+    from fedml_tpu.async_.torture import make_template
+
+    template = make_template(64)
+    server = AsyncServerManager(template, 1, 4, 0, 5, "INPROC",
+                                staleness_mode="constant", mix=1.0,
+                                streaming=True, redispatch=False,
+                                deadline_s=0.15, min_quorum=2,
+                                router=InProcRouter())
+    try:
+        row = flatten_vars_row(jax.tree.map(
+            lambda a: np.ones(a.shape, np.float32), template))
+        with server._lock:
+            server._arm_watchdog(server.version)
+        server._ingest_row(1, row.copy(), 1.0, 0)
+        time.sleep(0.45)                    # >= 2 deadline windows
+        assert server.version == 0, "sub-quorum deadline committed"
+        assert server.buffer.count == 1
+        server._ingest_row(2, row.copy(), 1.0, 0)
+        assert server.done.wait(timeout=5.0), \
+            "quorum met but deadline never committed"
+        assert server.version == 1
+        assert server.degraded_commits == 1     # 2-of-4 = degraded
+        assert server.partial_commits == 1
+    finally:
+        server.finish()
+
+
+def test_chaos_reorder_swaps_never_silently_drops():
+    """A reorder-held frame is released behind the NEXT frame whatever
+    that frame draws — reorder means swapped delivery, not a disguised
+    drop (review finding: the old release fired only on a second
+    reorder draw)."""
+    pol = ChaosPolicy(ChaosConfig(reorder=1.0, seed=0))
+    frames = [bytes([i]) * 8 for i in range(5)]
+    out = []
+    for f in frames:
+        out.extend(pol.filter_recv(f))
+    # every frame is held one slot then released: delivery lags by one,
+    # the last frame stays held (the window's tail truncation)
+    assert out == frames[:-1]
+    assert pol.summary()["reorder"] == 5
+
+    pol2 = ChaosPolicy(ChaosConfig(reorder=0.5, seed=1))
+    delivered = []
+    for f in frames * 40:
+        delivered.extend(pol2.filter_recv(f))
+    # at most ONE frame (the final hold) may be missing — never more
+    assert len(delivered) >= len(frames) * 40 - 1
+
+
+def test_reliable_seq_state_survives_crash_resume():
+    """The crash-resume reliability state (review findings 1+2): a
+    restored endpoint (a) suppresses replays of frames the dead server
+    already ingested — the ACK-died-with-the-crash double-fold — and
+    (b) resumes its send seqs PAST the saved counters, so its
+    re-handshake is not suppressed by surviving peers' ledgers."""
+    rx1 = ReliableEndpoint(0, lambda p, w: None, name="server1")
+    tx = ReliableEndpoint(3, lambda p, w: None, name="client",
+                          policy=BackoffPolicy(base_s=60.0))
+    try:
+        wires = [tx.wrap(0, b"FML1" + bytes([i]) * 16) for i in range(3)]
+        for w in wires:
+            assert rx1.on_wire(w, reply=lambda a: None) is not None
+        rx1.wrap(3, b"FML1" + bytes(8))            # one pre-crash dispatch
+        state = rx1.export_seq_state(size=4)
+        assert int(state["seen"][3]) == 2          # seqs 0..2 ingested
+        assert int(state["seq"][3]) == 1           # one dispatch sent
+
+        # "server2": fresh endpoint + imported state
+        rx2 = ReliableEndpoint(0, lambda p, w: None, name="server2")
+        rx2.import_seq_state(state)
+        # (a) the client's resend of an already-ingested frame is a dup
+        assert rx2.on_wire(wires[-1], reply=lambda a: None) is None
+        # ...but a genuinely new frame still flows
+        fresh = tx.wrap(0, b"FML1" + bytes(16))
+        assert rx2.on_wire(fresh, reply=lambda a: None) is not None
+        # (b) send seqs resume past the dead server's counters + slack
+        w2 = rx2.wrap(3, b"FML1" + bytes(8))
+        import struct as _s
+        seq = _s.unpack("<4sBIQI", w2[:reliability.HEADER_LEN])[3]
+        assert seq >= 1 + ReliableEndpoint.SEQ_RESUME_SLACK
+        rx2.close()
+    finally:
+        tx.close()
+        rx1.close()

@@ -54,3 +54,76 @@ def test_no_timeout_still_exact():
                                      worker_num=3, straggler_timeout=60.0)
     for a, b in zip(jax.tree.leaves(v_barrier), jax.tree.leaves(v_timeout)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stale_upload_after_timeout_dropped_without_perturbing_next_round():
+    """ISSUE-8 satellite: an uplink that arrives AFTER
+    _on_straggler_timeout closed its round must be dropped — it may not
+    occupy a receive slot, and the NEXT round's aggregate must be
+    bitwise what it would be from the round-(n+1) uploads alone."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from fedml_tpu.comm.fedavg_messaging import (FedAvgAggregator,
+                                                 FedAvgServerManager,
+                                                 MyMessage)
+    from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.core.pytree import tree_weighted_mean
+
+    trainer, data, cfg = _setup(n_clients=2)
+    init = {"w": np.zeros((3,), np.float32)}
+
+    def upload(sender, round_idx, vals, n):
+        m = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, sender, 0)
+        m.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                     {"w": np.asarray(vals, np.float32)})
+        m.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND, round_idx)
+        return m
+
+    router = InProcRouter()
+    # dummy client mailboxes so the server's next-round sync broadcast
+    # has somewhere to go (never dispatched — no run loop)
+    InProcBackend(1, router), InProcBackend(2, router)
+    agg = FedAvgAggregator(init, 2, 2, 2)
+    seen = {}
+    done = threading.Event()
+
+    def on_round(idx, variables):
+        seen[idx] = {k: np.asarray(v).copy() for k, v in variables.items()}
+        if idx == 1:
+            done.set()
+
+    server = FedAvgServerManager(agg, 2, 0, 3, "INPROC", router=router,
+                                 straggler_timeout=0.15,
+                                 on_round_done=on_round)
+    server.register_message_receive_handlers()
+    try:
+        # round 0: only client 1 uploads; the watchdog closes the round
+        server._handle_model_from_client(upload(1, 0, [1.0, 1.0, 1.0], 4))
+        t0 = time.time()
+        while 0 not in seen and time.time() - t0 < 10:
+            time.sleep(0.01)
+        assert 0 in seen, "straggler timeout never closed round 0"
+        np.testing.assert_array_equal(seen[0]["w"],
+                                      np.ones(3, np.float32))
+
+        # the straggler's round-0 upload lands late: dropped — no slot
+        server._handle_model_from_client(upload(2, 0, [9.0, 9.0, 9.0], 100))
+        assert agg.received_count() == 0, "stale upload took a slot"
+
+        # round 1 completes from fresh uploads only; the aggregate is
+        # bitwise the weighted mean of THESE uploads — the stale 9s
+        # never leak in
+        server._handle_model_from_client(upload(1, 1, [2.0, 2.0, 2.0], 1))
+        server._handle_model_from_client(upload(2, 1, [4.0, 4.0, 4.0], 3))
+        assert done.wait(timeout=10)
+        stacked = {"w": np.stack([np.full(3, 2.0, np.float32),
+                                  np.full(3, 4.0, np.float32)])}
+        expect = tree_weighted_mean(stacked,
+                                    jnp.asarray([1.0, 3.0], jnp.float32))
+        np.testing.assert_array_equal(seen[1]["w"], np.asarray(expect["w"]))
+    finally:
+        server.finish()

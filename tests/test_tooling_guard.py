@@ -239,3 +239,55 @@ def test_chip_queue_carries_trace_ab():
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr
+
+
+def test_bench_json_schema_v7_carries_chaos_block():
+    """ISSUE 8: schema v7 adds the chaos-mode fields — the "chaos"
+    block from `python bench.py --mode chaos` with the clean reliable
+    arm, the goodput-vs-fault-rate curve, the mixed acceptance arm and
+    its goodput_vs_clean headline, plus the retry/dedup/quarantine/
+    recv-death counters every row carries.  Static source check like
+    the v3-v6 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 7, (
+        "bench schema must stay >= v7 (chaos block)")
+    for field in ('"chaos"', '"clean"', '"curve"', '"mixed"',
+                  "goodput_ratio", "goodput_vs_clean", "retries",
+                  "dups_suppressed", "quarantined",
+                  "recv_thread_deaths", "_bench_chaos"):
+        assert field in src, (
+            f"bench.py lost the v7 chaos field {field} "
+            "(see fedml_tpu/comm/chaos.py and _bench_chaos)")
+    # the block's numbers come from the torture harness's chaos report
+    tort = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "async_", "torture.py")).read()
+    for field in ("chaos_injected", "dups_suppressed", "quarantined",
+                  "recv_thread_deaths", "abandoned"):
+        assert field in tort, (
+            f"run_ingest_torture's report lost {field!r} — bench.py's "
+            "v7 chaos block reads it")
+    # and the layer itself must exist
+    for mod in ("chaos.py", "reliability.py"):
+        assert os.path.exists(os.path.join(
+            os.path.dirname(__file__), "..", "fedml_tpu", "comm", mod)), (
+            f"fedml_tpu/comm/{mod} (the ISSUE-8 robustness layer) is gone")
+
+
+def test_chip_queue_carries_chaos_ab():
+    """ISSUE 8: the next chip window must price the chaos goodput —
+    scripts/run_chip_queue.sh carries the CHAOS step (10/10) and
+    profile_bench.py defines the exp_CHAOS experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    assert "profile_bench.py CHAOS" in open(queue).read(), (
+        "run_chip_queue.sh lost the CHAOS goodput A/B "
+        "(ISSUE 8 queues it for the next chip window)")
+    assert "exp_CHAOS" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_CHAOS experiment the queue runs")
+    import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr

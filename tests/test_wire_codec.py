@@ -398,3 +398,76 @@ def test_obs_disabled_backend_send_is_byte_identical(tmp_path):
     ref = MessageCodec.encode(msg)
     be.send_message(msg)
     assert seen["frame"] == ref
+
+
+# -- ISSUE 8: reliability-off frames stay byte-identical to pre-PR -----------
+
+def test_reliability_disabled_frames_byte_identical_across_variants():
+    """The ISSUE-8 acceptance pin: with reliability NOT enabled (the
+    default) a backend send emits frames byte-identical to a plain
+    MessageCodec.encode across every codec flavor (v1, v2 bf16/int8
+    transport, v2 zlib) — the envelope only exists when a sender opted
+    in."""
+    from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+    seen = {}
+
+    class Capture(InProcRouter):
+        def route(self, msg):
+            payload = MessageCodec.encode(msg)
+            seen["frame"] = payload
+            return len(payload)
+
+    be = InProcBackend(0, Capture())
+    for name, msg in _frame_variants().items():
+        ref = MessageCodec.encode(msg)
+        be.send_message(msg)
+        assert seen["frame"] == ref, (
+            f"{name}: reliability-off send changed the frame bytes")
+
+
+def test_reliability_escape_hatch_keeps_bytes_identical(monkeypatch):
+    """FEDML_RELIABLE=0 beats an explicit enable_reliability(): frames
+    stay byte-identical to the pre-envelope wire — the one-env-var
+    rollback mirrors FEDML_WIRE_V1."""
+    from fedml_tpu.comm import reliability
+    from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
+    monkeypatch.setenv(reliability.ENV_RELIABLE, "0")
+    seen = {}
+
+    class Capture(InProcRouter):
+        def route(self, msg):
+            seen["frame"] = MessageCodec.encode(msg)
+            return len(seen["frame"])
+
+    be = InProcBackend(0, Capture())
+    assert be.enable_reliability() is False
+    for name, msg in _frame_variants().items():
+        ref = MessageCodec.encode(msg)
+        be.send_message(msg)
+        assert seen["frame"] == ref, name
+
+
+def test_reliability_envelope_carries_every_codec_flavor():
+    """v1-compatibility of the envelope: the wrapped inner frame is the
+    codec frame UNCHANGED (wire == header + frame), and unwrapping
+    restores it bitwise for v1/bf16/int8/zlib flavors — decode sees
+    exactly what it would have seen without the envelope."""
+    from fedml_tpu.comm import reliability
+    from fedml_tpu.comm.reliability import BackoffPolicy, ReliableEndpoint
+    tx = ReliableEndpoint(5, lambda p, w: None,
+                          policy=BackoffPolicy(base_s=60.0))
+    rx = ReliableEndpoint(0, lambda p, w: None)
+    try:
+        for name, msg in _frame_variants().items():
+            frame = MessageCodec.encode(msg)
+            wire = tx.wrap(0, frame)
+            assert wire[:4] == reliability.MAGIC
+            assert wire[reliability.HEADER_LEN:] == frame, name
+            inner = rx.on_wire(wire, reply=lambda w: None)
+            assert inner == frame, name
+            out = MessageCodec.decode(inner)
+            ref = MessageCodec.decode(frame)
+            assert sorted(out.get_params()) == sorted(ref.get_params())
+    finally:
+        tx.close()
+        rx.close()

@@ -1055,6 +1055,39 @@ def exp_TRACE(reps: int = 4):
               flush=True)
 
 
+def exp_CHAOS():
+    """Chaos goodput A/B (ISSUE 8): the reliable ingest torture (32 TCP
+    clients, FMLR envelopes, decode-into + streaming, pool 4) under
+    seeded wire-level fault injection (fedml_tpu/comm/chaos.py) at the
+    server's receive chokepoint.  Arms: clean reliable baseline, 5% and
+    20% frame loss, and the acceptance-shaped mixed arm (5% loss + 1%
+    dup + 0.5% corrupt).  The gate is goodput >= 0.5x clean on the
+    mixed arm with ZERO recv-thread deaths — the `bench.py --mode
+    chaos` curve, priced with the chip-attached jax runtime driving
+    the fold/commit."""
+    from fedml_tpu.async_.torture import run_ingest_torture
+
+    arms = [("clean", None),
+            ("loss_5", {"drop": 0.05}),
+            ("loss_20", {"drop": 0.20}),
+            ("mixed", {"drop": 0.05, "dup": 0.01, "corrupt": 0.005})]
+    base = None
+    for i, (tag, chaos) in enumerate(arms):
+        r = run_ingest_torture(n_clients=32, backend="TCP", buffer_k=8,
+                               commits=20, warmup_commits=3,
+                               ingest_pool=4, decode_into=True,
+                               streaming=True, base_port=53900 + i,
+                               timeout_s=600, reliable=True, chaos=chaos)
+        ups = r["committed_updates_per_sec"]
+        base = ups if base is None else base
+        print(f"CHAOS {tag}: {ups:.1f} updates/s "
+              f"({ups / base:.2f}x clean)  retries {r['retries']:.0f}  "
+              f"dups suppressed {r['dups_suppressed']:.0f}  "
+              f"quarantined {r['quarantined']:.0f}  recv deaths "
+              f"{r['recv_thread_deaths']:.0f}  injected "
+              f"{r['chaos_injected']}", flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
