@@ -71,7 +71,19 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     and a "mixed" arm (5% loss + 1% dup + 0.5% corrupt — the
 #     acceptance shape) with its goodput_vs_clean headline; null in
 #     other modes, so v6 readers keep working
-SCHEMA_VERSION = 7
+# v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
+#     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
+#     attack x defense arms on the async MNIST-LR workload (each row:
+#     attack mode, defended flag, test_acc, quarantine counts with
+#     honest/byzantine attribution), the "mixed" acceptance trio
+#     (20% byzantine boost+labelflip — defended_acc vs undefended_acc
+#     vs clean_acc, false_positive_quarantines), and an "overhead"
+#     ingest-torture pair (admission screen on vs off) whose
+#     throughput_ratio prices the fused screen (the >=0.9x target is
+#     the chip-side gate — on the 2-core CI box the serial fold is the
+#     bottleneck and the paired median is ~0.73x, PERF.md); null in
+#     other modes, so v7 readers keep working
+SCHEMA_VERSION = 8
 
 
 def _critical_path_doc():
@@ -181,7 +193,8 @@ def _probe_with_retry() -> tuple[bool, str]:
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser("bench")
-    ap.add_argument("--mode", choices=("sync", "async", "ingest", "chaos"),
+    ap.add_argument("--mode",
+                    choices=("sync", "async", "ingest", "chaos", "attack"),
                     default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
@@ -196,7 +209,12 @@ def main() -> None:
                          "chaos: the same torture under seeded wire "
                          "faults (fedml_tpu/comm/chaos.py) with the "
                          "reliability envelope on — goodput-vs-fault-"
-                         "rate curves for loss/dup/corrupt")
+                         "rate curves for loss/dup/corrupt; attack: "
+                         "the adversarial-robustness matrix (ISSUE 9, "
+                         "fedml_tpu/async_/adversary.py + defense.py) "
+                         "— attack x defense accuracy on the async "
+                         "MNIST-LR workload plus the admission-screen "
+                         "ingest-overhead pair")
     ap.add_argument("--ingest_clients", type=int, default=32,
                     help="ingest mode: concurrent uplink clients")
     ap.add_argument("--ingest_backend", default="TCP",
@@ -218,6 +236,18 @@ def main() -> None:
     ap.add_argument("--chaos_seed", type=int, default=0,
                     help="chaos mode: fault-injection seed (same seed = "
                          "same per-stream injected-event trace)")
+    ap.add_argument("--attack_commits", type=int, default=16,
+                    help="attack mode: async commits per accuracy arm "
+                         "(the quality-band workload runs 16)")
+    ap.add_argument("--attack_ingest_clients", type=int, default=32,
+                    help="attack mode: clients in the screen-overhead "
+                         "ingest pair")
+    ap.add_argument("--attack_backend", default="TCP",
+                    choices=("TCP", "GRPC", "INPROC"),
+                    help="attack mode: transport of the overhead pair")
+    ap.add_argument("--attack_seed", type=int, default=0,
+                    help="attack mode: adversary seed (same seed = same "
+                         "byzantine set + corruption streams)")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -239,6 +269,7 @@ def main() -> None:
             "async": None,
             "ingest": None,
             "chaos": None,
+            "attack": None,
             "critical_path": None,
             "error": "chip_unavailable",
             "detail": detail,
@@ -259,6 +290,9 @@ def main() -> None:
         return
     if args.mode == "chaos":
         _bench_chaos(args)
+        return
+    if args.mode == "attack":
+        _bench_attack(args)
         return
     import jax.numpy as jnp
 
@@ -364,6 +398,7 @@ def main() -> None:
         "async": None,
         "ingest": None,
         "chaos": None,
+        "attack": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -446,6 +481,7 @@ def _bench_async(cfg, data, trainer) -> None:
                   for k, v in rep.items()},
         "ingest": None,
         "chaos": None,
+        "attack": None,
         # v6: commit-to-commit stage attribution from the scheduler's
         # spans (train waves / commits / eval + wait); null untraced
         "critical_path": _critical_path_doc(),
@@ -531,6 +567,7 @@ def _bench_ingest(args) -> None:
         "h2d_bytes_per_round": None,
         "rounds": [],
         "async": None,
+        "attack": None,
         "ingest": {
             "backend": legacy["backend"],
             "n_clients": legacy["n_clients"],
@@ -654,6 +691,7 @@ def _bench_chaos(args) -> None:
         "rounds": [],
         "async": None,
         "ingest": None,
+        "attack": None,
         "chaos": {
             "backend": clean["backend"],
             "n_clients": clean["n_clients"],
@@ -673,6 +711,174 @@ def _bench_chaos(args) -> None:
             {k: v for k, v in mixed["critical_path"].items()
              if k != "rounds"}
             if mixed.get("critical_path") else None),
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+# attack-mode shape (ISSUE 9): the accuracy matrix runs the SAME
+# synthetic MNIST-LR async workload the quality bands calibrate
+# (1000 clients, buffer K=8, concurrency 16, polynomial staleness,
+# lognormal latency), so matrix rows are directly band-comparable;
+# the defense arm is the band's defense config.  The overhead pair
+# reruns the ingest torture with the admission screen on vs off —
+# honest traffic only, so quarantines there are false positives by
+# definition and the throughput ratio isolates the screen's cost.
+ATTACK_FRAC = 0.2
+ATTACK_BOOST = 20.0
+# the MIXED arm runs the quality-band calibration shape EXACTLY
+# (benchmarks/quality_bands.json async_mnist_lr_attacked_*: boost β=8,
+# poison_frac 1.0) so its defended/undefended accuracies are directly
+# band-comparable; the other matrix rows explore at ATTACK_BOOST
+ATTACK_BAND_BOOST = 8.0
+ATTACK_BAND_POISON = 1.0
+ATTACK_MATRIX_MODES = ("signflip", "boost", "gaussian", "labelflip",
+                       "mixed")
+ATTACK_DEFENSE = dict(norm_bound=2.0, screen=True, z_max=8.0,
+                      cos_min=-1.0, screen_warmup=10, buckets=4, trim_k=0)
+ATTACK_OVERHEAD_COMMITS = 20
+
+
+def _bench_attack(args) -> None:
+    """Attack x defense accuracy/goodput matrix (ISSUE 9): every
+    adversary family from fedml_tpu/async_/adversary.py against the
+    admission pipeline + bucketed robust commit, on the async MNIST-LR
+    quality-band workload, plus the admission-overhead ingest pair.
+    Gates: the mixed defended arm stays within the clean band while
+    undefended degrades, zero honest quarantines in the clean arm;
+    the overhead pair's throughput_ratio prices the fused screen
+    (>= 0.9x on chip, ~0.73x paired-median on the fold-bottlenecked
+    2-core CI box — PERF.md "Adversarial robustness")."""
+    import jax
+
+    from fedml_tpu import obs
+    from fedml_tpu.async_ import (AsyncFedAvgEngine, AttackConfig,
+                                  DefenseConfig, LifecycleConfig)
+    from fedml_tpu.async_.torture import run_ingest_torture
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.loaders import load_data
+    from fedml_tpu.models import create_model
+    from fedml_tpu.utils.config import FedConfig
+
+    data = load_data("mnist", client_num_in_total=1000, batch_size=10,
+                     synthetic_scale=0.2, seed=0)
+    cfg = FedConfig(client_num_in_total=1000, client_num_per_round=16,
+                    comm_round=args.attack_commits, epochs=1,
+                    batch_size=10, lr=0.03, frequency_of_the_test=10_000)
+    lc = LifecycleConfig(latency="lognormal", latency_scale=1.0,
+                         latency_sigma=0.8, heterogeneity=0.5, seed=0)
+
+    def arm(tag, attack_mode, defended):
+        trainer = ClientTrainer(create_model("lr", output_dim=10),
+                                lr=cfg.lr)
+        attack = None
+        if attack_mode == "mixed":
+            attack = AttackConfig(mode="mixed", frac=ATTACK_FRAC,
+                                  boost=ATTACK_BAND_BOOST,
+                                  poison_frac=ATTACK_BAND_POISON,
+                                  seed=args.attack_seed)
+        elif attack_mode != "none":
+            attack = AttackConfig(mode=attack_mode, frac=ATTACK_FRAC,
+                                  boost=ATTACK_BOOST,
+                                  seed=args.attack_seed)
+        defense = (DefenseConfig(**ATTACK_DEFENSE) if defended else None)
+        eng = AsyncFedAvgEngine(trainer, data, cfg, buffer_k=8,
+                                concurrency=16, staleness="polynomial",
+                                staleness_a=0.5, lifecycle_cfg=lc,
+                                attack=attack, defense=defense)
+        v = eng.run(rounds=args.attack_commits)
+        acc = float(eng.evaluate(v)["test_acc"])
+        rep = eng.async_report()
+        attrib = eng.quarantine_attribution()
+        print(f"{tag}: acc {acc:.3f}  quarantined "
+              f"{rep.get('quarantined_total', 0)} "
+              f"(byz {attrib['byzantine']} / honest {attrib['honest']})",
+              file=sys.stderr)
+        return {"attack": attack_mode, "defended": defended,
+                "test_acc": round(acc, 4),
+                "quarantined": rep.get("quarantined", {}),
+                "quarantined_total": rep.get("quarantined_total", 0),
+                "quarantined_byzantine": attrib["byzantine"],
+                "quarantined_honest": attrib["honest"],
+                "byzantine_clients": rep.get("byzantine_clients", 0)}
+
+    clean = arm("clean undefended", "none", False)
+    clean_def = arm("clean defended", "none", True)
+    matrix = []
+    for mode in ATTACK_MATRIX_MODES:
+        matrix.append(arm(f"{mode} undefended", mode, False))
+        matrix.append(arm(f"{mode} defended", mode, True))
+    mixed_und = next(r for r in matrix
+                     if r["attack"] == "mixed" and not r["defended"])
+    mixed_def = next(r for r in matrix
+                     if r["attack"] == "mixed" and r["defended"])
+
+    # admission-overhead pair: honest ingest torture, screen off vs on
+    port = int(os.environ.get("BENCH_ATTACK_PORT", "53500"))
+    off = run_ingest_torture(
+        n_clients=args.attack_ingest_clients, backend=args.attack_backend,
+        buffer_k=INGEST_BUFFER_K, commits=ATTACK_OVERHEAD_COMMITS,
+        warmup_commits=3, ingest_pool=4, decode_into=True, streaming=True,
+        base_port=port + 1)
+    on = run_ingest_torture(
+        n_clients=args.attack_ingest_clients, backend=args.attack_backend,
+        buffer_k=INGEST_BUFFER_K, commits=ATTACK_OVERHEAD_COMMITS,
+        warmup_commits=3, ingest_pool=4, decode_into=True, streaming=True,
+        base_port=port + 2,
+        defense=DefenseConfig(screen=True, z_max=8.0, screen_warmup=8))
+    ratio = (on["committed_updates_per_sec"]
+             / off["committed_updates_per_sec"]
+             if off["committed_updates_per_sec"] > 0 else None)
+    print(f"overhead: screen-off {off['committed_updates_per_sec']:.1f} "
+          f"-> screen-on {on['committed_updates_per_sec']:.1f} updates/s "
+          f"(ratio {f'{ratio:.2f}' if ratio is not None else 'n/a'}; "
+          f"chip gate >= 0.9)  false-positive "
+          f"quarantines {on['admission']['quarantined_total']}",
+          file=sys.stderr)
+
+    doc = _stamp({
+        "metric": "async_attack_mnist_lr_defended_acc",
+        "value": mixed_def["test_acc"],
+        "unit": "accuracy",
+        # the in-schema comparisons are the clean and undefended arms
+        "vs_baseline": None,
+        "mode": "attack",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": None,
+        "chaos": None,
+        "attack": {
+            "workload": "async_mnist_lr (quality-band shape, K=8, "
+                        "conc 16, poly a=0.5)",
+            "frac": ATTACK_FRAC,
+            "boost": ATTACK_BOOST,
+            "seed": args.attack_seed,
+            "defense": dict(ATTACK_DEFENSE),
+            "clean_acc": clean["test_acc"],
+            "clean_defended_acc": clean_def["test_acc"],
+            "defended_acc": mixed_def["test_acc"],
+            "undefended_acc": mixed_und["test_acc"],
+            "false_positive_quarantines":
+                clean_def["quarantined_honest"],
+            "matrix": [clean, clean_def] + matrix,
+            "overhead": {
+                "backend": off["backend"],
+                "n_clients": off["n_clients"],
+                "screen_off_updates_per_sec": round(
+                    off["committed_updates_per_sec"], 4),
+                "screen_on_updates_per_sec": round(
+                    on["committed_updates_per_sec"], 4),
+                "throughput_ratio": (round(ratio, 4)
+                                     if ratio is not None else None),
+                "screen_on_quarantined":
+                    on["admission"]["quarantined_total"],
+            },
+        },
+        "critical_path": _critical_path_doc(),
     })
     if obs.enabled():
         obs.export()

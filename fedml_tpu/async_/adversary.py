@@ -1,0 +1,216 @@
+"""Seeded adversarial client simulator — the attack half of ISSUE 9.
+
+PR 8 made the transport survive a hostile NETWORK; this module makes
+clients hostile at the SEMANTIC level: a byzantine cohort rides the
+PR-5 ``ClientLifecycle`` (same dispatch path, same latencies) but
+corrupts what it uploads — the FedML paper's attack benchmarking
+surface (arXiv:2007.13518 §3.4) brought to the async path, where
+ROADMAP item 4 calls stale adversarial updates "an open research
+edge".
+
+Attack families, applied to the flat f32 uplink row (the
+``flatten_vars_row`` layout both async paths speak):
+
+    signflip    row' = g − (row − g)          (reversed update direction)
+    boost       row' = g + β·(row − g)        (scaled model replacement,
+                                               Bagdasaryan et al. 2020's
+                                               train-and-scale)
+    gaussian    row' = row + σ·N(0, I)        (additive noise)
+    labelflip   honest protocol, poisoned DATA (data/poison.py label
+                flip — trigger_fn=None semantics)
+    backdoor    honest protocol, pixel-trigger backdoor shards
+                (data/poison.py BadNets-style corner patch)
+    mixed       boost + labelflip together — the acceptance arm's shape
+
+Orthogonal modifiers:
+
+* ``collude``: every byzantine client at the same model version sends
+  the IDENTICAL crafted row (a shared direction from a cohort stream),
+  defeating per-client outlier screens — the case bucketed robust
+  aggregation exists for;
+* ``stale``: byzantine uplinks are timed to land at high staleness
+  (``stale_lag`` extra latency per dispatch), so the attack hides in
+  the staleness-discount regime the async path tolerates by design.
+
+Determinism (the comm/chaos.py contract): the byzantine set is a
+seeded choice, and every per-client corruption stream is a pure
+function of ``[seed, client_id]`` (colluding draws of ``[seed,
+version]``) — two sims with the same seed corrupt identically
+(identical ``events`` traces, pinned in tests/test_robustness.py), two
+seeds differ.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from fedml_tpu import obs
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+ATTACK_MODES = ("none", "signflip", "boost", "gaussian", "labelflip",
+                "backdoor", "mixed")
+# modes that corrupt the uplink row (vs. poisoning the training data)
+_MODEL_ATTACKS = ("signflip", "boost", "gaussian", "mixed")
+# modes that poison the attacker clients' shards
+_DATA_ATTACKS = ("labelflip", "backdoor", "mixed")
+
+_MAX_EVENTS = 50_000
+
+
+@dataclasses.dataclass
+class AttackConfig:
+    """Knobs of the seeded adversarial cohort (CLI --attack_*)."""
+    mode: str = "none"
+    frac: float = 0.2                # byzantine fraction of the fleet
+    boost: float = 10.0              # model-replacement scale β
+    noise_std: float = 1.0           # gaussian attack σ
+    target_label: int = 0            # label-flip / backdoor target
+    poison_frac: float = 0.5         # poisoned fraction of attacker data
+    collude: bool = False            # identical crafted rows per version
+    stale: bool = False              # time uplinks to land stale
+    stale_lag: float = 3.0           # extra latency (sim/real seconds)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.mode not in ATTACK_MODES:
+            raise ValueError(f"unknown attack mode {self.mode!r} "
+                             f"(choose one of {ATTACK_MODES})")
+        if not 0.0 <= self.frac <= 1.0:
+            raise ValueError(f"attack frac must be in [0, 1], got "
+                             f"{self.frac}")
+
+
+class AdversarySim:
+    """Seeded byzantine cohort.  Thread-safe (the messaging FSM corrupts
+    from concurrent client threads); per-client streams are lazily
+    created np.Generators keyed [seed, 7, client_id], so one client's
+    corruption trace never depends on another's interleaving."""
+
+    def __init__(self, cfg: AttackConfig, n_clients: int):
+        self.cfg = cfg
+        self.n_clients = n_clients
+        self._lock = threading.Lock()
+        self._streams: dict[int, np.random.Generator] = {}
+        self.events: list[tuple] = []
+        self.injected = 0                # unbounded (events list is capped)
+        self._m_corrupted = obs.counter("async_attacks_injected_total")
+        rng = np.random.default_rng([cfg.seed, 6])
+        n_byz = int(round(cfg.frac * n_clients)) if cfg.mode != "none" else 0
+        self.byzantine = frozenset(
+            int(c) for c in rng.choice(n_clients, size=n_byz,
+                                       replace=False)) if n_byz else frozenset()
+
+    def is_byzantine(self, client_id: int) -> bool:
+        return int(client_id) in self.byzantine
+
+    def attacks_model(self) -> bool:
+        return self.cfg.mode in _MODEL_ATTACKS
+
+    def attacks_data(self) -> bool:
+        return self.cfg.mode in _DATA_ATTACKS
+
+    def _stream(self, client_id: int) -> np.random.Generator:
+        with self._lock:
+            st = self._streams.get(client_id)
+            if st is None:
+                st = self._streams[client_id] = np.random.default_rng(
+                    [self.cfg.seed, 7, int(client_id)])
+            return st
+
+    def _record(self, kind: str, client_id: int, version: int) -> None:
+        with self._lock:
+            self.injected += 1
+            if len(self.events) < _MAX_EVENTS:
+                self.events.append((kind, int(client_id), int(version)))
+        self._m_corrupted.inc()
+        obs.instant(f"attack.{kind}", client=client_id, version=version)
+
+    def trace(self) -> list[tuple]:
+        with self._lock:
+            return list(self.events)
+
+    def stale_extra_latency(self, client_id: int) -> float:
+        """Extra dispatch latency for a stale-attacking byzantine client
+        (0 otherwise) — lands its uplink several commits late, where
+        the staleness discount is supposed to defang it."""
+        if self.cfg.stale and self.is_byzantine(client_id):
+            return float(self.cfg.stale_lag)
+        return 0.0
+
+    def corrupt_row(self, client_id: int, row: np.ndarray,
+                    global_row: np.ndarray, version: int = 0) -> np.ndarray:
+        """The model-level attack on one flat uplink row.  `global_row`
+        is the model the client trained FROM (the attacker legitimately
+        holds it); honest clients and data-only attacks pass through
+        unchanged.  Always returns a fresh array — callers may hold
+        read-only views of device buffers."""
+        c = self.cfg
+        if not self.is_byzantine(client_id) or not self.attacks_model():
+            return row
+        row = np.asarray(row, np.float32)
+        g = np.asarray(global_row, np.float32)
+        if c.collude:
+            # every colluder at this version sends the same crafted
+            # model: g + β·σ·(shared unit direction) — per-VERSION
+            # stream, so the cohort agrees without communicating
+            rng = np.random.default_rng([c.seed, 8, int(version)])
+            d = rng.standard_normal(row.shape[0]).astype(np.float32)
+            d *= np.float32(c.noise_std) / np.float32(
+                max(np.linalg.norm(d), 1e-12))
+            out = g + np.float32(c.boost) * d
+            self._record("collude", client_id, version)
+            return out
+        if c.mode == "signflip":
+            out = g - (row - g)
+            self._record("signflip", client_id, version)
+            return out
+        if c.mode in ("boost", "mixed"):
+            out = g + np.float32(c.boost) * (row - g)
+            self._record("boost", client_id, version)
+            return out
+        # gaussian
+        noise = self._stream(client_id).standard_normal(
+            row.shape[0]).astype(np.float32)
+        self._record("gaussian", client_id, version)
+        return row + np.float32(c.noise_std) * noise
+
+    def corrupt_update(self, client_id: int, new_vars: Pytree,
+                       base_vars: Pytree, version: int = 0) -> Pytree:
+        """Pytree form of corrupt_row for the messaging FSM (the client
+        holds variables, not rows): flatten both through the ONE
+        flatten_vars_row layout, corrupt, unflatten back to numpy."""
+        if not self.is_byzantine(client_id) or not self.attacks_model():
+            return new_vars
+        import jax
+        from fedml_tpu.async_.staleness import flatten_vars_row
+        row = self.corrupt_row(client_id, flatten_vars_row(new_vars),
+                               flatten_vars_row(base_vars), version)
+        leaves, treedef = jax.tree.flatten(new_vars)
+        out, off = [], 0
+        for leaf in leaves:
+            size = int(np.prod(np.shape(leaf))) if np.ndim(leaf) else 1
+            out.append(np.asarray(
+                row[off:off + size], np.float32).reshape(np.shape(leaf)))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+
+def apply_data_attack(data, cfg: AttackConfig, adversary: AdversarySim):
+    """Poison the byzantine clients' shards for the data-level attacks
+    (labelflip/backdoor/mixed), through the existing data/poison.py
+    machinery: label-flip = poison_federated_data with trigger_fn=None,
+    backdoor = the BadNets pixel trigger.  Identity for model-only
+    attacks."""
+    if not adversary.attacks_data() or not adversary.byzantine:
+        return data
+    from fedml_tpu.data.poison import pixel_trigger, poison_federated_data
+    trigger = pixel_trigger if cfg.mode == "backdoor" else None
+    return poison_federated_data(
+        data, sorted(adversary.byzantine), cfg.target_label,
+        poison_frac=cfg.poison_frac, trigger_fn=trigger, seed=cfg.seed)

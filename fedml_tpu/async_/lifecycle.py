@@ -38,8 +38,14 @@ from fedml_tpu import obs
 from fedml_tpu.obs import propagate
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.async_.adversary import (AdversarySim, AttackConfig,
+                                        apply_data_attack)
+from fedml_tpu.async_.defense import (DefenseConfig, UpdateAdmission,
+                                      make_flatten_fn)
 from fedml_tpu.async_.staleness import (AsyncBuffer, RowLayout, flat_dim,
-                                        flatten_vars_row, make_commit_fn,
+                                        flatten_vars_row,
+                                        make_bucket_commit_fn,
+                                        make_commit_fn,
                                         make_stream_commit_fn,
                                         unflatten_rows)
 
@@ -206,11 +212,19 @@ class AsyncServerManager(ServerManager):
                  decode_into: bool = True, redispatch: bool = True,
                  reliable: bool = False, min_quorum: int = 1,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_every: int = 1, resume: bool = False, **kw):
+                 checkpoint_every: int = 1, resume: bool = False,
+                 defense: Optional[DefenseConfig] = None, **kw):
         super().__init__(rank, size, backend, **kw)
         import jax
         if reliable:
             self.com_manager.enable_reliability()
+        if defense is not None and not streaming:
+            raise ValueError(
+                "the admission pipeline rides the streaming fold "
+                "(defense needs streaming=True) — the drain path holds "
+                "the full [K, P] matrix and has the sync-side robust "
+                "aggregators instead")
+        self.defense = defense
         self.variables = jax.tree.map(np.asarray, init_variables)
         self.total_commits = total_commits
         self.buffer_k = buffer_k
@@ -229,11 +243,35 @@ class AsyncServerManager(ServerManager):
         self.commit_walls: list[float] = []      # perf_counter per commit
         self.commit_sizes: list[int] = []        # n_real per commit
         p = flat_dim(self.variables)
-        self.buffer = AsyncBuffer(buffer_k, p, streaming=streaming,
-                                  staleness_mode=staleness_mode,
-                                  staleness_a=staleness_a,
-                                  staleness_b=staleness_b)
-        if streaming:
+        self.buffer = AsyncBuffer(
+            buffer_k, p, streaming=streaming,
+            staleness_mode=staleness_mode, staleness_a=staleness_a,
+            staleness_b=staleness_b,
+            buckets=(defense.buckets if defense is not None else 1),
+            bucket_seed=(defense.seed if defense is not None else 0))
+        # ISSUE 9: the admission pipeline + bucketed robust commit.  The
+        # admission gate sits at _ingest_row (the ONE insert path);
+        # defense=None keeps the PR-6 programs untouched, and the
+        # defended degenerate config (B=1, no screen/clip) is pinned
+        # bitwise against them in tests/test_robustness.py.
+        self._admission: Optional[UpdateAdmission] = None
+        self._dp_rng = None
+        self._flat_fn = make_flatten_fn()
+        self._g_dev = None
+        if defense is not None:
+            self._admission = UpdateAdmission(defense, p)
+            self._admission.bind_fold(staleness_mode, staleness_a,
+                                      staleness_b)
+            self._g_dev = self._flat_fn(self.variables)
+            self._admission.note_global(0, self._g_dev)
+            if defense.dp_noise > 0.0:
+                self._dp_rng = jax.random.PRNGKey(defense.seed + 17)
+        if streaming and defense is not None:
+            self._commit = make_bucket_commit_fn(
+                self.variables, combine=defense.combine,
+                trim_k=defense.trim_k, dp_noise=defense.dp_noise,
+                dp_clip=defense.dp_clip or 1.0, donate=False)
+        elif streaming:
             self._commit = make_stream_commit_fn(self.variables,
                                                  donate=False)
         else:
@@ -282,6 +320,16 @@ class AsyncServerManager(ServerManager):
                 if rel is not None and "reliable" in extra:
                     rel.import_seq_state(
                         jax.tree.map(np.asarray, extra["reliable"]))
+                if self._admission is not None:
+                    if "defense" in extra:
+                        # the screen resumes ARMED: its running
+                        # reference survives the crash, so a restart
+                        # cannot be exploited as a fresh cold-start
+                        # warmup window
+                        self._admission.load_state(
+                            jax.tree.map(np.asarray, extra["defense"]))
+                    self._g_dev = self._flat_fn(self.variables)
+                    self._admission.note_global(self.version, self._g_dev)
                 log.info("async server resumed from checkpoint: version "
                          "%d, %d updates committed, buffer %d/%d",
                          self.version, self.updates_committed,
@@ -330,14 +378,19 @@ class AsyncServerManager(ServerManager):
         rel_state = (rel.export_seq_state(self.size) if rel is not None
                      else {"seq": np.zeros((self.size,), np.int64),
                            "seen": np.full((self.size,), -1, np.int64)})
-        return {"buffer": self.buffer.state(),
-                "updates_committed": np.asarray(self.updates_committed,
-                                                np.int64),
-                "partial_commits": np.asarray(self.partial_commits,
-                                              np.int64),
-                "degraded_commits": np.asarray(self.degraded_commits,
+        out = {"buffer": self.buffer.state(),
+               "updates_committed": np.asarray(self.updates_committed,
                                                np.int64),
-                "reliable": rel_state}
+               "partial_commits": np.asarray(self.partial_commits,
+                                             np.int64),
+               "degraded_commits": np.asarray(self.degraded_commits,
+                                              np.int64),
+               "reliable": rel_state}
+        if self._admission is not None:
+            # bucket accumulators ride the buffer state above; the
+            # admission pipeline's running reference rides here
+            out["defense"] = self._admission.state()
+        return out
 
     def _save_checkpoint_locked(self) -> None:
         with obs.span("async.checkpoint", version=self.version):
@@ -468,10 +521,32 @@ class AsyncServerManager(ServerManager):
             if self.done.is_set():
                 return                      # late straggler after shutdown
             staleness = float(self.version - dispatched)
+            if self._admission is not None:
+                # ISSUE-9 admission gate at the ONE insert path: finite
+                # canary -> shared-definition norm clip -> z/cosine
+                # anomaly screen, FUSED with the streaming fold into a
+                # single jitted dispatch (the hot path keeps its PR-6
+                # throughput).  A quarantined row never reaches the
+                # accumulator; its sender is redispatched like any
+                # contributing client, so an attacker cannot starve the
+                # round by getting itself rejected.
+                with obs.span("ingest.fold", sender=sender):
+                    ok, _why, full = self.buffer.add_screened(
+                        row, weight, staleness, self._admission,
+                        sender=sender, version=dispatched)
+                if not ok:
+                    self._outstanding[sender] = None
+                    if self.redispatch:
+                        self._redispatch_locked([sender])
+                    return
+            else:
+                with obs.span("ingest.fold", sender=sender):
+                    full = self.buffer.add(row, weight, staleness)
+            # shared post-insert bookkeeping: only ADMITTED results
+            # count toward the staleness statistics (a quarantined
+            # row's staleness returned above)
             self.staleness_seen.append(staleness)
             self._m_staleness.observe(staleness)
-            with obs.span("ingest.fold", sender=sender):
-                full = self.buffer.add(row, weight, staleness)
             self._m_occupancy.set(self.buffer.count)
             self._outstanding[sender] = None
             if not full:
@@ -535,7 +610,22 @@ class AsyncServerManager(ServerManager):
                       streaming=self.streaming,
                       n_results=self.buffer.count,
                       deadline=deadline_fired):
-            if self.streaming:
+            if self.streaming and self.defense is not None:
+                # bucketed robust streaming commit (ISSUE 9): O(B·P)
+                accs, wsums, _w, _s, n_real, _raw = \
+                    self.buffer.take_stream_buckets()
+                self._m_occupancy.set(0)
+                if self._dp_rng is not None:
+                    self._dp_rng, k = jax.random.split(self._dp_rng)
+                    new_vars, _stats = self._commit(
+                        jax.tree.map(jnp.asarray, self.variables),
+                        accs, wsums, jnp.float32(self.mix),
+                        jnp.float32(n_real), k)
+                else:
+                    new_vars, _stats = self._commit(
+                        jax.tree.map(jnp.asarray, self.variables),
+                        accs, wsums, jnp.float32(self.mix))
+            elif self.streaming:
                 acc, wsum, _w, _s, n_real, _raw = self.buffer.take_stream()
                 self._m_occupancy.set(0)
                 new_vars, _stats = self._commit(
@@ -549,7 +639,12 @@ class AsyncServerManager(ServerManager):
                     jnp.asarray(rows), jnp.asarray(w), jnp.asarray(s),
                     jnp.float32(self.mix))
             self.variables = jax.tree.map(np.asarray, new_vars)
+            if self._g_dev is not None:
+                # the admission reference global moves with every commit
+                self._g_dev = self._flat_fn(self.variables)
         self.version += 1
+        if self._admission is not None:
+            self._admission.note_global(self.version, self._g_dev)
         self.updates_committed += n_real
         self.commit_walls.append(time.perf_counter())
         self.commit_sizes.append(n_real)
@@ -643,9 +738,11 @@ class AsyncClientManager(ClientManager):
     def __init__(self, trainer, data, epochs: int, rank: int, size: int,
                  backend: str = "INPROC",
                  lifecycle: Optional[ClientLifecycle] = None,
-                 reliable: bool = False, **kw):
+                 reliable: bool = False,
+                 adversary: Optional[AdversarySim] = None, **kw):
         super().__init__(rank, size, backend, **kw)
         import jax
+        self.adversary = adversary
         if reliable:
             # enveloped uplinks: a server restart mid-upload is carried
             # by the endpoint's backoff resend instead of an exception
@@ -686,6 +783,11 @@ class AsyncClientManager(ClientManager):
                 obs.counter("async_dropouts_total").inc()
                 return
             lat = self.lifecycle.draw_latency(client_idx)
+            if self.adversary is not None:
+                # stale-attack: the byzantine uplink deliberately lands
+                # commits late (REAL seconds here — keep stale_lag small
+                # in tests, like latency_scale)
+                lat += self.adversary.stale_extra_latency(client_idx)
             if lat > 0.0:
                 time.sleep(lat)
         variables = msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)
@@ -696,9 +798,16 @@ class AsyncClientManager(ClientManager):
                       client=client_idx):
             new_vars, _loss, n = self._local_train(
                 jax.tree.map(jnp.asarray, variables), shard, rng)
+        upload = jax.tree.map(np.asarray, new_vars)
+        if self.adversary is not None:
+            # byzantine clients corrupt what they UPLOAD (semantically
+            # valid frames — the wire layer has no reason to reject
+            # them; that is exactly the admission pipeline's job)
+            upload = self.adversary.corrupt_update(
+                client_idx, upload, variables,
+                int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
         out = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, self.rank, 0)
-        out.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                       jax.tree.map(np.asarray, new_vars))
+        out.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, upload)
         out.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
         out.add_params(AsyncMessage.MSG_ARG_KEY_VERSION,
                        int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
@@ -738,6 +847,8 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
                         streaming: bool = True, ingest_pool: int = 0,
                         decode_into: bool = True, reliable: bool = False,
                         chaos=None, min_quorum: int = 1,
+                        attack: Optional[AttackConfig] = None,
+                        defense: Optional[DefenseConfig] = None,
                         timeout_s: float = 600.0, **backend_kw):
     """Launch the async server + one lifecycle-simulated client per rank
     (threads for INPROC; for TCP/GRPC run one rank per process and call
@@ -749,7 +860,13 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
     ingestion under retries/duplicates); `chaos` installs a
     comm.chaos.ChaosPolicy on the SERVER's backend (uplink faults —
     the torture direction); `min_quorum` gates deadline commits under
-    partition."""
+    partition.
+
+    ISSUE 9: `attack` builds one seeded AdversarySim shared by every
+    client manager (byzantine uplink corruption; data-level attacks
+    poison the shared dataset before the clients snapshot it) and
+    `defense` installs the admission pipeline + bucketed robust commit
+    on the server."""
     import jax
     import jax.numpy as jnp
     from fedml_tpu.comm.inproc import InProcRouter
@@ -767,6 +884,10 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
 
     if lifecycle is None and lifecycle_cfg is not None:
         lifecycle = ClientLifecycle(lifecycle_cfg, worker_num)
+    adversary = None
+    if attack is not None and attack.mode != "none":
+        adversary = AdversarySim(attack, worker_num)
+        data = apply_data_attack(data, attack, adversary)
     init_vars = trainer.init(jax.random.PRNGKey(cfg.seed),
                              jnp.asarray(data.client_shards["x"][0, 0]))
     server = AsyncServerManager(
@@ -775,12 +896,13 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
         staleness_b=staleness_b, mix=mix, deadline_s=deadline_s,
         streaming=streaming, ingest_pool=ingest_pool,
         decode_into=decode_into, reliable=reliable,
-        min_quorum=min_quorum, **kw)
+        min_quorum=min_quorum, defense=defense, **kw)
     if chaos is not None:
         server.com_manager.install_chaos(chaos)
     clients = [AsyncClientManager(trainer, data, cfg.epochs, r, size,
                                   backend, lifecycle=lifecycle,
-                                  reliable=reliable, **kw)
+                                  reliable=reliable, adversary=adversary,
+                                  **kw)
                for r in range(1, size)]
     threads = [c.run_async() for c in clients] + [server.run_async()]
     server.send_start()

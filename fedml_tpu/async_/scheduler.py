@@ -41,9 +41,14 @@ import numpy as np
 
 from fedml_tpu import obs
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
+from fedml_tpu.async_.adversary import (AdversarySim, AttackConfig,
+                                        apply_data_attack)
+from fedml_tpu.async_.defense import (DefenseConfig, UpdateAdmission,
+                                      make_flatten_fn)
 from fedml_tpu.async_.lifecycle import ClientLifecycle, LifecycleConfig
 from fedml_tpu.async_.staleness import (AsyncBuffer, STALENESS_MODES,
                                         flat_dim, flatten_stacked_rows,
+                                        make_bucket_commit_fn,
                                         make_commit_fn)
 
 log = logging.getLogger(__name__)
@@ -83,10 +88,24 @@ class AsyncFedAvgEngine(FedAvgEngine):
                  staleness_b: float = 4.0, mix: float = 1.0,
                  round_deadline_s: Optional[float] = None,
                  lifecycle_cfg: Optional[LifecycleConfig] = None,
-                 async_seed: Optional[int] = None, donate: bool = True):
+                 async_seed: Optional[int] = None, donate: bool = True,
+                 attack: Optional[AttackConfig] = None,
+                 defense: Optional[DefenseConfig] = None):
         if staleness not in STALENESS_MODES:
             raise ValueError(f"unknown staleness mode {staleness!r} "
                              f"(choose one of {STALENESS_MODES})")
+        # ISSUE 9: the seeded byzantine cohort (attack) and the update
+        # admission + bucketed robust commit (defense).  Data-level
+        # attacks poison the byzantine clients' shards BEFORE the engine
+        # snapshots the data — the attackers then run the honest
+        # protocol on hostile data, exactly the reference's backdoor
+        # benchmarking shape.
+        self.attack = attack
+        self.defense = defense
+        self._adversary = None
+        if attack is not None and attack.mode != "none":
+            self._adversary = AdversarySim(attack, cfg.client_num_in_total)
+            data = apply_data_attack(data, attack, self._adversary)
         super().__init__(trainer, data, cfg, donate=donate)
         self.buffer_k = (buffer_k if buffer_k is not None
                          else cfg.client_num_per_round)
@@ -118,7 +137,9 @@ class AsyncFedAvgEngine(FedAvgEngine):
         self._train_wave = jax.jit(jax.vmap(
             self._one_client, in_axes=(None, 0, 0)))
         self._rows_fn = jax.jit(flatten_stacked_rows)
+        self._flat_fn = make_flatten_fn()
         self._commit_fn = None        # built per variables template
+        self._admission: Optional[UpdateAdmission] = None
         self._p = None
         self.version = 0
         self.commits_deadline = 0
@@ -144,14 +165,19 @@ class AsyncFedAvgEngine(FedAvgEngine):
         per-client staleness counters (utils/checkpoint.py extra_state).
         The event clock/heap is NOT part of it — a resumed run restarts
         the lifecycle clock but keeps every buffered result and
-        staleness statistic."""
+        staleness statistic.  Defended runs additionally carry the
+        bucket accumulators (inside the buffer state) and the admission
+        pipeline's running reference, so a resumed screen stays armed."""
         self._ensure_buffer()
-        return {
+        out = {
             "buffer": self._buffer.state(),
             "version": np.asarray(self.version, np.int64),
             "client_last_staleness": self._client_last_staleness.copy(),
             "client_contribs": self._client_contribs.copy(),
         }
+        if self._admission is not None:
+            out["defense"] = self._admission.state()
+        return out
 
     def load_async_state(self, state: dict) -> None:
         self._ensure_buffer()
@@ -161,11 +187,32 @@ class AsyncFedAvgEngine(FedAvgEngine):
             state["client_last_staleness"], np.float32).copy()
         self._client_contribs = np.asarray(
             state["client_contribs"], np.int64).copy()
+        if self._admission is not None and "defense" in state:
+            self._admission.load_state(state["defense"])
 
     def _ensure_buffer(self) -> None:
         if getattr(self, "_buffer", None) is None:
             n = self.sampler.client_num_in_total
-            self._buffer = AsyncBuffer(self.buffer_k, self._flat_dim())
+            if self.defense is not None:
+                # defended path: streaming bucketed buffer — the robust
+                # commit needs B accumulators, and the staleness
+                # discount moves into the arrival fold (same λ math;
+                # the weights ride the fold instead of the drained
+                # commit)
+                self._buffer = AsyncBuffer(
+                    self.buffer_k, self._flat_dim(), streaming=True,
+                    staleness_mode=self.staleness_mode,
+                    staleness_a=self.staleness_a,
+                    staleness_b=self.staleness_b,
+                    buckets=self.defense.buckets,
+                    bucket_seed=self.defense.seed)
+                self._admission = UpdateAdmission(self.defense,
+                                                  self._flat_dim())
+                self._admission.bind_fold(self.staleness_mode,
+                                          self.staleness_a,
+                                          self.staleness_b)
+            else:
+                self._buffer = AsyncBuffer(self.buffer_k, self._flat_dim())
             self._client_last_staleness = np.zeros(n, np.float32)
             self._client_contribs = np.zeros(n, np.int64)
 
@@ -197,10 +244,28 @@ class AsyncFedAvgEngine(FedAvgEngine):
             log.info("async resume: version %d, buffer %d/%d", self.version,
                      self._buffer.count, self.buffer_k)
         if self._commit_fn is None:
-            self._commit_fn = make_commit_fn(
-                variables, mode=self.staleness_mode, a=self.staleness_a,
-                b=self.staleness_b, donate=self.donate)
+            if self.defense is not None:
+                d = self.defense
+                self._commit_fn = make_bucket_commit_fn(
+                    variables, combine=d.combine, trim_k=d.trim_k,
+                    dp_noise=d.dp_noise, dp_clip=d.dp_clip or 1.0,
+                    donate=self.donate)
+            else:
+                self._commit_fn = make_commit_fn(
+                    variables, mode=self.staleness_mode, a=self.staleness_a,
+                    b=self.staleness_b, donate=self.donate)
         variables = jax.tree.map(jnp.asarray, variables)
+        # the admission screen and the adversary both compare uplinks
+        # against the model the clients trained FROM — one flat device
+        # row per version, refreshed at every commit
+        g_dev = (self._flat_fn(variables)
+                 if (self._admission is not None
+                     or self._adversary is not None) else None)
+        if self._admission is not None:
+            self._admission.note_global(self.version, g_dev)
+        dp_rng = (jax.random.PRNGKey(cfg.seed + 17)
+                  if self.defense is not None and self.defense.dp_noise > 0
+                  else None)
         lifecycle = ClientLifecycle(self.lifecycle_cfg,
                                     self.sampler.client_num_in_total)
 
@@ -245,6 +310,8 @@ class AsyncFedAvgEngine(FedAvgEngine):
                     variables, cohort, crngs)
                 rows = np.asarray(self._rows_fn(stacked))
                 ns = np.asarray(ns)
+            g_np = (np.asarray(g_dev) if self._adversary is not None
+                    and self._adversary.attacks_model() else None)
             self._m_dispatches.inc(len(ids))
             for lane, cid in enumerate(ids):
                 free.discard(cid)
@@ -258,25 +325,62 @@ class AsyncFedAvgEngine(FedAvgEngine):
                     else:
                         push(now + delay, _REJOIN, cid)
                     continue
+                row = rows[lane]
+                if g_np is not None and self._adversary.is_byzantine(cid):
+                    # byzantine lanes swap their honest result for the
+                    # crafted row — AFTER the crash draw, so a crashed
+                    # byzantine dispatch (its uplink never arrives)
+                    # neither pays the corruption nor counts as an
+                    # injected attack in the trace/counters
+                    row = self._adversary.corrupt_row(
+                        cid, row, g_np, self.version)
+                    self.trace.append(("attack", round(now, 9), cid,
+                                       self.version))
                 in_flight[cid] = self.version
                 lat = lifecycle.draw_latency(cid)
+                if self._adversary is not None:
+                    # stale-attack: byzantine uplinks deliberately land
+                    # several commits late, where the staleness
+                    # discount was supposed to defang them
+                    lat += self._adversary.stale_extra_latency(cid)
                 self.trace.append(("dispatch", round(now, 9), cid,
                                    self.version))
-                push(now + lat, _ARRIVE,
-                     (cid, rows[lane], float(ns[lane])))
+                push(now + lat, _ARRIVE, (cid, row, float(ns[lane])))
             wave_idx += 1
 
         def commit(deadline_fired: bool):
-            nonlocal variables, last_commit_t, deadline_armed_version
-            rows, w, s, n_real = self._buffer.drain()
-            self.occupancy_at_commit.append(n_real)
-            self._m_occupancy.set(0)
-            with obs.span("async.commit", version=self.version,
-                          n_results=n_real, deadline=deadline_fired):
-                variables, _stats = self._commit_fn(
-                    variables, jnp.asarray(rows), jnp.asarray(w),
-                    jnp.asarray(s), jnp.float32(self.mix))
+            nonlocal variables, last_commit_t, deadline_armed_version, \
+                g_dev, dp_rng
+            if self.defense is not None:
+                accs, wsums, _w, _s, n_real, _raw = \
+                    self._buffer.take_stream_buckets()
+                self.occupancy_at_commit.append(n_real)
+                self._m_occupancy.set(0)
+                with obs.span("async.commit", version=self.version,
+                              n_results=n_real, deadline=deadline_fired,
+                              defended=True):
+                    if dp_rng is not None:
+                        dp_rng, k = jax.random.split(dp_rng)
+                        variables, _stats = self._commit_fn(
+                            variables, accs, wsums, jnp.float32(self.mix),
+                            jnp.float32(n_real), k)
+                    else:
+                        variables, _stats = self._commit_fn(
+                            variables, accs, wsums, jnp.float32(self.mix))
+            else:
+                rows, w, s, n_real = self._buffer.drain()
+                self.occupancy_at_commit.append(n_real)
+                self._m_occupancy.set(0)
+                with obs.span("async.commit", version=self.version,
+                              n_results=n_real, deadline=deadline_fired):
+                    variables, _stats = self._commit_fn(
+                        variables, jnp.asarray(rows), jnp.asarray(w),
+                        jnp.asarray(s), jnp.float32(self.mix))
+            if g_dev is not None:
+                g_dev = self._flat_fn(variables)
             self.version += 1
+            if self._admission is not None:
+                self._admission.note_global(self.version, g_dev)
             last_commit_t = now
             deadline_armed_version = -1
             self._m_commits.inc()
@@ -351,11 +455,26 @@ class AsyncFedAvgEngine(FedAvgEngine):
                     staleness = float(self.version - dispatched_v)
                     self.trace.append(("arrive", round(now, 9), cid,
                                        self.version, staleness))
+                    if self._admission is not None:
+                        # the ISSUE-9 admission gate, fused with the
+                        # streaming fold (one jitted dispatch); a
+                        # quarantined row never reaches the accumulator
+                        # (the client is free again and redispatches
+                        # with the next wave)
+                        full = False
+                        ok, why, full = self._buffer.add_screened(
+                            row, n, staleness, self._admission,
+                            sender=cid, version=int(dispatched_v))
+                        if not ok:
+                            self.trace.append(
+                                ("quarantine", round(now, 9), cid, why))
+                            continue
+                    else:
+                        full = self._buffer.add(row, n, staleness)
                     self.staleness_committed.append(staleness)
                     self._client_last_staleness[cid] = staleness
                     self._client_contribs[cid] += 1
                     self._m_staleness.observe(staleness)
-                    full = self._buffer.add(row, n, staleness)
                     self._m_occupancy.set(self._buffer.count)
                     if full:
                         commit(deadline_fired=False)
@@ -391,7 +510,7 @@ class AsyncFedAvgEngine(FedAvgEngine):
     def async_report(self) -> dict:
         """Headline async numbers for bench.py / profile_bench."""
         occ = np.asarray(self.occupancy_at_commit or [0])
-        return {
+        out = {
             "committed_updates": int(self.version),
             "deadline_commits": int(self.commits_deadline),
             "staleness_p50": self.staleness_percentiles()["p50"],
@@ -400,3 +519,23 @@ class AsyncFedAvgEngine(FedAvgEngine):
                 self.staleness_committed or [0.0])),
             "buffer_occupancy_mean": float(occ.mean()),
         }
+        if self._admission is not None:
+            out.update(self._admission.report())
+        if self._adversary is not None:
+            out["byzantine_clients"] = len(self._adversary.byzantine)
+            # the unbounded counter, not len(events) — the trace list
+            # caps at 50k while long runs keep injecting
+            out["attacks_injected"] = self._adversary.injected
+        return out
+
+    def quarantine_attribution(self) -> dict:
+        """{"byzantine": n, "honest": n} quarantine split — the
+        false-positive gate's raw numbers (honest must be 0 in the
+        clean arm).  Needs both an adversary (who is byzantine) and an
+        admission pipeline (who was quarantined)."""
+        byz = self._adversary.byzantine if self._adversary else frozenset()
+        out = {"byzantine": 0, "honest": 0}
+        if self._admission is not None:
+            for cid, _why in self._admission.quarantine_log:
+                out["byzantine" if cid in byz else "honest"] += 1
+        return out

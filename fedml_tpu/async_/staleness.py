@@ -55,7 +55,7 @@ scheduler's sync-FedAvg anchor.
 from __future__ import annotations
 
 import threading
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -299,6 +299,113 @@ def make_stream_commit_fn(template: Pytree, donate: bool = True):
     return jax.jit(commit, donate_argnums=(0, 2) if donate else ())
 
 
+BUCKET_COMBINE_MODES = ("mean", "trimmed_mean", "median")
+
+
+def make_bucket_commit_fn(template: Pytree, combine: str = "trimmed_mean",
+                          trim_k: int = 0, dp_noise: float = 0.0,
+                          dp_clip: float = 1.0, donate: bool = True):
+    """Build the O(B·P) bucketed ROBUST streaming commit (ISSUE 9):
+
+        commit(variables, accs [B,P], wsums [B], alpha[, rng])
+            -> (new_variables, stats)
+
+    Each arrival folded w̃·row into one of B seeded bucket accumulators
+    (AsyncBuffer(buckets=B)); the commit divides each non-empty bucket
+    into its discounted mean and combines ACROSS bucket means with a
+    robust order statistic — the Karimireddy et al. bucketing recipe
+    (arXiv:2006.09365 shape) adapted to the streaming regime: memory
+    stays O(B·P), never O(K·P), so the PR-6 aggregation-on-arrival
+    property survives the defense.
+
+    Combine families (per coordinate, over the m non-empty buckets,
+    empty buckets masked to +inf before the sort so they fall outside
+    every rank window):
+
+        mean           trimmed_mean with k_eff = 0
+        trimmed_mean   drop the k_eff = min(trim_k, ⌊(m-1)/2⌋) largest
+                       and smallest bucket means, average the rest
+        median         per-coordinate median of the m bucket means
+
+    DEGENERATE PIN: B = 1, trim 0 (or "mean") reproduces the PR-6
+    streaming commit (make_stream_commit_fn) BITWISE — the single
+    bucket mean is the same acc/wsum division, the sort over a
+    size-1 axis is the identity, and the final /1.0 is exact — pinned
+    in tests/test_robustness.py and audited as the
+    `async_bucket_commit` hlo_copy_audit family (0 copy ops;
+    variables, accs and wsums all donated — accs aliases into the
+    stats' bucket_means passthrough).
+
+    DP-FedAvg (ROADMAP item 4's first server transform): `dp_noise`
+    > 0 adds Gaussian noise inside the jitted commit — the signature
+    grows to commit(variables, accs, wsums, alpha, n_contrib, rng),
+    and σ = dp_noise·dp_clip/n_contrib per coordinate on the combined
+    mean: the per-client clip (the SAME clip_row definition,
+    core/robust.py, applied at admission) bounds each contribution to
+    dp_clip, so the n-client average has sensitivity S/n and the
+    McMahan et al. 2018 noise-multiplier convention divides by the
+    CLIENT count, not the bucket count.  dp_noise = 0 builds the
+    noise-free 4-arg program (no dormant ops in the degenerate
+    pin)."""
+    if combine not in BUCKET_COMBINE_MODES:
+        raise ValueError(f"unknown bucket combine {combine!r} "
+                         f"(choose one of {BUCKET_COMBINE_MODES})")
+    if trim_k < 0:
+        raise ValueError(f"trim_k must be >= 0, got {trim_k}")
+
+    def _combine(accs, wsums):
+        valid = wsums > 0.0
+        m = jnp.sum(valid.astype(jnp.float32))
+        safe_w = jnp.where(valid, wsums, 1.0)
+        means = accs / safe_w[:, None]
+        masked = jnp.where(valid[:, None], means, jnp.inf)
+        s = jnp.sort(masked, axis=0)          # invalid rows sort to the top
+        if combine == "median":
+            mi = m.astype(jnp.int32)
+            lo = jnp.take(s, (mi - 1) // 2, axis=0)
+            hi = jnp.take(s, mi // 2, axis=0)
+            row = 0.5 * (lo + hi)
+        else:
+            k_eff = (jnp.minimum(jnp.float32(trim_k),
+                                 jnp.floor((m - 1.0) / 2.0))
+                     if combine == "trimmed_mean" and trim_k > 0
+                     else jnp.float32(0.0))
+            ranks = jnp.arange(s.shape[0], dtype=jnp.float32)[:, None]
+            keep = (ranks >= k_eff) & (ranks < m - k_eff)
+            row = (jnp.sum(jnp.where(keep, s, 0.0), axis=0)
+                   / (m - 2.0 * k_eff))
+        stats = {"bucket_means": jnp.where(valid[:, None], means, 0.0),
+                 "n_buckets": m, "bucket_wsum": wsums}
+        return row, m, stats
+
+    def _mix(variables, row, alpha):
+        avg = unflatten_row(row, variables)
+        alpha = jnp.asarray(alpha, jnp.float32)
+        return jax.tree.map(
+            lambda v, mm: ((1.0 - alpha) * v.astype(jnp.float32)
+                           + alpha * mm).astype(v.dtype),
+            variables, avg)
+
+    if dp_noise > 0.0:
+        def commit(variables, accs, wsums, alpha, n_contrib, rng):
+            row, _m, stats = _combine(accs, wsums)
+            sigma = (jnp.float32(dp_noise * dp_clip)
+                     / jnp.maximum(jnp.asarray(n_contrib, jnp.float32),
+                                   1.0))
+            row = row + sigma * jax.random.normal(rng, row.shape,
+                                                  jnp.float32)
+            return _mix(variables, row, alpha), stats
+    else:
+        def commit(variables, accs, wsums, alpha):
+            row, _m, stats = _combine(accs, wsums)
+            return _mix(variables, row, alpha), stats
+
+    # variables alias the update in place; accs alias the bucket_means
+    # stats passthrough (same [B, P] f32 shape); wsums alias their own
+    # passthrough — the 0-copy `async_bucket_commit` audit family
+    return jax.jit(commit, donate_argnums=(0, 1, 2) if donate else ())
+
+
 # ---------------------------------------------------------------------------
 # the bounded aggregation buffer
 # ---------------------------------------------------------------------------
@@ -321,6 +428,18 @@ class AsyncBuffer:
       streaming buffer by REPLAYING its rows through the same fold —
       bitwise the accumulator the arrivals would have built.
 
+    Bucketed mode (ISSUE 9, streaming only): `buckets` = B > 1 keeps B
+    independent [P] accumulators instead of one; each arrival folds
+    into a SEEDED bucket (block-wise seeded permutations of range(B),
+    so every window of B inserts spreads evenly but an attacker cannot
+    predict its bucket from its arrival slot — the assignment stream
+    is a pure function of `bucket_seed` and the insert sequence, like
+    comm/chaos.py's fault streams).  `take_stream_buckets()` hands the
+    bucketed robust commit (make_bucket_commit_fn) the stacked
+    [B, P] / [B] state; memory stays O(B·P), preserving the PR-6
+    streaming regime.  B = 1 keeps the exact PR-6 fields and code
+    path.
+
     Internally thread-safe (ISSUE-6 satellite): `add`, `drain`,
     `take_stream`, `state`, and `load_state` all take the buffer's own
     lock, so a checkpoint snapshot racing a decode-pool insert can
@@ -329,12 +448,24 @@ class AsyncBuffer:
 
     def __init__(self, capacity: int, p: int, *, streaming: bool = False,
                  staleness_mode: str = "constant", staleness_a: float = 0.5,
-                 staleness_b: float = 4.0):
+                 staleness_b: float = 4.0, buckets: int = 1,
+                 bucket_seed: int = 0):
         if capacity < 1:
             raise ValueError(f"buffer capacity must be >= 1, got {capacity}")
+        if buckets < 1:
+            raise ValueError(f"buckets must be >= 1, got {buckets}")
+        if buckets > 1 and not streaming:
+            raise ValueError("bucketed aggregation needs streaming=True "
+                             "(drain mode already holds the full [K, P] "
+                             "matrix — bucket it at commit time instead)")
+        if buckets > capacity:
+            raise ValueError(f"buckets ({buckets}) cannot exceed buffer "
+                             f"capacity ({capacity}): a full buffer could "
+                             f"never populate every bucket")
         self.capacity = capacity
         self.p = p
         self.streaming = streaming
+        self.buckets = int(buckets)
         self._lock = threading.Lock()
         self.weights = np.zeros((capacity,), np.float32)
         self.staleness = np.zeros((capacity,), np.float32)
@@ -343,11 +474,87 @@ class AsyncBuffer:
             self.rows = None
             self._fold = make_fold_fn(staleness_mode, staleness_a,
                                       staleness_b)
-            self.acc = jnp.zeros((p,), jnp.float32)
-            self.wsum = jnp.zeros((), jnp.float32)
+            if self.buckets > 1:
+                self._accs = [jnp.zeros((p,), jnp.float32)
+                              for _ in range(self.buckets)]
+                self._wsums = [jnp.zeros((), jnp.float32)
+                               for _ in range(self.buckets)]
+                self._bucket_rng = np.random.default_rng([bucket_seed, 5])
+                self._bucket_order: list[int] = []
+                self._bucket_draws = 0   # assignment-stream position
+            else:
+                self.acc = jnp.zeros((p,), jnp.float32)
+                self.wsum = jnp.zeros((), jnp.float32)
             self.raw_wsum = 0.0          # un-discounted Σweight (stats)
         else:
             self.rows = np.zeros((capacity, p), np.float32)
+
+    def _next_bucket(self) -> int:
+        """Seeded bucket draw (caller holds _lock): refill with a fresh
+        permutation of range(B) every B inserts — even spread per
+        window, order unpredictable, deterministic per bucket_seed."""
+        if not self._bucket_order:
+            self._bucket_order = [int(b) for b in
+                                  self._bucket_rng.permutation(self.buckets)]
+        self._bucket_draws += 1
+        return self._bucket_order.pop()
+
+    def _peek_bucket(self) -> int:
+        """The bucket the NEXT accepted insert will take, without
+        consuming the draw — the screened fold needs the target
+        accumulator before admission is decided, and a quarantined row
+        must not advance the assignment stream (replaying only the
+        accepted rows then reproduces the same assignment)."""
+        if not self._bucket_order:
+            self._bucket_order = [int(b) for b in
+                                  self._bucket_rng.permutation(self.buckets)]
+        return self._bucket_order[-1]
+
+    def add_screened(self, row, weight: float, staleness: float,
+                     admission, *, sender: int = -1,
+                     version: Optional[int] = None):
+        """The ISSUE-9 defended insert: ONE fused jitted dispatch
+        screens the row (canary -> clip -> anomaly screen) and folds
+        the accepted contribution into the (bucketed) accumulator
+        (defense.UpdateAdmission.screened_fold).  Returns (admitted,
+        reason, full) — a quarantined row leaves the accumulator
+        bit-untouched, consumes no buffer slot and no bucket draw.
+        Streaming mode only."""
+        with self._lock:
+            if not self.streaming:
+                raise RuntimeError(
+                    "add_screened() on a drain-mode AsyncBuffer — the "
+                    "admission pipeline rides the streaming fold")
+            if self.count >= self.capacity:
+                raise RuntimeError("async buffer overflow: commit before add")
+            if self.buckets > 1:
+                b = self._peek_bucket()
+                ok, why, acc1, wsum1 = admission.screened_fold(
+                    self._accs[b], self._wsums[b], row, weight, staleness,
+                    sender=sender, version=version)
+                self._accs[b], self._wsums[b] = acc1, wsum1
+                if ok:
+                    self._bucket_order.pop()
+                    self._bucket_draws += 1
+            else:
+                ok, why, acc1, wsum1 = admission.screened_fold(
+                    self.acc, self.wsum, row, weight, staleness,
+                    sender=sender, version=version)
+                self.acc, self.wsum = acc1, wsum1
+            # no extra sync: screened_fold's host fetch of the admit
+            # flag already blocked on the whole fused program (one CPU
+            # executable — materializing any output means the fold that
+            # may alias the caller's row buffer has completed), so the
+            # row-recycling guarantee add() buys with
+            # wsum.block_until_ready() is already paid
+            if not ok:
+                return False, why, False
+            i = self.count
+            self.weights[i] = np.float32(weight)
+            self.staleness[i] = np.float32(staleness)
+            self.raw_wsum += float(weight)
+            self.count += 1
+            return True, why, self.count >= self.capacity
 
     def add(self, row: np.ndarray, weight: float, staleness: float) -> bool:
         """Insert one result; returns True when the buffer reached
@@ -360,16 +567,28 @@ class AsyncBuffer:
             self.weights[i] = np.float32(weight)
             self.staleness[i] = np.float32(staleness)
             if self.streaming:
-                self.acc, self.wsum = self._fold(
-                    self.acc, self.wsum,
-                    np.ascontiguousarray(row, np.float32),
-                    np.float32(weight), np.float32(staleness))
-                # jax on CPU may alias `row`'s host buffer zero-copy and
-                # dispatches asynchronously; block before returning so
-                # callers may recycle/overwrite the row (the decode
-                # pool's scratch free-list does exactly that — an unsynced
-                # fold would read a half-overwritten row)
-                self.wsum.block_until_ready()
+                if not isinstance(row, jax.Array):
+                    # device arrays (the admission pipeline's clipped
+                    # rows) feed the fold directly — no host detour
+                    row = np.ascontiguousarray(row, np.float32)
+                if self.buckets > 1:
+                    b = self._next_bucket()
+                    self._accs[b], self._wsums[b] = self._fold(
+                        self._accs[b], self._wsums[b], row,
+                        np.float32(weight), np.float32(staleness))
+                    # same row-recycling sync as the B=1 path below
+                    self._wsums[b].block_until_ready()
+                else:
+                    self.acc, self.wsum = self._fold(
+                        self.acc, self.wsum, row,
+                        np.float32(weight), np.float32(staleness))
+                    # jax on CPU may alias `row`'s host buffer zero-copy
+                    # and dispatches asynchronously; block before
+                    # returning so callers may recycle/overwrite the row
+                    # (the decode pool's scratch free-list does exactly
+                    # that — an unsynced fold would read a
+                    # half-overwritten row)
+                    self.wsum.block_until_ready()
                 self.raw_wsum += float(weight)
             else:
                 np.copyto(self.rows[i], row)
@@ -396,15 +615,50 @@ class AsyncBuffer:
     def take_stream(self):
         """(acc [P], wsum, weights [K], staleness [K], n_real, raw_wsum)
         — the streaming commit's inputs; resets the buffer.  Streaming
-        mode only."""
+        mode only (B = 1; a bucketed buffer hands back stacked state
+        via take_stream_buckets)."""
         with self._lock:
             if not self.streaming:
                 raise RuntimeError(
                     "take_stream() on a drain-mode AsyncBuffer — use drain()")
+            if self.buckets > 1:
+                raise RuntimeError(
+                    "take_stream() on a bucketed AsyncBuffer — use "
+                    "take_stream_buckets()")
             out = (self.acc, self.wsum, self.weights.copy(),
                    self.staleness.copy(), self.count, self.raw_wsum)
             self.acc = jnp.zeros((self.p,), jnp.float32)
             self.wsum = jnp.zeros((), jnp.float32)
+            self.raw_wsum = 0.0
+            self.weights[:] = 0.0
+            self.staleness[:] = 0.0
+            self.count = 0
+            return out
+
+    def take_stream_buckets(self):
+        """(accs [B,P], wsums [B], weights [K], staleness [K], n_real,
+        raw_wsum) — the bucketed robust commit's inputs; resets the
+        buffer.  Works for any streaming buffer (B = 1 stacks the PR-6
+        accumulator, so the degenerate-config pin runs through the SAME
+        bucket commit program it is pinned against)."""
+        with self._lock:
+            if not self.streaming:
+                raise RuntimeError("take_stream_buckets() on a drain-mode "
+                                   "AsyncBuffer — use drain()")
+            if self.buckets > 1:
+                accs = jnp.stack(self._accs)
+                wsums = jnp.stack(self._wsums)
+                self._accs = [jnp.zeros((self.p,), jnp.float32)
+                              for _ in range(self.buckets)]
+                self._wsums = [jnp.zeros((), jnp.float32)
+                               for _ in range(self.buckets)]
+            else:
+                accs = self.acc[None, :]
+                wsums = self.wsum[None]
+                self.acc = jnp.zeros((self.p,), jnp.float32)
+                self.wsum = jnp.zeros((), jnp.float32)
+            out = (accs, wsums, self.weights.copy(),
+                   self.staleness.copy(), self.count, self.raw_wsum)
             self.raw_wsum = 0.0
             self.weights[:] = 0.0
             self.staleness[:] = 0.0
@@ -422,10 +676,27 @@ class AsyncBuffer:
                       # StandardSave rejects np.int64(x) leaves
                       "count": np.asarray(self.count, np.int64)}
             if self.streaming:
-                common.update(
-                    acc=np.asarray(self.acc, np.float32).copy(),
-                    wsum=np.asarray(self.wsum, np.float32).copy(),
-                    raw_wsum=np.asarray(self.raw_wsum, np.float64))
+                if self.buckets > 1:
+                    # bucketed crash-resume (ISSUE 9): the stacked
+                    # accumulators ARE the round state — restore refuses
+                    # on a bucket-count change like the shape checks
+                    # below.  bucket_draws is the assignment stream's
+                    # position: a resumed buffer replays that many
+                    # seeded draws, so post-resume inserts continue the
+                    # SAME permutation schedule the crashed run was on
+                    common.update(
+                        acc=np.stack([np.asarray(a, np.float32)
+                                      for a in self._accs]),
+                        wsum=np.stack([np.asarray(w, np.float32)
+                                       for w in self._wsums]),
+                        raw_wsum=np.asarray(self.raw_wsum, np.float64),
+                        bucket_draws=np.asarray(self._bucket_draws,
+                                                np.int64))
+                else:
+                    common.update(
+                        acc=np.asarray(self.acc, np.float32).copy(),
+                        wsum=np.asarray(self.wsum, np.float32).copy(),
+                        raw_wsum=np.asarray(self.raw_wsum, np.float64))
             else:
                 common["rows"] = self.rows.copy()
             return common
@@ -443,7 +714,31 @@ class AsyncBuffer:
                       np.asarray(state["staleness"], np.float32))
             self.count = int(state["count"])
             if self.streaming:
-                if "acc" in state:
+                if "acc" in state and self.buckets > 1:
+                    acc = np.asarray(state["acc"], np.float32)
+                    if acc.shape != (self.buckets, self.p):
+                        raise ValueError(
+                            f"async buffer shape mismatch: checkpoint acc "
+                            f"{acc.shape} vs configured "
+                            f"({self.buckets}, {self.p}) (buckets or "
+                            f"model changed)")
+                    wsum = np.asarray(state["wsum"], np.float32)
+                    # copy=True for the same donation-safety reason as
+                    # the B=1 branch below
+                    self._accs = [jnp.array(acc[b], copy=True)
+                                  for b in range(self.buckets)]
+                    self._wsums = [jnp.array(wsum[b], copy=True)
+                                   for b in range(self.buckets)]
+                    self.raw_wsum = float(state.get(
+                        "raw_wsum", float(np.sum(self.weights))))
+                    # resume the assignment stream where the crashed
+                    # run left it — without the replay, post-resume
+                    # inserts would redraw a window the interrupted
+                    # permutation had already part-consumed
+                    for _ in range(int(state.get("bucket_draws", 0))):
+                        self._next_bucket()
+                    self._bucket_draws = int(state.get("bucket_draws", 0))
+                elif "acc" in state:
                     acc = np.asarray(state["acc"], np.float32)
                     if acc.shape != (self.p,):
                         raise ValueError(
@@ -465,17 +760,30 @@ class AsyncBuffer:
                     # drain-mode checkpoint into a streaming buffer:
                     # replay the saved rows through the fold — bitwise
                     # the accumulator those arrivals would have built
+                    # (bucketed buffers replay through their own seeded
+                    # assignment stream, exactly as live arrivals would)
                     rows = np.asarray(state["rows"], np.float32)
                     if rows.shape[1] != self.p:
                         raise ValueError(
                             f"async buffer shape mismatch: checkpoint rows "
                             f"{rows.shape} vs row width {self.p}")
-                    self.acc = jnp.zeros((self.p,), jnp.float32)
-                    self.wsum = jnp.zeros((), jnp.float32)
-                    for i in range(self.count):
-                        self.acc, self.wsum = self._fold(
-                            self.acc, self.wsum, rows[i],
-                            self.weights[i], self.staleness[i])
+                    if self.buckets > 1:
+                        self._accs = [jnp.zeros((self.p,), jnp.float32)
+                                      for _ in range(self.buckets)]
+                        self._wsums = [jnp.zeros((), jnp.float32)
+                                       for _ in range(self.buckets)]
+                        for i in range(self.count):
+                            b = self._next_bucket()
+                            self._accs[b], self._wsums[b] = self._fold(
+                                self._accs[b], self._wsums[b], rows[i],
+                                self.weights[i], self.staleness[i])
+                    else:
+                        self.acc = jnp.zeros((self.p,), jnp.float32)
+                        self.wsum = jnp.zeros((), jnp.float32)
+                        for i in range(self.count):
+                            self.acc, self.wsum = self._fold(
+                                self.acc, self.wsum, rows[i],
+                                self.weights[i], self.staleness[i])
                     self.raw_wsum = float(np.sum(self.weights[:self.count]))
                 else:
                     raise ValueError(

@@ -240,7 +240,7 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
                        reliable: bool = False,
                        chaos: Optional[dict] = None, chaos_seed: int = 0,
                        reliable_backoff: Optional[BackoffPolicy] = None,
-                       window: int = 4) -> dict:
+                       defense=None, window: int = 4) -> dict:
     """Saturate one server with `n_clients` concurrent uplinks until
     `warmup_commits + commits` commits land; returns the ingestion
     report.  `streaming=False, ingest_pool=0, decode_into=False` is the
@@ -311,7 +311,7 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
         template, total, buffer_k, 0, n_clients + 1, backend,
         staleness_mode="constant", mix=1.0, streaming=streaming,
         ingest_pool=ingest_pool, decode_into=decode_into,
-        redispatch=False, reliable=reliable, **kw)
+        redispatch=False, reliable=reliable, defense=defense, **kw)
     if policy is not None:
         server.com_manager.install_chaos(policy)
     if inbox_bound is not None and ingest_pool == 0:
@@ -439,6 +439,12 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
         # during warmup count too; the goodput ratio compares arms
         # under IDENTICAL accounting, so the window mismatch cancels)
         "reliable": bool(reliable),
+        # ISSUE-9 admission accounting: the screen-on overhead arm of
+        # `bench.py --mode attack` reads these (honest torture clients
+        # must see zero quarantines — the false-positive gate)
+        "defense": defense is not None,
+        "admission": (server._admission.report()
+                      if server._admission is not None else None),
         "chaos": dict(chaos) if chaos else None,
         "chaos_injected": policy.summary() if policy is not None else None,
         "retries": rob["reliable_retries"].value

@@ -195,6 +195,74 @@ def build_parser() -> argparse.ArgumentParser:
                    help="P(a crashed client ever rejoins)")
     p.add_argument("--async_rejoin_delay_s", type=float, default=5.0,
                    help="mean rejoin delay (exponential, simulated s)")
+    # adversarial robustness (ISSUE 9, fedml_tpu/async_/adversary.py +
+    # defense.py): a seeded byzantine cohort rides the lifecycle, and
+    # the server's admission pipeline + bucketed robust streaming
+    # commit defend the async aggregation.  PERF.md "Adversarial
+    # robustness".
+    p.add_argument("--attack_mode", type=str, default="none",
+                   choices=("none", "signflip", "boost", "gaussian",
+                            "labelflip", "backdoor", "mixed"),
+                   help="with --async: seeded byzantine-client attack — "
+                        "signflip reverses update directions, boost is "
+                        "scaled model replacement, gaussian adds noise, "
+                        "labelflip/backdoor poison the attackers' "
+                        "shards (data/poison.py), mixed = boost + "
+                        "labelflip (the acceptance arm)")
+    p.add_argument("--attack_frac", type=float, default=0.2,
+                   help="byzantine fraction of the fleet")
+    p.add_argument("--attack_boost", type=float, default=10.0,
+                   help="model-replacement scale (boost/mixed)")
+    p.add_argument("--attack_noise_std", type=float, default=1.0,
+                   help="gaussian-attack noise std")
+    p.add_argument("--attack_target_label", type=int, default=0,
+                   help="label-flip/backdoor target class")
+    p.add_argument("--attack_collude", action="store_true",
+                   help="colluding cohort: every byzantine client at a "
+                        "version sends the identical crafted row")
+    p.add_argument("--attack_stale", action="store_true",
+                   help="stale-attack: byzantine uplinks are timed to "
+                        "land at high staleness (--attack_stale_lag)")
+    p.add_argument("--attack_stale_lag", type=float, default=3.0,
+                   help="extra byzantine dispatch latency (sim seconds)")
+    p.add_argument("--attack_seed", type=int, default=0,
+                   help="adversary seed: same seed = same byzantine set "
+                        "and corruption streams")
+    p.add_argument("--defense_norm_bound", type=float, default=None,
+                   help="admission clip: client update deltas are "
+                        "norm-clipped to this bound at the insert path "
+                        "(the ONE clip definition norm_diff_clip/the "
+                        "pallas clip-agg share)")
+    p.add_argument("--defense_screen", action="store_true",
+                   help="arm the z-score + cosine anomaly screen "
+                        "against a running reference of accepted "
+                        "updates (quarantines instead of folding)")
+    p.add_argument("--defense_z_max", type=float, default=4.0,
+                   help="robust z threshold on the update-delta norm")
+    p.add_argument("--defense_cos_min", type=float, default=-1.0,
+                   help="cosine floor vs the accepted-direction "
+                        "reference (-1 disables; catches sign-flip)")
+    p.add_argument("--defense_warmup", type=int, default=8,
+                   help="accepted updates before the screen arms")
+    p.add_argument("--defense_buckets", type=int, default=1,
+                   help="bucketed robust streaming aggregation: B "
+                        "seeded bucket accumulators, committed via a "
+                        "robust combine ACROSS bucket means (O(B*P) "
+                        "memory; 1 + trim 0 = the exact PR-6 streaming "
+                        "commit)")
+    p.add_argument("--defense_combine", type=str, default="trimmed_mean",
+                   choices=("mean", "trimmed_mean", "median"),
+                   help="combine across bucket means")
+    p.add_argument("--defense_trim_k", type=int, default=0,
+                   help="buckets trimmed per side (trimmed_mean)")
+    p.add_argument("--defense_dp_clip", type=float, default=None,
+                   help="DP-FedAvg per-client clip S (uses the shared "
+                        "clip definition; required by --defense_dp_noise)")
+    p.add_argument("--defense_dp_noise", type=float, default=0.0,
+                   help="DP-FedAvg noise multiplier z: Gaussian noise "
+                        "sigma z*S/m added inside the jitted commit")
+    p.add_argument("--defense_seed", type=int, default=0,
+                   help="bucket-assignment seed")
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
     p.add_argument("--streaming", action="store_true",
                    help="host-resident client stack; upload only each "
@@ -436,6 +504,38 @@ def _stack_dtype(args):
         "bfloat16, or uint8)")
 
 
+def _attack_config(args):
+    """--attack_* flags -> AttackConfig (None when no attack)."""
+    if getattr(args, "attack_mode", "none") == "none":
+        return None
+    from fedml_tpu.async_ import AttackConfig
+    return AttackConfig(
+        mode=args.attack_mode, frac=args.attack_frac,
+        boost=args.attack_boost, noise_std=args.attack_noise_std,
+        target_label=args.attack_target_label,
+        collude=args.attack_collude, stale=args.attack_stale,
+        stale_lag=args.attack_stale_lag, seed=args.attack_seed)
+
+
+def _defense_config(args):
+    """--defense_* flags -> DefenseConfig (None when every stage is at
+    its defaults — the undefended PR-6 fast path stays untouched)."""
+    if not (args.defense_norm_bound is not None or args.defense_screen
+            or args.defense_buckets > 1 or args.defense_trim_k > 0
+            or args.defense_combine != "trimmed_mean"
+            or args.defense_dp_noise > 0.0
+            or args.defense_dp_clip is not None):
+        return None
+    from fedml_tpu.async_ import DefenseConfig
+    return DefenseConfig(
+        norm_bound=args.defense_norm_bound, screen=args.defense_screen,
+        z_max=args.defense_z_max, cos_min=args.defense_cos_min,
+        screen_warmup=args.defense_warmup, buckets=args.defense_buckets,
+        combine=args.defense_combine, trim_k=args.defense_trim_k,
+        dp_clip=args.defense_dp_clip, dp_noise=args.defense_dp_noise,
+        seed=args.defense_seed)
+
+
 def _build_async_engine(args, cfg: FedConfig, data):
     """--async: the buffered staleness-aware scheduler over the seeded
     lifecycle simulator (fedml_tpu/async_).  FedAvg/FedProx only — the
@@ -468,7 +568,9 @@ def _build_async_engine(args, cfg: FedConfig, data):
         staleness_b=args.async_staleness_b,
         mix=args.async_mix,
         round_deadline_s=args.async_round_deadline_s,
-        lifecycle_cfg=lc)
+        lifecycle_cfg=lc,
+        attack=_attack_config(args),
+        defense=_defense_config(args))
 
 
 def build_engine(args, cfg: FedConfig, data):
@@ -476,6 +578,12 @@ def build_engine(args, cfg: FedConfig, data):
     algo = args.algorithm
     if getattr(args, "async_mode", False):
         return _build_async_engine(args, cfg, data)
+    if (getattr(args, "attack_mode", "none") != "none"
+            or _defense_config(args) is not None):
+        logging.getLogger(__name__).warning(
+            "--attack_*/--defense_* reach only the --async engine "
+            "(the sync robust path is --algorithm fedavg_robust "
+            "--defense ...); ignored by %s", algo)
     mesh = None
     if args.mesh_batch is not None and args.mesh_batch < 1:
         raise SystemExit(f"--mesh_batch must be >= 1, got {args.mesh_batch}")

@@ -25,7 +25,8 @@ from fedml_tpu.core.topology import (
     SymmetricTopologyManager,
     AsymmetricTopologyManager,
 )
-from fedml_tpu.core.robust import norm_diff_clip, add_weak_dp_noise
+from fedml_tpu.core.robust import (norm_diff_clip, add_weak_dp_noise,
+                                   clip_scale, clip_row)
 
 __all__ = [
     "tree_weighted_mean", "tree_select", "tree_stack", "tree_unstack",
@@ -35,5 +36,5 @@ __all__ = [
     "partition_homo", "partition_dirichlet", "partition_power_law",
     "record_data_stats", "ClientSampler", "ClientTrainer", "TrainState",
     "SymmetricTopologyManager", "AsymmetricTopologyManager",
-    "norm_diff_clip", "add_weak_dp_noise",
+    "norm_diff_clip", "add_weak_dp_noise", "clip_scale", "clip_row",
 ]

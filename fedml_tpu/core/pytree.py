@@ -81,10 +81,32 @@ def tree_l2_norm(tree: Pytree) -> jax.Array:
     return jnp.sqrt(jnp.sum(jnp.stack(sq)))
 
 
+def clip_scale(sq_norm, max_norm):
+    """THE norm-clip factor:  min(1, τ/‖·‖)  from a SQUARED norm, with
+    the 1e-24 floor inside the sqrt guarding the zero-update case.
+
+    One definition, three call sites (the ISSUE-9 dedupe): the pytree
+    clip below (→ core/robust.norm_diff_clip), the pallas clip-agg's
+    host-side factor (ops/aggregate.robust_weighted_mean_pallas), and
+    the flat-row admission/DP clip (core/robust.clip_row →
+    async_/defense.py).  They reduce their squared norms differently
+    (tree-sum vs tile-accumulated vs flat dot), so the cross-pin in
+    tests/test_robustness.py holds on the FACTOR given equal sq_norm —
+    routing all three through here is what keeps the DP-FedAvg clip
+    and the admission clip from drifting."""
+    norm = jnp.sqrt(jnp.maximum(jnp.asarray(sq_norm, jnp.float32), 1e-24))
+    return jnp.minimum(1.0, max_norm / norm)
+
+
+def tree_sq_norm(tree: Pytree) -> jax.Array:
+    """Global squared L2 norm over all leaves (f32 accumulate)."""
+    sq = jax.tree.leaves(jax.tree.map(
+        lambda x: jnp.sum(jnp.square(x.astype(jnp.float32))), tree))
+    return jnp.sum(jnp.stack(sq))
+
+
 def tree_clip_by_norm(tree: Pytree, max_norm) -> Pytree:
-    norm = tree_l2_norm(tree)
-    scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
-    return tree_scale(tree, scale)
+    return tree_scale(tree, clip_scale(tree_sq_norm(tree), max_norm))
 
 
 def tree_cast(tree: Pytree, dtype) -> Pytree:

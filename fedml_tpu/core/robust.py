@@ -17,17 +17,35 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.core.pytree import tree_add, tree_clip_by_norm, tree_sub
+from fedml_tpu.core.pytree import (clip_scale, tree_add, tree_clip_by_norm,
+                                   tree_sub)
 
 Pytree = Any
+
+__all__ = ["norm_diff_clip", "clip_scale", "clip_row", "add_weak_dp_noise",
+           "krum_select_flat", "krum_scores_flat", "multi_krum_select_flat",
+           "default_multi_krum_m", "krum_select", "multi_krum_select",
+           "coordinate_median", "trimmed_mean"]
 
 
 def norm_diff_clip(local_params: Pytree, global_params: Pytree,
                    norm_bound: float) -> Pytree:
     """Clip the update (w_local - w_global) to `norm_bound` and re-apply:
-    returns w_global + clip(w_local - w_global)."""
+    returns w_global + clip(w_local - w_global).  The clip factor is the
+    ONE shared definition (core/pytree.clip_scale) — the pallas fused
+    clip-agg and the flat-row admission/DP clip use the same one."""
     diff = tree_sub(local_params, global_params)
     return tree_add(global_params, tree_clip_by_norm(diff, norm_bound))
+
+
+def clip_row(row: jax.Array, norm_bound: float) -> jax.Array:
+    """Flat-row norm clip: `row * clip_scale(‖row‖², bound)` — the
+    RowLayout-row form of norm_diff_clip's clip (callers pass the DELTA
+    row, i.e. uplink − global, and re-add the global themselves).  The
+    async admission pipeline and the DP-FedAvg per-client clip
+    (async_/defense.py) both resolve here, so the two cannot drift."""
+    row = jnp.asarray(row, jnp.float32)
+    return row * clip_scale(jnp.sum(row * row), norm_bound)
 
 
 def add_weak_dp_noise(params: Pytree, rng: jax.Array, stddev: float) -> Pytree:
@@ -57,6 +75,13 @@ def krum_scores_flat(flat: jax.Array, n_byzantine: int) -> jax.Array:
     n = flat.shape[0]
     k = max(n - n_byzantine - 2, 1)
     d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
+    # NaN/Inf guard: a non-finite row would otherwise poison EVERY
+    # pairwise distance it touches (NaN sorts unpredictably and argmin
+    # propagates it), letting one garbage uplink break the selection for
+    # honest clients too.  Non-finite distances become +inf: the bad row
+    # scores inf (never selected) and drops out of everyone else's
+    # k-nearest sums — for finite inputs this where() is the identity.
+    d2 = jnp.where(jnp.isfinite(d2) | jnp.eye(n, dtype=bool), d2, jnp.inf)
     return jnp.sum(jnp.sort(d2, axis=1)[:, :k], axis=1)
 
 

@@ -71,7 +71,11 @@ def poison_federated_data(data: FederatedData,
         if trigger_fn is not None:
             shards["x"][cid, bi, si] = trigger_fn(shards["x"][cid, bi, si])
         shards["y"][cid, bi, si] = target_label
-    return dataclasses.replace(data, client_shards=shards)
+    # fresh _device_cache: dataclasses.replace would otherwise SHARE the
+    # mutable cache dict with the source data — whichever object uploads
+    # its stack first would silently serve it to BOTH (a poisoned run
+    # reading clean tensors, or worse, a clean run reading poisoned ones)
+    return dataclasses.replace(data, client_shards=shards, _device_cache={})
 
 
 def load_edge_case_pool(data_dir: Optional[str], poison_type: str,
@@ -191,7 +195,8 @@ def poison_edge_case(data: FederatedData, attacker_ids: Sequence[int],
         bi, si = np.unravel_index(chosen, (B, bs))
         shards["x"][cid, bi, si] = pool[picks].astype(shards["x"].dtype)
         shards["y"][cid, bi, si] = target_label
-    return dataclasses.replace(data, client_shards=shards)
+    # fresh _device_cache — same shared-cache hazard as poison_federated_data
+    return dataclasses.replace(data, client_shards=shards, _device_cache={})
 
 
 def edge_case_test_shard(pool_test: np.ndarray, target_label: int,

@@ -80,6 +80,9 @@ CANONICAL_BUCKETS = {
     # one-way frame transit estimates (obs/propagate.py): LAN transits
     # are sub-ms like decodes, WAN ones spill into the seconds tail
     "trace_transit_seconds": DECODE_SECONDS_BUCKETS,
+    # the admission pipeline's per-row screen wall (async_/defense.py):
+    # one O(P) jitted step, sub-ms like a decode — same ladder
+    "defense_screen_seconds": DECODE_SECONDS_BUCKETS,
 }
 
 

@@ -175,8 +175,11 @@ def robust_weighted_mean_pallas(stacked: Pytree, weights: jax.Array,
         interpret=interpret,
     )(flat, gflat)
 
-    norms = jnp.sqrt(jnp.maximum(sq[:, 0], 1e-24))
-    clip = jnp.minimum(1.0, norm_bound / norms)
+    # the clip factor is the ONE shared definition (core/pytree.clip_scale
+    # — same 1e-24-floored sqrt), so this fused path, norm_diff_clip and
+    # the flat-row admission/DP clip cannot drift (ISSUE-9 dedupe)
+    from fedml_tpu.core.pytree import clip_scale
+    clip = clip_scale(sq[:, 0], norm_bound)
     w = weights.astype(jnp.float32)
     cf = (w / jnp.maximum(jnp.sum(w), 1e-12)) * clip
 

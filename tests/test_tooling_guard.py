@@ -274,6 +274,69 @@ def test_bench_json_schema_v7_carries_chaos_block():
             f"fedml_tpu/comm/{mod} (the ISSUE-8 robustness layer) is gone")
 
 
+def test_bench_json_schema_v8_carries_attack_block():
+    """ISSUE 9: schema v8 adds the attack-mode fields — the "attack"
+    block from `python bench.py --mode attack` with the attack x
+    defense accuracy "matrix", the mixed acceptance trio (clean_acc /
+    undefended_acc / defended_acc), the false-positive count, and the
+    admission-overhead pair whose throughput_ratio is the >=0.9x gate.
+    Static source check like the v3-v7 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 8, (
+        "bench schema must stay >= v8 (adversarial-robustness block)")
+    for field in ('"attack"', '"matrix"', '"overhead"', "_bench_attack",
+                  "clean_acc", "defended_acc", "undefended_acc",
+                  "false_positive_quarantines", "throughput_ratio",
+                  "quarantined_byzantine", "quarantined_honest"):
+        assert field in src, (
+            f"bench.py lost the v8 attack field {field} "
+            "(see fedml_tpu/async_/adversary.py + defense.py and "
+            "_bench_attack)")
+    # the block's accuracy rows come from the async engine's rollup and
+    # the torture report's admission block — names must stay in sync
+    sched = open(os.path.join(os.path.dirname(__file__), "..",
+                              "fedml_tpu", "async_", "scheduler.py")).read()
+    assert "quarantine_attribution" in sched, (
+        "AsyncFedAvgEngine lost quarantine_attribution — bench.py's v8 "
+        "attack block reads it")
+    defn = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "async_", "defense.py")).read()
+    assert "quarantined_total" in defn, (
+        "UpdateAdmission.report lost quarantined_total — bench.py's v8 "
+        "attack block reads it through async_report")
+    tort = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "async_", "torture.py")).read()
+    assert '"admission"' in tort, (
+        "run_ingest_torture's report lost the admission block — the v8 "
+        "overhead pair reads it")
+    # and the layer itself must exist
+    for mod in ("adversary.py", "defense.py"):
+        assert os.path.exists(os.path.join(
+            os.path.dirname(__file__), "..", "fedml_tpu", "async_", mod)), (
+            f"fedml_tpu/async_/{mod} (the ISSUE-9 robustness layer) is "
+            "gone")
+
+
+def test_chip_queue_carries_attack_ab():
+    """ISSUE 9: the next chip window must price the attack x defense
+    matrix — scripts/run_chip_queue.sh carries the ATTACK step (11/11)
+    and profile_bench.py defines the exp_ATTACK experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    assert "profile_bench.py ATTACK" in open(queue).read(), (
+        "run_chip_queue.sh lost the ATTACK adversarial-robustness A/B "
+        "(ISSUE 9 queues it for the next chip window)")
+    assert "exp_ATTACK" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_ATTACK experiment the queue runs")
+    import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+
+
 def test_chip_queue_carries_chaos_ab():
     """ISSUE 8: the next chip window must price the chaos goodput —
     scripts/run_chip_queue.sh carries the CHAOS step (10/10) and

@@ -298,6 +298,27 @@ def build_family_programs(donate: bool = True,
         out["async_commit"] = [
             ("commit", commit, (v, rows, w, s, jnp.float32(1.0)))]
 
+    if want("async_bucket_commit"):
+        # the ISSUE-9 bucketed robust streaming commit: B seeded bucket
+        # accumulators combined via a per-coordinate trimmed mean across
+        # bucket means, O(B·P) — pinned at 0 copy ops with variables,
+        # accs AND wsums donated (accs aliases the bucket_means stats
+        # passthrough), so the defense layer cannot silently reintroduce
+        # a params-sized copy into the ingestion hot path
+        import jax.numpy as jnp
+        from fedml_tpu.async_.staleness import (flat_dim,
+                                                make_bucket_commit_fn)
+        v = trainer.init(rng, jax.numpy.asarray(
+            data.client_shards["x"][0, 0]))
+        B = 4
+        commit = make_bucket_commit_fn(v, combine="trimmed_mean",
+                                       trim_k=1, donate=donate)
+        accs = jnp.zeros((B, flat_dim(v)), jnp.float32)
+        wsums = jnp.ones((B,), jnp.float32)
+        out["async_bucket_commit"] = [
+            ("bucket_commit", commit,
+             (v, accs, wsums, jnp.float32(1.0)))]
+
     if want("async_stream_commit"):
         # the streaming aggregation-on-arrival commit (ISSUE 6): the
         # [K, P] reduction already happened at arrival time (the jitted
@@ -321,7 +342,7 @@ def build_family_programs(donate: bool = True,
 ALL_FAMILIES = ("fedavg_resident", "fedavg_streaming", "fedavg_blockstream",
                 "fednova_resident", "robust_orderstat", "robust_blockstream",
                 "hierarchical", "gossip", "async_commit",
-                "async_stream_commit")
+                "async_stream_commit", "async_bucket_commit")
 
 
 def audit_families(families: list[str] | None = None,

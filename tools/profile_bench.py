@@ -14,6 +14,7 @@ Usage: python tools/profile_bench.py [A B F16 ...]
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -1086,6 +1087,34 @@ def exp_CHAOS():
               f"quarantined {r['quarantined']:.0f}  recv deaths "
               f"{r['recv_thread_deaths']:.0f}  injected "
               f"{r['chaos_injected']}", flush=True)
+
+
+def exp_ATTACK():
+    """Adversarial-robustness A/B (ISSUE 9): the attack x defense
+    accuracy matrix on the async MNIST-LR band workload (clean /
+    mixed-undefended / mixed-defended — the defended arm must stay in
+    band while undefended degrades, with zero honest quarantines), plus
+    the admission-overhead ingest pair (screen on vs off, 32 TCP
+    clients — the >=0.9x throughput gate) priced with the chip-attached
+    jax runtime driving the screen + fold + bucketed commit.  The same
+    sweep `bench.py --mode attack` runs; this entry queues it for chip
+    windows."""
+    import json as _json
+    import subprocess
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py"), "--mode", "attack"],
+        capture_output=True, text=True, timeout=3600)
+    print(out.stderr, flush=True)
+    line = (out.stdout.strip().splitlines() or ["{}"])[-1]
+    doc = _json.loads(line)
+    atk = doc.get("attack") or {}
+    print(f"ATTACK clean {atk.get('clean_acc')}  undefended "
+          f"{atk.get('undefended_acc')}  defended {atk.get('defended_acc')}"
+          f"  false-positives {atk.get('false_positive_quarantines')}  "
+          f"overhead ratio "
+          f"{(atk.get('overhead') or {}).get('throughput_ratio')}",
+          flush=True)
 
 
 def exp_U8():
