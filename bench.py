@@ -71,6 +71,16 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     and a "mixed" arm (5% loss + 1% dup + 0.5% corrupt — the
 #     acceptance shape) with its goodput_vs_clean headline; null in
 #     other modes, so v6 readers keep working
+# v9: + "serve" block (`python bench.py --mode serve`, ISSUE 10 —
+#     fedml_tpu/scale/): the million-client serving-spine bench — one
+#     row per simulated population (default 10k/100k/1M) from the
+#     virtual-time serve loop (scale/serve.py: sharded registry +
+#     streaming cohort sampler + trace-driven arrivals driving the
+#     PR-6 streaming buffer), each carrying committed_updates_per_sec,
+#     registry_bytes / registry_bytes_per_client (the <= ~100 B/client
+#     sub-linear-memory gate, recorded in "sublinear_ok"), sampler
+#     scratch bytes, rss_bytes and the virtual-time arrival stats;
+#     null in other modes, so v8 readers keep working
 # v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
 #     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
 #     attack x defense arms on the async MNIST-LR workload (each row:
@@ -83,7 +93,7 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 
 def _critical_path_doc():
@@ -194,7 +204,8 @@ def main() -> None:
     import argparse
     ap = argparse.ArgumentParser("bench")
     ap.add_argument("--mode",
-                    choices=("sync", "async", "ingest", "chaos", "attack"),
+                    choices=("sync", "async", "ingest", "chaos", "attack",
+                             "serve"),
                     default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
@@ -214,7 +225,12 @@ def main() -> None:
                          "fedml_tpu/async_/adversary.py + defense.py) "
                          "— attack x defense accuracy on the async "
                          "MNIST-LR workload plus the admission-screen "
-                         "ingest-overhead pair")
+                         "ingest-overhead pair; serve: the "
+                         "million-client serving-spine bench (ISSUE 10, "
+                         "fedml_tpu/scale/) — sustained committed-"
+                         "updates/sec and server registry memory vs "
+                         "simulated population (10k/100k/1M) under a "
+                         "trace-driven arrival process in virtual time")
     ap.add_argument("--ingest_clients", type=int, default=32,
                     help="ingest mode: concurrent uplink clients")
     ap.add_argument("--ingest_backend", default="TCP",
@@ -248,6 +264,29 @@ def main() -> None:
     ap.add_argument("--attack_seed", type=int, default=0,
                     help="attack mode: adversary seed (same seed = same "
                          "byzantine set + corruption streams)")
+    ap.add_argument("--serve_populations", default="10000,100000,1000000",
+                    help="serve mode: comma-separated simulated client "
+                         "populations (one bench row each)")
+    ap.add_argument("--serve_commits", type=int, default=40,
+                    help="serve mode: streaming commits per population "
+                         "arm (K updates each)")
+    ap.add_argument("--serve_buffer_k", type=int, default=32,
+                    help="serve mode: streaming buffer capacity K")
+    ap.add_argument("--serve_row_dim", type=int, default=4096,
+                    help="serve mode: flat update-row width P the fold "
+                         "and commit run at")
+    ap.add_argument("--serve_sampler", default="stratified",
+                    choices=("uniform", "reservoir", "stratified"),
+                    help="serve mode: cohort sampler over the registry "
+                         "(stratified = O(k)-per-draw, the spine "
+                         "default; reservoir = exact-uniform one-pass)")
+    ap.add_argument("--serve_arrivals", default="diurnal",
+                    choices=("constant", "diurnal", "flash"),
+                    help="serve mode: arrival-process family driving "
+                         "the virtual clock")
+    ap.add_argument("--serve_seed", type=int, default=0,
+                    help="serve mode: one seed drives sampler, arrivals "
+                         "and fault draws (same seed = same trace)")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -270,6 +309,7 @@ def main() -> None:
             "ingest": None,
             "chaos": None,
             "attack": None,
+            "serve": None,
             "critical_path": None,
             "error": "chip_unavailable",
             "detail": detail,
@@ -293,6 +333,9 @@ def main() -> None:
         return
     if args.mode == "attack":
         _bench_attack(args)
+        return
+    if args.mode == "serve":
+        _bench_serve(args)
         return
     import jax.numpy as jnp
 
@@ -399,6 +442,7 @@ def main() -> None:
         "ingest": None,
         "chaos": None,
         "attack": None,
+        "serve": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -482,6 +526,7 @@ def _bench_async(cfg, data, trainer) -> None:
         "ingest": None,
         "chaos": None,
         "attack": None,
+        "serve": None,
         # v6: commit-to-commit stage attribution from the scheduler's
         # spans (train waves / commits / eval + wait); null untraced
         "critical_path": _critical_path_doc(),
@@ -568,6 +613,7 @@ def _bench_ingest(args) -> None:
         "rounds": [],
         "async": None,
         "attack": None,
+        "serve": None,
         "ingest": {
             "backend": legacy["backend"],
             "n_clients": legacy["n_clients"],
@@ -692,6 +738,7 @@ def _bench_chaos(args) -> None:
         "async": None,
         "ingest": None,
         "attack": None,
+        "serve": None,
         "chaos": {
             "backend": clean["backend"],
             "n_clients": clean["n_clients"],
@@ -851,6 +898,7 @@ def _bench_attack(args) -> None:
         "async": None,
         "ingest": None,
         "chaos": None,
+        "serve": None,
         "attack": {
             "workload": "async_mnist_lr (quality-band shape, K=8, "
                         "conc 16, poly a=0.5)",
@@ -877,6 +925,114 @@ def _bench_attack(args) -> None:
                 "screen_on_quarantined":
                     on["admission"]["quarantined_total"],
             },
+        },
+        "critical_path": _critical_path_doc(),
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+# serve-mode shape (ISSUE 10): one virtual-time serve-loop arm per
+# simulated population, same buffer/arrival/sampler config across arms,
+# so the table isolates POPULATION — the north star's heavy-traffic
+# axis.  The sub-linear gate is the registry's allocated bytes per
+# client (<= ~100 B; 29 B at the current field set), asserted per arm.
+SERVE_WARMUP_COMMITS = 4
+SERVE_BYTES_PER_CLIENT_GATE = 100.0
+
+
+def _bench_serve(args) -> None:
+    """Million-client serving-spine bench (ISSUE 10, fedml_tpu/scale/):
+    sustained committed-updates/sec and server memory versus simulated
+    client population.  Each arm drives the REAL PR-6 streaming
+    buffer/commit through the sharded registry + streaming cohort
+    sampler under a seeded arrival process in virtual time; client
+    compute is out of scope (pre-generated update rows), so the wall
+    prices the SERVER round hot path.  Gates: registry bytes/client
+    <= ~100 at every population (sub-linear memory), updates/sec
+    sustained (the 1M arm within 2x of the 10k arm on a healthy
+    box)."""
+    from fedml_tpu import obs
+    from fedml_tpu.scale import ArrivalConfig, run_serve_sim
+
+    pops = sorted(int(p) for p in str(args.serve_populations).split(",")
+                  if p.strip())
+    if not pops or pops[0] < 1:
+        raise SystemExit(
+            f"--serve_populations must be a comma-separated list of "
+            f"positive client counts, got {args.serve_populations!r}")
+    # sorted above: the headline row and sustain_ratio_vs_smallest
+    # assume rows[-1] is the LARGEST population
+    arrival = ArrivalConfig(mode=args.serve_arrivals, rate=2000.0,
+                            period_s=600.0, amplitude=0.8,
+                            flash_at_s=5.0, flash_duration_s=10.0,
+                            flash_boost=5.0, seed=args.serve_seed)
+    rows = []
+    for pop in pops:
+        rep = run_serve_sim(
+            pop, commits=args.serve_commits,
+            warmup_commits=SERVE_WARMUP_COMMITS,
+            buffer_k=args.serve_buffer_k, row_dim=args.serve_row_dim,
+            sampler_mode=args.serve_sampler, arrival=arrival,
+            dropout_prob=0.02, banned_frac=0.01, seed=args.serve_seed)
+        rep["sublinear_ok"] = bool(
+            rep["registry_bytes_per_client"] <= SERVE_BYTES_PER_CLIENT_GATE)
+        print(f"serve pop={pop}: "
+              f"{rep['committed_updates_per_sec']:.0f} updates/s  "
+              f"registry {rep['registry_bytes'] / 1e6:.1f} MB "
+              f"({rep['registry_bytes_per_client']:.1f} B/client)  "
+              f"rss {rep['rss_bytes'] / 1e6:.0f} MB  virtual "
+              f"{rep['virtual_time_s']:.1f}s", file=sys.stderr)
+        rows.append(rep)
+    head = rows[-1]            # the largest population is the headline
+    doc = _stamp({
+        "metric": (f"serve_spine_{head['population']}clients_"
+                   "committed_updates_per_sec"),
+        "value": round(head["committed_updates_per_sec"], 4),
+        "unit": "updates/sec",
+        # the in-schema comparison is across the population arms
+        "vs_baseline": None,
+        "mode": "serve",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": None,
+        "chaos": None,
+        "attack": None,
+        "serve": {
+            "buffer_k": args.serve_buffer_k,
+            "row_dim": args.serve_row_dim,
+            "sampler_mode": args.serve_sampler,
+            "arrival_mode": args.serve_arrivals,
+            "commits": args.serve_commits,
+            "seed": args.serve_seed,
+            "bytes_per_client_gate": SERVE_BYTES_PER_CLIENT_GATE,
+            "populations": [{
+                "population": r["population"],
+                "committed_updates_per_sec": round(
+                    r["committed_updates_per_sec"], 4),
+                "registry_bytes": r["registry_bytes"],
+                "registry_bytes_per_client": round(
+                    r["registry_bytes_per_client"], 2),
+                "registry_shards_allocated":
+                    r["registry_shards_allocated"],
+                "sampler_peak_scratch_bytes":
+                    r["sampler_peak_scratch_bytes"],
+                "rss_bytes": r["rss_bytes"],
+                "virtual_time_s": round(r["virtual_time_s"], 3),
+                "mean_arrival_rate": round(r["mean_arrival_rate"], 2),
+                "crashed": r["crashed"],
+                "banned": r["banned"],
+                "sublinear_ok": r["sublinear_ok"],
+            } for r in rows],
+            "sublinear_ok": all(r["sublinear_ok"] for r in rows),
+            "sustain_ratio_vs_smallest": round(
+                head["committed_updates_per_sec"]
+                / rows[0]["committed_updates_per_sec"], 4)
+                if rows[0]["committed_updates_per_sec"] > 0 else None,
         },
         "critical_path": _critical_path_doc(),
     })

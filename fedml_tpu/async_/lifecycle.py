@@ -48,6 +48,8 @@ from fedml_tpu.async_.staleness import (AsyncBuffer, RowLayout, flat_dim,
                                         make_commit_fn,
                                         make_stream_commit_fn,
                                         unflatten_rows)
+from fedml_tpu.scale.registry import BANNED as _REG_BANNED
+from fedml_tpu.scale.registry import ClientRegistry
 
 log = logging.getLogger(__name__)
 Pytree = Any
@@ -281,9 +283,13 @@ class AsyncServerManager(ServerManager):
                                           donate=False)
         self._lock = threading.Lock()
         self._watchdog: Optional[threading.Timer] = None
-        # rank -> version of its outstanding dispatch (None = idle)
-        self._outstanding: dict[int, Optional[int]] = {
-            r: None for r in range(1, size)}
+        # ISSUE 10: per-rank dispatch/participation state lives in the
+        # sharded client registry (scale/registry.py) instead of the
+        # PR-5 `_outstanding` dict — the `outstanding` field carries
+        # the in-flight version (-1 idle), participation/staleness/
+        # quarantine counters ride the same shards, and the whole thing
+        # checkpoints through _ckpt_state like the reliability ledger.
+        self.registry = ClientRegistry(size)
         self.done = threading.Event()
         self._m_occupancy = obs.gauge("async_buffer_occupancy")
         self._m_staleness = obs.histogram(
@@ -320,6 +326,13 @@ class AsyncServerManager(ServerManager):
                 if rel is not None and "reliable" in extra:
                     rel.import_seq_state(
                         jax.tree.map(np.asarray, extra["reliable"]))
+                if "registry" in extra:
+                    # per-rank participation/staleness/quarantine
+                    # counters survive the crash; in-flight markers are
+                    # transient (send_start() re-dispatches everyone)
+                    self.registry.load_state(
+                        jax.tree.map(np.asarray, extra["registry"]))
+                    self.registry.reset_transient()
                 if self._admission is not None:
                     if "defense" in extra:
                         # the screen resumes ARMED: its running
@@ -385,7 +398,11 @@ class AsyncServerManager(ServerManager):
                                              np.int64),
                "degraded_commits": np.asarray(self.degraded_commits,
                                               np.int64),
-               "reliable": rel_state}
+               "reliable": rel_state,
+               # ISSUE 10: registry shards (participation/staleness/
+               # quarantine/outstanding per rank) ride the checkpoint —
+               # shape-stable stacked arrays, orbax-friendly
+               "registry": self.registry.state()}
         if self._admission is not None:
             # bucket accumulators ride the buffer state above; the
             # admission pipeline's running reference rides here
@@ -425,7 +442,8 @@ class AsyncServerManager(ServerManager):
         msg.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, self.variables)
         msg.add_params(AsyncMessage.MSG_ARG_KEY_CLIENT_INDEX, rank - 1)
         msg.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, self.version)
-        self._outstanding[rank] = self.version
+        if self.registry.contains(rank):
+            self.registry.note_dispatch_one(rank, self.version)
         self.send_message(msg)
 
     # -- FSM -----------------------------------------------------------------
@@ -521,6 +539,7 @@ class AsyncServerManager(ServerManager):
             if self.done.is_set():
                 return                      # late straggler after shutdown
             staleness = float(self.version - dispatched)
+            known = self.registry.contains(sender)
             if self._admission is not None:
                 # ISSUE-9 admission gate at the ONE insert path: finite
                 # canary -> shared-definition norm clip -> z/cosine
@@ -535,8 +554,14 @@ class AsyncServerManager(ServerManager):
                         row, weight, staleness, self._admission,
                         sender=sender, version=dispatched)
                 if not ok:
-                    self._outstanding[sender] = None
-                    if self.redispatch:
+                    banned = False
+                    if known:
+                        self.registry.note_return(sender)
+                        # True when the quarantine counter crossed the
+                        # registry's ban threshold — a banned sender
+                        # must NOT be redispatched (the ban contract)
+                        banned = self.registry.note_quarantine(sender)
+                    if self.redispatch and not banned:
                         self._redispatch_locked([sender])
                     return
             else:
@@ -548,7 +573,10 @@ class AsyncServerManager(ServerManager):
             self.staleness_seen.append(staleness)
             self._m_staleness.observe(staleness)
             self._m_occupancy.set(self.buffer.count)
-            self._outstanding[sender] = None
+            if known:
+                self.registry.note_return(sender)
+                self.registry.note_contribution(sender, staleness,
+                                                self.version)
             if not full:
                 # the contributing client would idle until the next
                 # commit; async has no barrier, so hand it work now
@@ -586,8 +614,7 @@ class AsyncServerManager(ServerManager):
                 # client steer the model during a partition
                 if self.redispatch:
                     self._redispatch_locked(
-                        [r for r, v in self._outstanding.items()
-                         if v is not None])
+                        [int(r) for r in self.registry.outstanding_ids()])
                 self._arm_watchdog(self.version)
                 return
             last = self._commit_locked(deadline_fired=True)
@@ -669,9 +696,11 @@ class AsyncServerManager(ServerManager):
         # ranks whose outstanding dispatch predates the PREVIOUS
         # version — two commits without a reply reads as a crash
         if self.redispatch:
-            retry = [r for r, v in self._outstanding.items()
-                     if v is None or (deadline_fired
-                                      and v < self.version - 1)]
+            ranks = np.arange(1, self.size, dtype=np.int64)
+            out = self.registry.outstanding_of(ranks)
+            retry = [int(r) for r, v in zip(ranks, out)
+                     if v < 0 or (deadline_fired
+                                  and v < self.version - 1)]
             self._redispatch_locked(retry)
         if self.deadline_s is not None:
             self._arm_watchdog(self.version)
@@ -679,6 +708,10 @@ class AsyncServerManager(ServerManager):
 
     def _redispatch_locked(self, ranks) -> None:
         for r in ranks:
+            if (self.registry.contains(r) and int(
+                    self.registry.status_of([r])[0]) == _REG_BANNED):
+                continue        # banned = never dispatched again (all
+                #                 call sites funnel through here)
             self._m_redispatch.inc()
             self._dispatch(r)
 

@@ -50,6 +50,10 @@ from fedml_tpu.async_.staleness import (AsyncBuffer, STALENESS_MODES,
                                         flat_dim, flatten_stacked_rows,
                                         make_bucket_commit_fn,
                                         make_commit_fn)
+from fedml_tpu.scale import registry as _reg
+from fedml_tpu.scale.arrivals import (ArrivalConfig, ArrivalProcess,
+                                      make_arrivals)
+from fedml_tpu.scale.registry import ClientRegistry
 
 log = logging.getLogger(__name__)
 Pytree = Any
@@ -90,7 +94,9 @@ class AsyncFedAvgEngine(FedAvgEngine):
                  lifecycle_cfg: Optional[LifecycleConfig] = None,
                  async_seed: Optional[int] = None, donate: bool = True,
                  attack: Optional[AttackConfig] = None,
-                 defense: Optional[DefenseConfig] = None):
+                 defense: Optional[DefenseConfig] = None,
+                 shardstore=None,
+                 arrivals: Optional[object] = None):
         if staleness not in STALENESS_MODES:
             raise ValueError(f"unknown staleness mode {staleness!r} "
                              f"(choose one of {STALENESS_MODES})")
@@ -136,6 +142,18 @@ class AsyncFedAvgEngine(FedAvgEngine):
         # wave width (waves are buffer_k-sized in steady state)
         self._train_wave = jax.jit(jax.vmap(
             self._one_client, in_axes=(None, 0, 0)))
+        # ISSUE 10: the sharded client registry replaces the per-client
+        # Python containers (free/dead sets, in_flight dict, the
+        # staleness/contribution numpy arrays) — O(cohort) touches per
+        # wave, O(1) aggregate reads, checkpointable shards.  An
+        # optional ShardStore supplies cohorts on demand (no all-client
+        # stack), and an arrival process modulates dispatch turnaround
+        # with the load curve (scale/arrivals.py).
+        self.registry = ClientRegistry(self.sampler.client_num_in_total)
+        self._shardstore = shardstore
+        if isinstance(arrivals, ArrivalConfig):
+            arrivals = make_arrivals(arrivals)
+        self._arrivals: Optional[ArrivalProcess] = arrivals
         self._rows_fn = jax.jit(flatten_stacked_rows)
         self._flat_fn = make_flatten_fn()
         self._commit_fn = None        # built per variables template
@@ -162,18 +180,18 @@ class AsyncFedAvgEngine(FedAvgEngine):
     # -- async server state (checkpoint payload) ------------------------------
     def async_state(self) -> dict:
         """Checkpointable async server state: buffer contents + version +
-        per-client staleness counters (utils/checkpoint.py extra_state).
-        The event clock/heap is NOT part of it — a resumed run restarts
-        the lifecycle clock but keeps every buffered result and
-        staleness statistic.  Defended runs additionally carry the
-        bucket accumulators (inside the buffer state) and the admission
+        the sharded client registry (participation/staleness/quarantine
+        counters — utils/checkpoint.py extra_state).  The event
+        clock/heap is NOT part of it — a resumed run restarts the
+        lifecycle clock but keeps every buffered result and staleness
+        statistic.  Defended runs additionally carry the bucket
+        accumulators (inside the buffer state) and the admission
         pipeline's running reference, so a resumed screen stays armed."""
         self._ensure_buffer()
         out = {
             "buffer": self._buffer.state(),
             "version": np.asarray(self.version, np.int64),
-            "client_last_staleness": self._client_last_staleness.copy(),
-            "client_contribs": self._client_contribs.copy(),
+            "registry": self.registry.state(),
         }
         if self._admission is not None:
             out["defense"] = self._admission.state()
@@ -183,16 +201,30 @@ class AsyncFedAvgEngine(FedAvgEngine):
         self._ensure_buffer()
         self._buffer.load_state(state["buffer"])
         self.version = int(state["version"])
-        self._client_last_staleness = np.asarray(
-            state["client_last_staleness"], np.float32).copy()
-        self._client_contribs = np.asarray(
-            state["client_contribs"], np.int64).copy()
+        if "registry" in state:
+            self.registry.load_state(
+                jax.tree.map(np.asarray, state["registry"]))
+        elif "client_contribs" in state:
+            # pre-PR-10 checkpoint: migrate the two flat per-client
+            # arrays into registry counters (last_seen is not
+            # reconstructible — defaults to -1)
+            contribs = np.asarray(state["client_contribs"], np.int64)
+            stale = np.asarray(state["client_last_staleness"], np.float32)
+            for cid in np.flatnonzero(contribs):
+                s, loc = divmod(int(cid), self.registry.shard_size)
+                sh = self.registry._alloc(s)
+                sh["participation"][loc] = contribs[cid]
+                sh["last_staleness"][loc] = stale[cid]
+        else:
+            raise ValueError(
+                "async checkpoint carries neither 'registry' (PR 10) "
+                "nor the legacy per-client arrays — not an async "
+                "server state")
         if self._admission is not None and "defense" in state:
             self._admission.load_state(state["defense"])
 
     def _ensure_buffer(self) -> None:
         if getattr(self, "_buffer", None) is None:
-            n = self.sampler.client_num_in_total
             if self.defense is not None:
                 # defended path: streaming bucketed buffer — the robust
                 # commit needs B accumulators, and the staleness
@@ -213,8 +245,6 @@ class AsyncFedAvgEngine(FedAvgEngine):
                                           self.staleness_b)
             else:
                 self._buffer = AsyncBuffer(self.buffer_k, self._flat_dim())
-            self._client_last_staleness = np.zeros(n, np.float32)
-            self._client_contribs = np.zeros(n, np.int64)
 
     def _flat_dim(self) -> int:
         if self._p is None:
@@ -275,9 +305,13 @@ class AsyncFedAvgEngine(FedAvgEngine):
         now = 0.0
         wave_idx = self.version     # == start_version on resume; also
         #                             covers a manual load_async_state
-        in_flight: dict[int, int] = {}       # client -> dispatched version
-        dead: set[int] = set()               # crashed, awaiting rejoin/never
-        free = set(range(self.sampler.client_num_in_total))
+        # ISSUE 10: client scheduling state lives in the sharded
+        # registry — FREE/IN_FLIGHT/CRASHED/DEAD statuses + the
+        # dispatched version per client, no per-client Python objects.
+        # A (re)started run re-pools everything transient; counters
+        # (participation/staleness/quarantine) survive a resume.
+        reg = self.registry
+        reg.reset_transient()
         last_commit_t = 0.0
         deadline_armed_version = -1
         t_wall0 = time.perf_counter()
@@ -293,17 +327,23 @@ class AsyncFedAvgEngine(FedAvgEngine):
             flattened to buffer rows on device and scheduled as arrival
             events at their lifecycle latencies."""
             nonlocal wave_idx
-            slots = self.concurrency - len(in_flight)
-            if slots <= 0 or not free:
+            slots = self.concurrency - reg.count_in_flight
+            if slots <= 0 or reg.count_free == 0:
                 return
-            ids = [int(i) for i in self.sampler.sample(wave_idx)
-                   if int(i) in free][:slots]
-            if not ids:     # the draw missed every free client: take the
-                ids = sorted(free)[:slots]   # pool directly (deterministic)
+            # sample_fast: the non-mutating bitwise twin of the
+            # reference draw (core/sampling.py, ISSUE 10) — same
+            # cohorts, no global-RNG reseed per wave
+            draw = self.sampler.sample_fast(wave_idx)
+            ids = draw[reg.status_of(draw) == _reg.FREE][:slots]
+            if ids.size == 0:   # the draw missed every free client:
+                ids = reg.free_ids(slots)     # take the pool directly
+            ids = [int(i) for i in ids]
             w_rng, _ = jax.random.split(
                 jax.random.fold_in(rng_base, wave_idx))
             crngs = jax.random.split(w_rng, len(ids))
-            cohort, _ = self.data.cohort(np.asarray(ids, np.int64))
+            store = (self._shardstore if self._shardstore is not None
+                     else self.data)
+            cohort, _ = store.cohort(np.asarray(ids, np.int64))
             with obs.span("async.wave", wave=wave_idx, clients=len(ids),
                           version=self.version):
                 stacked, _losses, ns = self._train_wave(
@@ -314,15 +354,13 @@ class AsyncFedAvgEngine(FedAvgEngine):
                     and self._adversary.attacks_model() else None)
             self._m_dispatches.inc(len(ids))
             for lane, cid in enumerate(ids):
-                free.discard(cid)
                 if lifecycle.draw_crash(cid):
                     self.trace.append(("crash", round(now, 9), cid,
                                        self.version))
                     obs.counter("async_dropouts_total").inc()
                     delay = lifecycle.draw_rejoin_delay(cid)
-                    if delay is None:
-                        dead.add(cid)        # gone for good
-                    else:
+                    reg.note_crash(cid, rejoins=delay is not None)
+                    if delay is not None:
                         push(now + delay, _REJOIN, cid)
                     continue
                 row = rows[lane]
@@ -336,8 +374,14 @@ class AsyncFedAvgEngine(FedAvgEngine):
                         cid, row, g_np, self.version)
                     self.trace.append(("attack", round(now, 9), cid,
                                        self.version))
-                in_flight[cid] = self.version
+                reg.note_dispatch_one(cid, self.version)
                 lat = lifecycle.draw_latency(cid)
+                if self._arrivals is not None:
+                    # ISSUE 10: the arrival process shapes turnaround —
+                    # at the trough of the load curve the fleet answers
+                    # slower (pure function of virtual time, so seeded
+                    # determinism survives)
+                    lat *= self._arrivals.slowdown(now)
                 if self._adversary is not None:
                     # stale-attack: byzantine uplinks deliberately land
                     # several commits late, where the staleness
@@ -417,7 +461,7 @@ class AsyncFedAvgEngine(FedAvgEngine):
                     dispatch_wave()        # must not train a dead wave
                 while self.version < total:
                     if not heap:
-                        if free and not in_flight:
+                        if reg.count_free > 0 and reg.count_in_flight == 0:
                             # crash-starved: every in-flight dispatch
                             # died, but clients rejoined — start a wave
                             dispatch_wave()
@@ -429,18 +473,18 @@ class AsyncFedAvgEngine(FedAvgEngine):
                             f"async scheduler deadlock at version "
                             f"{self.version}/{total}: buffer "
                             f"{self._buffer.count}/{self.buffer_k}, "
-                            f"{len(dead)} clients dead with no rejoin, "
-                            f"{len(free)} free but undispatchable")
+                            f"{reg.count_dead} clients dead with no "
+                            f"rejoin, {reg.count_free} free but "
+                            f"undispatchable")
                     t, kind, _s, payload = heapq.heappop(heap)
                     now = max(now, t)
                     if kind == _REJOIN:
                         cid = payload
-                        dead.discard(cid)
-                        free.add(cid)
+                        reg.note_rejoin(cid)
                         self.trace.append(("rejoin", round(now, 9), cid,
                                            self.version))
                         obs.counter("async_rejoins_total").inc()
-                        if not in_flight:
+                        if reg.count_in_flight == 0:
                             dispatch_wave()
                         continue
                     if kind == _DEADLINE:
@@ -450,8 +494,7 @@ class AsyncFedAvgEngine(FedAvgEngine):
                             commit(deadline_fired=True)
                         continue
                     cid, row, n = payload
-                    dispatched_v = in_flight.pop(cid)
-                    free.add(cid)
+                    dispatched_v = reg.note_return(cid)
                     staleness = float(self.version - dispatched_v)
                     self.trace.append(("arrive", round(now, 9), cid,
                                        self.version, staleness))
@@ -466,14 +509,14 @@ class AsyncFedAvgEngine(FedAvgEngine):
                             row, n, staleness, self._admission,
                             sender=cid, version=int(dispatched_v))
                         if not ok:
+                            reg.note_quarantine(cid)
                             self.trace.append(
                                 ("quarantine", round(now, 9), cid, why))
                             continue
                     else:
                         full = self._buffer.add(row, n, staleness)
                     self.staleness_committed.append(staleness)
-                    self._client_last_staleness[cid] = staleness
-                    self._client_contribs[cid] += 1
+                    reg.note_contribution(cid, staleness, self.version)
                     self._m_staleness.observe(staleness)
                     self._m_occupancy.set(self._buffer.count)
                     if full:

@@ -195,6 +195,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="P(a crashed client ever rejoins)")
     p.add_argument("--async_rejoin_delay_s", type=float, default=5.0,
                    help="mean rejoin delay (exponential, simulated s)")
+    # million-client serving spine (ISSUE 10, fedml_tpu/scale/):
+    # trace-driven arrival processes shape the async lifecycle's
+    # turnaround with a load curve — at the trough of the diurnal cycle
+    # (or outside a flash crowd) the fleet answers slower, so staleness
+    # and deadline behavior see production load shapes.  The standalone
+    # heavy-traffic bench is `python bench.py --mode serve`.
+    p.add_argument("--arrival_process", type=str, default="none",
+                   choices=("none", "constant", "diurnal", "flash",
+                            "trace"),
+                   help="with --async: load-curve family modulating "
+                        "dispatch turnaround (fedml_tpu/scale/"
+                        "arrivals.py) — diurnal sinusoid, flash-crowd "
+                        "burst, or a replayed timestamp trace")
+    p.add_argument("--arrival_rate", type=float, default=100.0,
+                   help="base arrivals/sec of the load curve "
+                        "(virtual seconds)")
+    p.add_argument("--arrival_period_s", type=float, default=86400.0,
+                   help="diurnal period (simulated seconds)")
+    p.add_argument("--arrival_amplitude", type=float, default=0.8,
+                   help="diurnal swing in [0, 1)")
+    p.add_argument("--arrival_flash_at", type=float, default=300.0,
+                   help="flash-crowd onset (simulated seconds)")
+    p.add_argument("--arrival_flash_duration", type=float, default=60.0,
+                   help="flash-crowd duration (simulated seconds)")
+    p.add_argument("--arrival_flash_boost", type=float, default=10.0,
+                   help="flash-crowd rate multiplier")
+    p.add_argument("--arrival_trace", type=str, default=None,
+                   help="replayed-trace file: one arrival timestamp "
+                        "per line (--arrival_process trace)")
     # adversarial robustness (ISSUE 9, fedml_tpu/async_/adversary.py +
     # defense.py): a seeded byzantine cohort rides the lifecycle, and
     # the server's admission pipeline + bucketed robust streaming
@@ -536,6 +565,20 @@ def _defense_config(args):
         seed=args.defense_seed)
 
 
+def _arrival_config(args):
+    """--arrival_* flags -> ArrivalConfig (None when mode is 'none')."""
+    if getattr(args, "arrival_process", "none") == "none":
+        return None
+    from fedml_tpu.scale import ArrivalConfig
+    return ArrivalConfig(
+        mode=args.arrival_process, rate=args.arrival_rate,
+        period_s=args.arrival_period_s, amplitude=args.arrival_amplitude,
+        flash_at_s=args.arrival_flash_at,
+        flash_duration_s=args.arrival_flash_duration,
+        flash_boost=args.arrival_flash_boost,
+        trace_path=args.arrival_trace, seed=args.seed)
+
+
 def _build_async_engine(args, cfg: FedConfig, data):
     """--async: the buffered staleness-aware scheduler over the seeded
     lifecycle simulator (fedml_tpu/async_).  FedAvg/FedProx only — the
@@ -570,7 +613,8 @@ def _build_async_engine(args, cfg: FedConfig, data):
         round_deadline_s=args.async_round_deadline_s,
         lifecycle_cfg=lc,
         attack=_attack_config(args),
-        defense=_defense_config(args))
+        defense=_defense_config(args),
+        arrivals=_arrival_config(args))
 
 
 def build_engine(args, cfg: FedConfig, data):
@@ -584,6 +628,10 @@ def build_engine(args, cfg: FedConfig, data):
             "--attack_*/--defense_* reach only the --async engine "
             "(the sync robust path is --algorithm fedavg_robust "
             "--defense ...); ignored by %s", algo)
+    if getattr(args, "arrival_process", "none") != "none":
+        logging.getLogger(__name__).warning(
+            "--arrival_* reaches only the --async engine (sync rounds "
+            "have no virtual clock to shape); ignored by %s", algo)
     mesh = None
     if args.mesh_batch is not None and args.mesh_batch < 1:
         raise SystemExit(f"--mesh_batch must be >= 1, got {args.mesh_batch}")

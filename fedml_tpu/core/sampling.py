@@ -10,6 +10,8 @@ provided for fully-jitted round loops.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,6 +51,29 @@ class ClientSampler:
         np.random.seed(round_idx)  # deterministic, matches reference
         return np.asarray(
             np.random.choice(range(self.client_num_in_total), num, replace=False),
+            dtype=np.int64,
+        )
+
+    def sample_fast(self, round_idx: int,
+                    k: Optional[int] = None) -> np.ndarray:
+        """BITWISE-equal twin of `sample` that neither reseeds the
+        GLOBAL numpy RNG nor builds a Python `range(N)` list — the
+        cross-device fast path (ISSUE 10): `np.random.seed(r)` +
+        `np.random.choice(range(N), ...)` delegates to a global legacy
+        RandomState, so a PRIVATE `RandomState(r)` walks the identical
+        Mersenne-Twister stream (and `choice(N, ...)` indexes the same
+        permutation the range-array path takes) — cross-pinned against
+        the oracle in tests/test_scale.py.  Per draw this is still an
+        O(N) numpy permutation internally, but transient ndarray scratch
+        instead of an O(N) boxed-int list, and concurrency-safe: nothing
+        else sharing the process loses its RNG state.  `k` overrides the
+        cohort size (the streaming sampler's variable-width draws)."""
+        k = self.client_num_per_round if k is None else int(k)
+        if k >= self.client_num_in_total:
+            return np.arange(self.client_num_in_total, dtype=np.int64)
+        rs = np.random.RandomState(round_idx)
+        return np.asarray(
+            rs.choice(self.client_num_in_total, k, replace=False),
             dtype=np.int64,
         )
 

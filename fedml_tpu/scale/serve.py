@@ -1,0 +1,223 @@
+"""Virtual-time serve simulation — the million-client heavy-traffic
+bench behind `bench.py --mode serve`.
+
+What it measures: the SERVER's cross-device round hot path at
+production populations — cohort sampling over the sharded registry,
+per-uplink registry bookkeeping, the streaming fold, and the O(P)
+commit — under a trace-driven arrival process in virtual time.  Client
+compute is out of scope by design (updates are a rotating pool of
+pre-generated rows): the north-star question here is whether the
+serving spine sustains committed-updates/sec while server memory stays
+sub-linear in population (ISSUE 10 acceptance: registry <= ~100
+bytes/client at 1M, no per-client Python objects on the hot path).
+
+The loop (one process, no threads — the virtual clock comes from the
+arrival process):
+
+    arrivals  λ(t) from scale/arrivals.py yields uplink landing times
+    dispatch  when in-flight drops below `concurrency`, the streaming
+              cohort sampler draws a batch over the registry's
+              eligibility mask and `note_dispatch` marks it (vectorized)
+    ingest    each arrival pops the oldest in-flight client (a numpy
+              ring, no deque of Python tuples), `note_return` yields its
+              dispatched version -> staleness, the row folds into the
+              streaming AsyncBuffer (the PR-6 jitted fold), and
+              `note_contribution` updates the client's counters
+    commit    buffer full -> the O(P) stream commit, version += 1
+    faults    a seeded dropout stream crashes dispatches (no fold);
+              crashed clients rejoin at the next commit — eligibility
+              masks breathe, like the lifecycle model
+
+Determinism: sampler draws, the row pool, dropout and arrival times are
+all `default_rng([seed, ...])` streams — one seed, one trace.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu import obs
+from fedml_tpu.scale.arrivals import (ArrivalConfig, ArrivalProcess,
+                                      make_arrivals)
+from fedml_tpu.scale.registry import ClientRegistry
+from fedml_tpu.scale.sampler import StreamingCohortSampler
+
+
+def rss_bytes() -> int:
+    """Resident set size of this process (0 where /proc is absent)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+def run_serve_sim(population: int, *, commits: int = 30,
+                  warmup_commits: int = 2, buffer_k: int = 32,
+                  concurrency: Optional[int] = None, row_dim: int = 1024,
+                  sampler_mode: str = "stratified",
+                  arrival: Optional[ArrivalConfig] = None,
+                  dropout_prob: float = 0.0, banned_frac: float = 0.0,
+                  seed: int = 0) -> dict:
+    """Drive `commits` streaming commits at `population` simulated
+    clients; returns the serve report (committed-updates/sec, registry
+    memory, RSS, virtual-time stats)."""
+    import jax.numpy as jnp
+    from fedml_tpu.async_.staleness import (AsyncBuffer,
+                                            make_stream_commit_fn)
+
+    if commits <= warmup_commits:
+        raise ValueError(f"commits ({commits}) must exceed "
+                         f"warmup_commits ({warmup_commits})")
+    concurrency = (concurrency if concurrency is not None
+                   else 4 * buffer_k)
+    arrival = arrival if arrival is not None else ArrivalConfig(
+        mode="constant", rate=1000.0, seed=seed)
+    proc: Optional[ArrivalProcess] = make_arrivals(arrival)
+
+    registry = ClientRegistry(population)
+    rng = np.random.default_rng([seed, 2])
+    if banned_frac > 0.0:
+        # seeded ineligibility (defense bans / opted-out devices): the
+        # sampler must route around these forever
+        n_ban = max(1, int(banned_frac * population))
+        registry.ban(np.unique(rng.integers(0, population,
+                                            size=2 * n_ban))[:n_ban])
+    sampler = StreamingCohortSampler(registry, buffer_k, seed=seed,
+                                     mode=sampler_mode)
+    # the commit math: a tiny flat-row "model" through the REAL PR-6
+    # streaming buffer + O(P) commit program
+    template = {"w": jnp.zeros((row_dim,), jnp.float32)}
+    buffer = AsyncBuffer(buffer_k, row_dim, streaming=True)
+    commit_fn = make_stream_commit_fn(template, donate=False)
+    variables = template
+    # rotating pre-generated row pool: the fold reads realistic floats
+    # without paying a per-arrival P-sized RNG draw
+    pool = rng.standard_normal((64, row_dim)).astype(np.float32)
+    drop_rng = np.random.default_rng([seed, 3])
+
+    # in-flight FIFO as a numpy ring — ids only; the registry's
+    # `outstanding` field carries the dispatched version
+    cap = 2 * concurrency + buffer_k
+    ring = np.zeros(cap, np.int64)
+    head = tail = 0                     # pop at head, push at tail
+
+    version = 0
+    admitted = 0
+    crashed = 0
+    draws = 0        # sampler round index: MONOTONE per draw, never
+    #                  reused — the legacy uniform draw is prefix-stable
+    #                  in k at a fixed round, so re-sampling one round
+    #                  index across refills would re-select the same
+    #                  (now in-flight) ids and degrade to id-ordered
+    #                  top-ups
+    rejoin_at_commit: list[np.ndarray] = []
+    arr_iter = (proc.arrivals(0.0, np.random.default_rng(
+        [arrival.seed, seed, 1])) if proc is not None else None)
+    now = 0.0
+    t_wall0 = time.perf_counter()
+    t_timed = None
+    admitted_at_warmup = 0
+
+    def dispatch(need: int) -> int:
+        nonlocal tail, draws
+        ids = sampler.sample(draws, k=need)
+        draws += 1
+        if ids.size == 0:
+            return 0
+        registry.note_dispatch(ids, version)
+        for c in ids:                   # ring push (ids only)
+            ring[tail % cap] = c
+            tail += 1
+        return int(ids.size)
+
+    with obs.span("serve.run", population=population, commits=commits,
+                  sampler=sampler_mode, arrival=arrival.mode):
+        dispatch(concurrency)
+        while version < commits:
+            if head == tail and dispatch(buffer_k) == 0:
+                raise RuntimeError(
+                    f"serve sim starved at version {version}: no "
+                    f"eligible clients ({registry.count_free} free)")
+            if arr_iter is not None:
+                try:
+                    now = next(arr_iter)
+                except StopIteration:
+                    # only TraceArrivals terminates — name the fix
+                    raise ValueError(
+                        f"arrival trace exhausted after {admitted + crashed}"
+                        f" arrivals at commit {version}/{commits}: the "
+                        f"trace needs ~commits*buffer_k (+dropout) "
+                        f"timestamps") from None
+            cid = int(ring[head % cap])
+            head += 1
+            if dropout_prob > 0.0 and drop_rng.random() < dropout_prob:
+                registry.note_crash(cid, rejoins=True)
+                crashed += 1
+                rejoin_at_commit.append(np.asarray([cid], np.int64))
+            else:
+                v = registry.note_return(cid)
+                staleness = float(version - v)
+                full = buffer.add(pool[admitted % 64], 1.0, staleness)
+                registry.note_contribution(cid, staleness, version)
+                admitted += 1
+                if full:
+                    with obs.span("serve.commit", version=version,
+                                  t_virtual=round(now, 3)):
+                        acc, wsum, _w, _s, _n, _raw = buffer.take_stream()
+                        variables, _stats = commit_fn(
+                            variables, acc, wsum, jnp.float32(1.0))
+                    version += 1
+                    for ids in rejoin_at_commit:
+                        for c in ids:
+                            registry.note_rejoin(int(c))
+                    rejoin_at_commit.clear()
+                    if version == warmup_commits:
+                        t_timed = time.perf_counter()
+                        admitted_at_warmup = admitted
+            if (tail - head) <= concurrency - buffer_k:
+                with obs.span("serve.dispatch", version=version):
+                    dispatch(concurrency - (tail - head))
+    wall = time.perf_counter() - (t_timed if t_timed is not None
+                                  else t_wall0)
+    timed_updates = admitted - (admitted_at_warmup
+                                if t_timed is not None else 0)
+    # contributor spread (from allocated shards only — O(touched)):
+    # a healthy sampler scatters updates across the population; a
+    # biased one concentrates them on few clients
+    distinct = max_part = 0
+    for sh in registry._shards.values():
+        part = sh["participation"]
+        distinct += int(np.count_nonzero(part))
+        max_part = max(max_part, int(part.max()) if part.size else 0)
+    return {
+        "population": int(population),
+        "commits": int(version),
+        "committed_updates": int(admitted),
+        "distinct_contributors": distinct,
+        "max_client_participation": max_part,
+        "committed_updates_per_sec": (timed_updates / wall
+                                      if wall > 0 else 0.0),
+        "buffer_k": int(buffer_k),
+        "concurrency": int(concurrency),
+        "row_dim": int(row_dim),
+        "sampler_mode": sampler_mode,
+        "sampler_peak_scratch_bytes": int(sampler.peak_scratch_bytes),
+        "arrival_mode": arrival.mode,
+        "virtual_time_s": float(now),
+        "mean_arrival_rate": (admitted + crashed) / now if now > 0 else 0.0,
+        "registry_bytes": int(registry.nbytes),
+        "registry_bytes_per_client": float(registry.bytes_per_client),
+        "registry_shards_allocated": len(registry._shards),
+        "crashed": int(crashed),
+        "banned": int(registry.count_banned),
+        "rss_bytes": rss_bytes(),
+        "wall_s": float(wall),
+        "seed": int(seed),
+    }

@@ -1,0 +1,208 @@
+"""On-demand client-shard stores — cohort stacks without the
+all-client stack.
+
+`FederatedData` keeps every client's padded shard in ONE stacked array
+set ([C, B, bs, ...]) resident in host RAM/HBM — the right layout up to
+the proven 342k-client stack build, and a dead end at millions: the
+stack is built (and held) for clients that may never be sampled.  A
+ShardStore inverts that: client shards materialize ON DEMAND, per
+cohort, so host memory is O(cohort · shard) + a bounded reuse cache,
+and the cohort-build cost is amortized across rounds by that cache
+(FedJAX's sharded-dataset iterator shape, arXiv:2108.02117 §4).
+
+Every store speaks `FederatedData.cohort`'s contract —
+``cohort(ids) -> ({x, y, mask} stacked [K, B, bs, ...], weights [K])``
+— so the async scheduler (and anything else that gathers cohorts) takes
+either interchangeably, and `prefetcher()` wraps the PR-1 double-buffer
+(`parallel/prefetch.py`) around any store so cohort k+1 builds while
+the chip trains on k.
+
+Backends:
+
+    MaterializedShardStore   adapter over an existing FederatedData —
+                             the oracle the others are pinned against
+                             (bitwise, tests/test_scale.py).
+    MmapShardStore           the stacked arrays live in .npy files and
+                             are opened memory-mapped: a cohort gather
+                             touches only the cohort's pages, so RSS is
+                             O(touched clients), not O(population).
+    GeneratorShardStore      shards are synthesized per client id by a
+                             seeded factory — no backing array of any
+                             size ever exists (the 1M+ simulation
+                             story), deterministic per (seed, client).
+"""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from fedml_tpu import obs
+
+
+class ShardStore:
+    """Base: per-client fetch + bounded LRU reuse cache + cohort
+    stacking.  Subclasses implement `_fetch(cid) -> {x, y, mask}` (host
+    numpy, one client's [B, bs, ...] arrays) and `_weight(cid)`."""
+
+    def __init__(self, n_clients: int, cache_clients: int = 0):
+        self.n_clients = int(n_clients)
+        self.cache_clients = int(cache_clients)
+        self._cache: "OrderedDict[int, dict]" = OrderedDict()
+        self._m_hits = obs.counter("shardstore_cache_hits_total")
+        self._m_miss = obs.counter("shardstore_cache_misses_total")
+
+    # -- subclass surface ----------------------------------------------------
+    def _fetch(self, cid: int) -> dict:
+        raise NotImplementedError
+
+    def _weight(self, cid: int) -> float:
+        raise NotImplementedError
+
+    # -- the cohort contract -------------------------------------------------
+    def client_shard(self, cid: int) -> dict:
+        """One client's {x, y, mask}, through the reuse cache."""
+        if not 0 <= cid < self.n_clients:
+            raise IndexError(f"client id {cid} out of range "
+                             f"[0, {self.n_clients})")
+        if self.cache_clients > 0:
+            hit = self._cache.get(cid)
+            if hit is not None:
+                self._cache.move_to_end(cid)
+                self._m_hits.inc()
+                return hit
+        self._m_miss.inc()
+        shard = self._fetch(cid)
+        if self.cache_clients > 0:
+            self._cache[cid] = shard
+            while len(self._cache) > self.cache_clients:
+                self._cache.popitem(last=False)
+        return shard
+
+    def cohort(self, client_indices) -> tuple[dict, "object"]:
+        """({x, y, mask} device-stacked [K, ...], weights [K]) — the
+        FederatedData.cohort contract, built from on-demand shards."""
+        import jax.numpy as jnp
+        ids = np.asarray(client_indices, np.int64).reshape(-1)
+        with obs.span("serve.cohort_build", clients=int(ids.size)):
+            shards = [self.client_shard(int(c)) for c in ids]
+            stacked = {k: np.stack([s[k] for s in shards])
+                       for k in shards[0]} if shards else {}
+            w = np.asarray([self._weight(int(c)) for c in ids], np.float32)
+        return ({k: jnp.asarray(v) for k, v in stacked.items()},
+                jnp.asarray(w))
+
+    def prefetcher(self, cohorts: Sequence, depth: int = 2):
+        """Wrap the PR-1 double buffer around this store: one
+        `Prefetcher` whose items are cohort id arrays and whose
+        produce() is `self.cohort` — cohort k+1 gathers/uploads on the
+        background thread while k trains."""
+        from fedml_tpu.parallel.prefetch import Prefetcher
+        return Prefetcher(self.cohort, list(cohorts), depth=depth,
+                          name="shardstore-prefetch")
+
+
+class MaterializedShardStore(ShardStore):
+    """Adapter over an existing FederatedData stack — the bitwise
+    oracle (its cohort() must equal data.cohort())."""
+
+    def __init__(self, data, cache_clients: int = 0):
+        super().__init__(data.client_num, cache_clients)
+        self._data = data
+
+    def _fetch(self, cid: int) -> dict:
+        return {k: np.asarray(v[cid])
+                for k, v in self._data.client_shards.items()}
+
+    def _weight(self, cid: int) -> float:
+        return float(self._data.client_num_samples[cid])
+
+    def cohort(self, client_indices):
+        # delegate to the stack's device-side gather — this adapter
+        # exists to give materialized data the ShardStore interface
+        # (and the oracle cohorts), not to slow it down
+        return self._data.cohort(np.asarray(client_indices, np.int64))
+
+
+class MmapShardStore(ShardStore):
+    """Client shards in .npy files opened memory-mapped: the OS pages
+    in only the clients a cohort touches.  `build()` writes a
+    FederatedData's stack out once; reopening is O(1)."""
+
+    def __init__(self, directory: str, cache_clients: int = 0):
+        self.directory = directory
+        self._arrays = {}
+        for name in ("x", "y", "mask"):
+            self._arrays[name] = np.load(
+                os.path.join(directory, f"{name}.npy"), mmap_mode="r")
+        self._weights = np.load(os.path.join(directory, "weights.npy"))
+        super().__init__(self._arrays["mask"].shape[0], cache_clients)
+
+    @classmethod
+    def build(cls, data, directory: str,
+              cache_clients: int = 0) -> "MmapShardStore":
+        os.makedirs(directory, exist_ok=True)
+        for name, arr in data.client_shards.items():
+            # open_memmap + copy writes without doubling host RAM
+            out = np.lib.format.open_memmap(
+                os.path.join(directory, f"{name}.npy"), mode="w+",
+                dtype=arr.dtype, shape=arr.shape)
+            out[:] = arr
+            out.flush()
+            del out
+        np.save(os.path.join(directory, "weights.npy"),
+                np.asarray(data.client_num_samples, np.float32))
+        return cls(directory, cache_clients)
+
+    def _fetch(self, cid: int) -> dict:
+        # np.asarray forces the page-in copy OUT of the mmap so a cached
+        # shard never pins mmap pages
+        return {k: np.asarray(v[cid]) for k, v in self._arrays.items()}
+
+    def _weight(self, cid: int) -> float:
+        return float(self._weights[cid])
+
+
+class GeneratorShardStore(ShardStore):
+    """Shards synthesized per client id — deterministic per (seed,
+    client), nothing population-sized ever allocated.  `make_shard`
+    takes (client_id, rng) and returns host {x, y, mask} arrays;
+    omitted, a small seeded gaussian-image shard is generated (the
+    serve simulation's default)."""
+
+    def __init__(self, n_clients: int, seed: int = 0,
+                 make_shard: Optional[Callable] = None,
+                 batches: int = 2, batch_size: int = 8,
+                 sample_shape: tuple = (16,), n_classes: int = 10,
+                 cache_clients: int = 0):
+        super().__init__(n_clients, cache_clients)
+        self.seed = int(seed)
+        self._make = make_shard
+        self._batches = batches
+        self._bs = batch_size
+        self._shape = tuple(sample_shape)
+        self._classes = n_classes
+
+    def _rng(self, cid: int) -> np.random.Generator:
+        return np.random.default_rng([self.seed, cid])
+
+    def _fetch(self, cid: int) -> dict:
+        rng = self._rng(cid)
+        if self._make is not None:
+            return self._make(cid, rng)
+        shape = (self._batches, self._bs) + self._shape
+        return {
+            "x": rng.standard_normal(shape).astype(np.float32),
+            "y": rng.integers(0, self._classes,
+                              (self._batches, self._bs)).astype(np.int64),
+            "mask": np.ones((self._batches, self._bs), np.float32),
+        }
+
+    def _weight(self, cid: int) -> float:
+        # deterministic per client, independent of _fetch's draw order:
+        # a dedicated stream, so weights match whether or not the shard
+        # was ever fetched
+        return float(np.random.default_rng(
+            [self.seed, cid, 1]).integers(1, 40))

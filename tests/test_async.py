@@ -344,11 +344,23 @@ def test_async_checkpoint_roundtrips_server_state(tmp_path):
     for k in ("rows", "weights", "staleness", "count"):
         np.testing.assert_array_equal(np.asarray(extra["buffer"][k]),
                                       np.asarray(saved["buffer"][k]))
-    for k in ("client_last_staleness", "client_contribs"):
-        np.testing.assert_array_equal(np.asarray(extra[k]),
-                                      np.asarray(saved[k]))
+    # ISSUE 10: the sharded client registry rides the checkpoint —
+    # participation/staleness/quarantine shards round-trip bit-exactly
+    for k in ("participation", "last_staleness", "quarantined",
+              "last_seen"):
+        np.testing.assert_array_equal(np.asarray(extra["registry"][k]),
+                                      np.asarray(saved["registry"][k]))
+    assert int(np.asarray(
+        extra["registry"]["participation"]).sum()) > 0
     fresh.load_async_state(extra)
     assert fresh.version == step + 1
+    # restored registry serves the same counters the saved one did
+    ids = np.arange(fresh.registry.n_clients)
+    np.testing.assert_array_equal(
+        fresh.registry.participation(ids), eng.registry.participation(ids))
+    np.testing.assert_array_equal(
+        fresh.registry.last_staleness(ids),
+        eng.registry.last_staleness(ids))
     # and the restored engine keeps committing from there
     out = fresh.run(variables=v, rounds=fresh.version + 2)
     assert fresh.version == step + 3

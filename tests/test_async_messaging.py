@@ -473,6 +473,15 @@ def test_async_crash_resume_over_tcp(tmp_path):
                 time.sleep(0.5)
         assert server2 is not None, "same-port rebind never succeeded"
         assert server2.version >= 2, "resume lost the committed rounds"
+        # ISSUE 10: the sharded client registry rode the checkpoint —
+        # at a commit boundary the buffer is empty, so every admitted
+        # uplink has been committed and the restored per-rank
+        # participation counters must sum to the restored
+        # updates_committed exactly
+        assert (server2.registry.total_participation()
+                == server2.updates_committed), (
+            server2.registry.total_participation(),
+            server2.updates_committed)
         server2.run_async()
         server2.send_start()                # re-handshake every client
         assert server2.done.wait(timeout=180), (

@@ -337,6 +337,61 @@ def test_chip_queue_carries_attack_ab():
     assert r.returncode == 0, r.stderr
 
 
+def test_bench_json_schema_v9_carries_serve_block():
+    """ISSUE 10: schema v9 adds the serve-mode fields — the "serve"
+    block from `python bench.py --mode serve` with one row per
+    simulated population carrying committed_updates_per_sec,
+    registry_bytes / registry_bytes_per_client (the <= ~100 B/client
+    sub-linear-memory gate in "sublinear_ok"), sampler scratch, RSS and
+    the sustain ratio.  Static source check like the v3-v8 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 9, (
+        "bench schema must stay >= v9 (serving-spine block)")
+    for field in ('"serve"', '"populations"', "_bench_serve",
+                  "registry_bytes_per_client", "sublinear_ok",
+                  "sustain_ratio_vs_smallest",
+                  "sampler_peak_scratch_bytes", "rss_bytes"):
+        assert field in src, (
+            f"bench.py lost the v9 serve field {field} "
+            "(see fedml_tpu/scale/serve.py and _bench_serve)")
+    # the block's numbers come from the serve sim's report — names must
+    # stay in sync with run_serve_sim's dict
+    srv = open(os.path.join(os.path.dirname(__file__), "..",
+                            "fedml_tpu", "scale", "serve.py")).read()
+    for field in ("committed_updates_per_sec", "registry_bytes_per_client",
+                  "sampler_peak_scratch_bytes", "rss_bytes",
+                  "virtual_time_s"):
+        assert field in srv, (
+            f"run_serve_sim's report lost {field!r} — bench.py's v9 "
+            "serve block reads it")
+    # and the subsystem itself must exist
+    for mod in ("registry.py", "sampler.py", "shardstore.py",
+                "arrivals.py", "serve.py"):
+        assert os.path.exists(os.path.join(
+            os.path.dirname(__file__), "..", "fedml_tpu", "scale", mod)), (
+            f"fedml_tpu/scale/{mod} (the ISSUE-10 serving spine) is gone")
+
+
+def test_chip_queue_carries_serve_step():
+    """ISSUE 10: the next chip window must price the serving spine —
+    scripts/run_chip_queue.sh carries the SERVE step (12/12) and
+    profile_bench.py defines the exp_SERVE experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    assert "profile_bench.py SERVE" in open(queue).read(), (
+        "run_chip_queue.sh lost the SERVE million-client serving-spine "
+        "step (ISSUE 10 queues it for the next chip window)")
+    assert "exp_SERVE" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_SERVE experiment the queue runs")
+    import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+
+
 def test_chip_queue_carries_chaos_ab():
     """ISSUE 8: the next chip window must price the chaos goodput —
     scripts/run_chip_queue.sh carries the CHAOS step (10/10) and

@@ -1117,6 +1117,36 @@ def exp_ATTACK():
           flush=True)
 
 
+def exp_SERVE():
+    """Million-client serving-spine A/B (ISSUE 10): sustained
+    committed-updates/sec and server registry memory vs simulated
+    population (10k / 100k / 1M), stratified vs reservoir cohort
+    sampling, under the diurnal arrival process — the chip-side rerun
+    of `bench.py --mode serve` with the chip-attached jax runtime
+    dispatching the streaming fold/commit.  Gates: registry <= ~100
+    bytes/client at every population, and the 1M arm sustains (>= 0.5x
+    the 10k arm — sub-linear server cost is the headline, the fold is
+    the floor)."""
+    from fedml_tpu.scale import ArrivalConfig, run_serve_sim
+
+    arr = ArrivalConfig(mode="diurnal", rate=2000.0, period_s=600.0,
+                        amplitude=0.8)
+    for mode in ("stratified", "reservoir"):
+        base = None
+        for pop in (10_000, 100_000, 1_000_000):
+            r = run_serve_sim(pop, commits=40, warmup_commits=4,
+                              buffer_k=32, row_dim=4096,
+                              sampler_mode=mode, arrival=arr,
+                              dropout_prob=0.02, banned_frac=0.01)
+            ups = r["committed_updates_per_sec"]
+            base = ups if base is None else base
+            print(f"SERVE {mode} pop={pop}: {ups:.0f} updates/s "
+                  f"({ups / base:.2f}x vs 10k)  registry "
+                  f"{r['registry_bytes'] / 1e6:.1f} MB "
+                  f"({r['registry_bytes_per_client']:.1f} B/client)  "
+                  f"rss {r['rss_bytes'] / 1e6:.0f} MB", flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
