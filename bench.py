@@ -81,6 +81,17 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     sub-linear-memory gate, recorded in "sublinear_ok"), sampler
 #     scratch bytes, rss_bytes and the virtual-time arrival stats;
 #     null in other modes, so v8 readers keep working
+# v10: + "connections" block (`python bench.py --mode connections`,
+#     ISSUE 11 — fedml_tpu/comm/reactor.py + connswarm.py over the
+#     live-connection torture): one row per live-connection count
+#     (default 256/1k/10k), each with a clean, a mixed-chaos (5% loss +
+#     1% dup + 0.5% corrupt) and a storm (chaos + connection storm +
+#     reconnect churn) arm carrying committed_updates_per_sec,
+#     admission_p50_s/admission_p95_s, open_connections_peak, the
+#     evicted{stall|rate|shed} / uplinks_shed / recv_thread_deaths /
+#     fd_leaked counters and loop_lag_p95_s, plus per-row
+#     storm_goodput_ratio (the >= 0.5x-of-clean acceptance gate) —
+#     null in other modes, so v9 readers keep working
 # v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
 #     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
 #     attack x defense arms on the async MNIST-LR workload (each row:
@@ -93,7 +104,7 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 
 def _critical_path_doc():
@@ -205,7 +216,7 @@ def main() -> None:
     ap = argparse.ArgumentParser("bench")
     ap.add_argument("--mode",
                     choices=("sync", "async", "ingest", "chaos", "attack",
-                             "serve"),
+                             "serve", "connections"),
                     default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
@@ -230,7 +241,12 @@ def main() -> None:
                          "fedml_tpu/scale/) — sustained committed-"
                          "updates/sec and server registry memory vs "
                          "simulated population (10k/100k/1M) under a "
-                         "trace-driven arrival process in virtual time")
+                         "trace-driven arrival process in virtual time; "
+                         "connections: the live-connection reactor bench "
+                         "(ISSUE 11, fedml_tpu/comm/reactor.py) — "
+                         "sustained committed-updates/sec + p95 admission "
+                         "latency vs live socket count (256/1k/10k), "
+                         "clean vs mixed-chaos vs storm arms")
     ap.add_argument("--ingest_clients", type=int, default=32,
                     help="ingest mode: concurrent uplink clients")
     ap.add_argument("--ingest_backend", default="TCP",
@@ -287,6 +303,23 @@ def main() -> None:
     ap.add_argument("--serve_seed", type=int, default=0,
                     help="serve mode: one seed drives sampler, arrivals "
                          "and fault draws (same seed = same trace)")
+    ap.add_argument("--conn_counts", default="256,1000,10000",
+                    help="connections mode: comma-separated live-"
+                         "connection counts (one bench row each; counts "
+                         "past ~4k run the client swarm in a subprocess "
+                         "so both halves fit under ulimit -n)")
+    ap.add_argument("--conn_commits", type=int, default=24,
+                    help="connections mode: timed commits per arm")
+    ap.add_argument("--conn_buffer_k", type=int, default=32,
+                    help="connections mode: streaming buffer capacity K")
+    ap.add_argument("--conn_pool", type=int, default=4,
+                    help="connections mode: decode-pool size")
+    ap.add_argument("--conn_rate", type=float, default=2000.0,
+                    help="connections mode: aggregate offered uplink "
+                         "frames/sec across the swarm")
+    ap.add_argument("--conn_seed", type=int, default=0,
+                    help="connections mode: one seed drives the swarm "
+                         "schedule and the chaos injector")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -310,6 +343,7 @@ def main() -> None:
             "chaos": None,
             "attack": None,
             "serve": None,
+            "connections": None,
             "critical_path": None,
             "error": "chip_unavailable",
             "detail": detail,
@@ -336,6 +370,9 @@ def main() -> None:
         return
     if args.mode == "serve":
         _bench_serve(args)
+        return
+    if args.mode == "connections":
+        _bench_connections(args)
         return
     import jax.numpy as jnp
 
@@ -443,6 +480,7 @@ def main() -> None:
         "chaos": None,
         "attack": None,
         "serve": None,
+        "connections": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -527,6 +565,7 @@ def _bench_async(cfg, data, trainer) -> None:
         "chaos": None,
         "attack": None,
         "serve": None,
+        "connections": None,
         # v6: commit-to-commit stage attribution from the scheduler's
         # spans (train waves / commits / eval + wait); null untraced
         "critical_path": _critical_path_doc(),
@@ -614,6 +653,7 @@ def _bench_ingest(args) -> None:
         "async": None,
         "attack": None,
         "serve": None,
+        "connections": None,
         "ingest": {
             "backend": legacy["backend"],
             "n_clients": legacy["n_clients"],
@@ -739,6 +779,7 @@ def _bench_chaos(args) -> None:
         "ingest": None,
         "attack": None,
         "serve": None,
+        "connections": None,
         "chaos": {
             "backend": clean["backend"],
             "n_clients": clean["n_clients"],
@@ -899,6 +940,7 @@ def _bench_attack(args) -> None:
         "ingest": None,
         "chaos": None,
         "serve": None,
+        "connections": None,
         "attack": {
             "workload": "async_mnist_lr (quality-band shape, K=8, "
                         "conc 16, poly a=0.5)",
@@ -1002,6 +1044,7 @@ def _bench_serve(args) -> None:
         "ingest": None,
         "chaos": None,
         "attack": None,
+        "connections": None,
         "serve": {
             "buffer_k": args.serve_buffer_k,
             "row_dim": args.serve_row_dim,
@@ -1033,6 +1076,129 @@ def _bench_serve(args) -> None:
                 head["committed_updates_per_sec"]
                 / rows[0]["committed_updates_per_sec"], 4)
                 if rows[0]["committed_updates_per_sec"] > 0 else None,
+        },
+        "critical_path": _critical_path_doc(),
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+# connections-mode shape (ISSUE 11): every arm runs the SAME reactor
+# config, buffer, pool and offered rate, so the table isolates the
+# live-connection count and the overload scenario.  The mixed-chaos
+# rates mirror the PR-8 acceptance shape; the storm arm adds the
+# connection storm (every SYN at once) + reconnect churn on top of the
+# same chaos — the acceptance arm of the >= 0.5x-of-clean gate.
+CONN_WARMUP_COMMITS = 3
+CONN_CHAOS = {"drop": 0.05, "dup": 0.01, "corrupt": 0.005}
+CONN_CHURN_LIFETIME_S = 5.0
+
+
+def _bench_connections(args) -> None:
+    """Live-connection reactor bench (ISSUE 11, fedml_tpu/comm/
+    reactor.py + connswarm.py): N live sockets against the selector
+    reactor transport — a swarm keeps every connection open with paced
+    FMLR-enveloped uplinks while the server reassembles, dedups, acks
+    and commits.  Arms per count: clean, mixed-chaos (5% loss + 1% dup
+    + 0.5% corrupt at the receive chokepoint) and storm (the same
+    chaos + a connection storm + seeded reconnect churn).  Gates:
+    storm sustains >= 0.5x clean committed-updates/sec, zero recv-
+    thread deaths, zero leaked FDs, every shed/evicted uplink
+    accounted."""
+    from fedml_tpu import obs
+    from fedml_tpu.async_.torture import run_connection_torture
+
+    counts = sorted(int(c) for c in str(args.conn_counts).split(",")
+                    if c.strip())
+    if not counts or counts[0] < 1:
+        raise SystemExit(
+            f"--conn_counts must be a comma-separated list of positive "
+            f"connection counts, got {args.conn_counts!r}")
+    port = int(os.environ.get("BENCH_CONN_PORT", "53700"))
+    arm_no = [0]
+
+    def run(tag, n, **kw):
+        arm_no[0] += 1
+        rep = run_connection_torture(
+            n_connections=n, buffer_k=args.conn_buffer_k,
+            commits=args.conn_commits, warmup_commits=CONN_WARMUP_COMMITS,
+            ingest_pool=args.conn_pool, offered_rate=args.conn_rate,
+            base_port=port + arm_no[0], timeout_s=900,
+            seed=args.conn_seed, chaos_seed=args.conn_seed, **kw)
+        ev = rep["evicted"]
+        print(f"{tag}: {rep['committed_updates_per_sec']:.1f} updates/s  "
+              f"admission p95 {rep['admission_p95_s'] * 1e3:.1f} ms  "
+              f"peak {rep['open_connections_peak']} conns  evicted "
+              f"stall/rate/shed {ev['stall']:.0f}/{ev['rate']:.0f}/"
+              f"{ev['shed']:.0f}  shed {rep['uplinks_shed']:.0f}  "
+              f"fd leak {rep['fd_leaked']}  recv deaths "
+              f"{rep['recv_thread_deaths']:.0f}", file=sys.stderr)
+        return rep
+
+    def arm_doc(rep):
+        return {
+            "committed_updates_per_sec": round(
+                rep["committed_updates_per_sec"], 4),
+            "admission_p50_s": round(rep["admission_p50_s"], 6),
+            "admission_p95_s": round(rep["admission_p95_s"], 6),
+            "loop_lag_p95_s": round(rep["loop_lag_p95_s"], 6),
+            "open_connections_peak": rep["open_connections_peak"],
+            "evicted": rep["evicted"],
+            "uplinks_shed": rep["uplinks_shed"],
+            "connections_drained": rep["connections_drained"],
+            "recv_thread_deaths": rep["recv_thread_deaths"],
+            "dups_suppressed": rep["dups_suppressed"],
+            "quarantined": rep["quarantined"],
+            "fd_leaked": rep["fd_leaked"],
+            "chaos_injected": rep["chaos_injected"],
+            "swarm": rep["swarm"],
+        }
+
+    rows = []
+    for n in counts:
+        clean = run(f"n={n} clean", n)
+        chaosr = run(f"n={n} chaos", n, chaos=dict(CONN_CHAOS))
+        storm = run(f"n={n} storm", n, chaos=dict(CONN_CHAOS),
+                    storm=True, churn_lifetime_s=CONN_CHURN_LIFETIME_S)
+        clean_ups = clean["committed_updates_per_sec"]
+        rows.append({
+            "n_connections": n,
+            "clean": arm_doc(clean),
+            "chaos": arm_doc(chaosr),
+            "storm": arm_doc(storm),
+            "storm_goodput_ratio": round(
+                storm["committed_updates_per_sec"] / clean_ups, 4)
+                if clean_ups > 0 else None,
+        })
+    head = rows[-1]
+    doc = _stamp({
+        "metric": (f"reactor_{head['n_connections']}conns_storm_"
+                   "committed_updates_per_sec"),
+        "value": head["storm"]["committed_updates_per_sec"],
+        "unit": "updates/sec",
+        # the in-schema comparison is the same count's clean arm
+        "vs_baseline": None,
+        "mode": "connections",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": None,
+        "chaos": None,
+        "attack": None,
+        "serve": None,
+        "connections": {
+            "buffer_k": args.conn_buffer_k,
+            "ingest_pool": args.conn_pool,
+            "offered_rate": args.conn_rate,
+            "commits": args.conn_commits,
+            "seed": args.conn_seed,
+            "chaos_rates": dict(CONN_CHAOS),
+            "churn_lifetime_s": CONN_CHURN_LIFETIME_S,
+            "rows": rows,
+            "storm_goodput_ratio": head["storm_goodput_ratio"],
         },
         "critical_path": _critical_path_doc(),
     })

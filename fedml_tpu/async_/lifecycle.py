@@ -304,6 +304,13 @@ class AsyncServerManager(ServerManager):
             "comm_decode_seconds",
             buckets=obs.metrics.DECODE_SECONDS_BUCKETS,
             backend=self.com_manager.backend_name)
+        # ISSUE 11: admission latency — wall from the transport handing
+        # over a reassembled frame to the row landing in the buffer
+        # (pool queueing + decode + screen + lock wait); the connection
+        # bench's p95 gate reads its histogram delta
+        self._m_admission = obs.histogram(
+            "comm_admission_seconds",
+            buckets=obs.metrics.DECODE_SECONDS_BUCKETS)
         # crash-resume (ISSUE 8): per-commit orbax checkpoints of the
         # full server round state — restore happens BEFORE the ingest
         # pool exists, so no frame can race the rebuild
@@ -377,6 +384,17 @@ class AsyncServerManager(ServerManager):
             self._ingest_sem = threading.BoundedSemaphore(
                 2 * self.ingest_pool)
             self.com_manager.set_frame_sink(self._ingest_frame)
+            # ISSUE 11: non-blocking admission probe for reactor
+            # transports — while the pool is at its in-flight bound the
+            # reactor suspends the peer's READ interest (kernel-buffer
+            # backpressure) instead of blocking a shared loop thread in
+            # the semaphore the way a recv thread harmlessly does.  The
+            # gauge is maintained exactly at the semaphore edges, so
+            # the probe races at most one task-width — a transient
+            # block bounded by one decode, never a stall.
+            pool_cap = float(2 * self.ingest_pool)
+            self.com_manager.set_ingest_pressure(
+                lambda: self._m_pool_depth.value >= pool_cap)
 
     # -- crash-resume --------------------------------------------------------
     def _ckpt_state(self) -> dict:
@@ -454,11 +472,13 @@ class AsyncServerManager(ServerManager):
     def _handle_result(self, msg: Message) -> None:
         """FSM route (ingest_pool=0): the backend decoded the frame
         inline; flatten and fold/insert."""
+        t0 = time.perf_counter()
         row = flatten_vars_row(msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS))
         self._ingest_row(
             msg.get_sender_id(), row,
             float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES)),
             int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+        self._m_admission.observe(time.perf_counter() - t0)
 
     # -- parallel ingest (frame sink + decode pool) --------------------------
     def _ingest_frame(self, payload) -> Optional[Message]:
@@ -472,13 +492,14 @@ class AsyncServerManager(ServerManager):
         self._ingest_sem.acquire()
         self._m_pool_depth.inc()
         try:
-            self._pool.submit(self._ingest_task, payload)
+            self._pool.submit(self._ingest_task, payload,
+                              time.perf_counter())
         except RuntimeError:                  # pool torn down mid-flight
             self._ingest_sem.release()
             self._m_pool_depth.dec()
         return None
 
-    def _ingest_task(self, payload) -> None:
+    def _ingest_task(self, payload, t_arrive: Optional[float] = None) -> None:
         """Decode-pool worker: decode one frame into a scratch row
         (zlib + numpy casts release the GIL, so tasks overlap), then
         fold it into the buffer."""
@@ -517,12 +538,21 @@ class AsyncServerManager(ServerManager):
                 msg.get_sender_id(), row,
                 float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES)),
                 int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+            if t_arrive is not None:
+                # admission latency: sink hand-off -> buffer insert
+                # (pool queue + decode + screen + lock), the ISSUE-11
+                # p95 gate's raw series
+                self._m_admission.observe(time.perf_counter() - t_arrive)
         except Exception:                     # never kill a pool worker
             log.exception("ingest task failed (%d bytes)", len(payload))
         finally:
             self._scratch.put(row)
             self._ingest_sem.release()
             self._m_pool_depth.dec()
+            # wake any reactor loop holding pressure-paused peers: a
+            # slot just freed (ISSUE 11 — resume is event-driven, the
+            # housekeeping scan is only the fallback)
+            self.com_manager._notify_ingest_ready()
 
     def _ingest_row(self, sender: int, row: np.ndarray, weight: float,
                     dispatched: int) -> None:

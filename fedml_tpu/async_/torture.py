@@ -25,6 +25,7 @@ windows.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import struct
 import threading
@@ -282,8 +283,14 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
         kw["base_port"] = base_port
         if backend == "TCP":
             # the pure-Python transport is the A/B's named spec; the
-            # native .so would move decode threading off-harness
+            # native .so would move decode threading off-harness.  The
+            # THREAD transport stays pinned here too (ISSUE 11): the
+            # legacy/bounded-inbox arms measure the thread-per-
+            # connection pathology by definition, and the decode-into
+            # arms keep their PR-6/8/9 bench continuity — the reactor
+            # is priced by its own bench, run_connection_torture
             kw["force_python_tcp"] = True
+            kw["reactor"] = False
 
     tracer = obs.tracer()
     # trace watermark: several torture arms share one process tracer
@@ -471,4 +478,235 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
         from fedml_tpu.obs import timeline
         report["critical_path"] = timeline.critical_path(
             [e for e in tracer.events() if e["ts"] >= trace_t0])
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the live-connection torture (ISSUE 11) — reactor transport under N live
+# sockets, storms, and shedding
+# ---------------------------------------------------------------------------
+
+def _swarm_subprocess(cfg, frame: bytes):
+    """Launch the swarm as `python -m fedml_tpu.comm.connswarm` so the
+    10k arm's client fds live in their own process (the container's
+    ulimit -n cannot hold both halves of 10k connections)."""
+    import json
+    import subprocess
+    import sys
+    import tempfile
+    fd, frame_path = tempfile.mkstemp(prefix="connswarm_", suffix=".bin")
+    with os.fdopen(fd, "wb") as f:
+        f.write(frame)
+    cfg.frame_path = frame_path
+    cfd, cfg_path = tempfile.mkstemp(prefix="connswarm_", suffix=".json")
+    with os.fdopen(cfd, "w") as f:
+        f.write(cfg.to_json())
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fedml_tpu.comm.connswarm", cfg_path],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def finish(timeout: float = 15.0) -> dict:
+        proc.terminate()
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate(timeout=5.0)
+        for p in (frame_path, cfg_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        try:
+            return json.loads(out.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return {}
+
+    return finish
+
+
+def run_connection_torture(*, n_connections: int = 256, p: int = 1024,
+                           buffer_k: int = 32, commits: int = 30,
+                           warmup_commits: int = 3, ingest_pool: int = 4,
+                           offered_rate: float = 2000.0,
+                           base_port: int = 53600,
+                           timeout_s: float = 600.0,
+                           storm: bool = False,
+                           churn_lifetime_s: float = 0.0,
+                           chaos: Optional[dict] = None,
+                           chaos_seed: int = 0, seed: int = 0,
+                           reactor_config=None,
+                           swarm_subprocess: Optional[bool] = None,
+                           template: Optional[dict] = None) -> dict:
+    """N LIVE connections against one reactor-transport async server
+    (ISSUE 11): a selector swarm keeps every socket open with paced
+    FMLR-enveloped uplinks at `offered_rate` aggregate frames/sec while
+    the server ingests, dedups, acks, and commits.  `storm=True`
+    replays a flash crowd as a connection storm (every SYN at once) and
+    `churn_lifetime_s` adds reconnect churn (seeded exponential
+    lifetimes); `chaos` installs the PR-8 fault injector at the
+    server's receive chokepoint.  The report carries the ISSUE-11
+    acceptance numbers: sustained committed-updates/sec, p50/p95
+    admission latency, peak open connections, every eviction/shed
+    counter, recv-thread deaths, and the process FD delta (the
+    leak audit).
+
+    `swarm_subprocess=None` auto-selects: in-process below ~4k
+    connections, a child process above (both halves of 10k connections
+    cannot share one ulimit -n)."""
+    import jax
+    from fedml_tpu.comm.connswarm import ConnectionSwarm, SwarmConfig
+    from fedml_tpu.comm.reactor import (ReactorConfig, open_fd_count,
+                                        reactor_default)
+
+    if not reactor_default():
+        # the subject under test IS the reactor; silently falling back
+        # to the thread transport would bench the wrong thing (and the
+        # report's reactor counters would read from a group that does
+        # not exist)
+        raise RuntimeError(
+            "run_connection_torture benches the reactor transport, but "
+            "FEDML_TCP_REACTOR=0 pins the thread transport process-wide "
+            "— unset it to run the connection bench")
+    if swarm_subprocess is None:
+        swarm_subprocess = n_connections > 4096
+    template = template if template is not None else make_template(p)
+    total = warmup_commits + commits
+    if reactor_config is None:
+        reactor_config = ReactorConfig(
+            reactors=max(2, (os.cpu_count() or 2)),
+            max_connections=max(n_connections + 64, 256),
+            stall_timeout_s=30.0,
+            shed_on_pressure=True, shed_after_s=2.0)
+
+    fd_before = open_fd_count()
+    policy = None
+    if chaos:
+        policy = ChaosPolicy(ChaosConfig(seed=chaos_seed, **chaos))
+    server = AsyncServerManager(
+        template, total, buffer_k, 0, n_connections + 1, "TCP",
+        staleness_mode="constant", mix=1.0, streaming=True,
+        ingest_pool=ingest_pool, decode_into=True, redispatch=False,
+        ip_config={0: "127.0.0.1"}, base_port=base_port,
+        force_python_tcp=True, reactor=True,
+        reactor_config=reactor_config)
+    if policy is not None:
+        server.com_manager.install_chaos(policy)
+    server.run_async()
+
+    hist_adm = obs.histogram("comm_admission_seconds")
+    hist_lag = obs.histogram("reactor_loop_lag_seconds", backend="tcp")
+    evict = {r: obs.counter("comm_connections_evicted_total",
+                            backend="tcp", reason=r)
+             for r in ("stall", "rate", "shed", "idle", "protocol",
+                       "error")}
+    shed = obs.counter("comm_uplinks_shed_total", backend="tcp")
+    drained = obs.counter("comm_connections_drained_total", backend="tcp")
+    deaths = obs.counter("comm_recv_thread_deaths_total")
+    dups = obs.counter("comm_reliable_dups_suppressed_total")
+    quar = obs.counter("comm_frames_quarantined_total")
+    base = {"evict": {r: c.value for r, c in evict.items()},
+            "shed": shed.value, "drained": drained.value,
+            "deaths": deaths.value, "dups": dups.value,
+            "quar": quar.value, "adm": hist_adm.cumulative(),
+            "lag": hist_lag.cumulative()}
+
+    # ONE uplink frame shared by the whole swarm (the server's dedup
+    # ledger is per-sender seq, so identical payload bytes are fine);
+    # constant staleness weights make the version echo weight-neutral
+    frame = _result_frame(template, 1, seed)
+    scfg = SwarmConfig(
+        host="127.0.0.1", port=base_port, n_connections=n_connections,
+        offered_rate=offered_rate,
+        ramp_s=(0.0 if storm else max(0.5, n_connections / 2000.0)),
+        storm=storm, churn_lifetime_s=churn_lifetime_s,
+        duration_s=timeout_s + 30.0, seed=seed)
+    swarm_stats: dict = {}
+    with obs.span("conn.torture", n=n_connections, storm=storm,
+                  churn=churn_lifetime_s, chaos=bool(chaos)):
+        if swarm_subprocess:
+            collect = _swarm_subprocess(scfg, frame)
+            swarm = None
+        else:
+            swarm = ConnectionSwarm(scfg, frame).start()
+        deadline = time.perf_counter() + timeout_s
+        while (len(server.commit_walls) < warmup_commits
+               and not server.done.is_set()
+               and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        adm0 = hist_adm.cumulative()
+        lag0 = hist_lag.cumulative()
+        finished = server.done.wait(
+            timeout=max(0.0, deadline - time.perf_counter()))
+        # monotone for the group's lifetime — one read after the wait
+        peak = server.com_manager._rg.peak_connections
+        if swarm is not None:
+            swarm.join()
+            swarm_stats = dict(swarm.stats)
+        else:
+            swarm_stats = collect()
+    if not finished:
+        obs.dump_flight("connection_torture_stall")
+        server.finish()
+        raise TimeoutError(
+            f"connection torture stalled: {server.version}/{total} "
+            f"commits in {timeout_s}s ({n_connections} connections, "
+            f"storm={storm})")
+    server.finish()
+    # teardown quiesce: poll the fd table back to its baseline before
+    # the leak audit reads it — straggler closes (shed sockets, the
+    # swarm's teardown) land a few hundred ms after finish(), and a
+    # fixed sleep mis-read those transients as ±leaks
+    deadline = time.perf_counter() + 2.0
+    while True:
+        fd_after = open_fd_count()
+        if fd_after <= fd_before or time.perf_counter() >= deadline:
+            break
+        time.sleep(0.05)
+
+    adm1, lag1 = hist_adm.cumulative(), hist_lag.cumulative()
+    if adm1[-1][1] - adm0[-1][1] <= 0:
+        adm0 = base["adm"]          # run outpaced the warmup snapshot
+    if lag1[-1][1] - lag0[-1][1] <= 0:
+        lag0 = base["lag"]          # same fallback for the lag window
+    walls, sizes = server.commit_walls, server.commit_sizes
+    dt = walls[-1] - walls[warmup_commits - 1]
+    updates = int(sum(sizes[warmup_commits:]))
+    report = {
+        "n_connections": int(n_connections),
+        "p": int(p),
+        "buffer_k": int(buffer_k),
+        "ingest_pool": int(ingest_pool),
+        "offered_rate": float(offered_rate),
+        "storm": bool(storm),
+        "churn_lifetime_s": float(churn_lifetime_s),
+        "chaos": dict(chaos) if chaos else None,
+        "chaos_injected": policy.summary() if policy is not None else None,
+        "commits": int(commits),
+        "updates_committed": updates,
+        "committed_updates_per_sec": updates / dt if dt > 0 else 0.0,
+        "admission_p50_s": quantile_from_cumulative(adm0, adm1, 0.50),
+        "admission_p95_s": quantile_from_cumulative(adm0, adm1, 0.95),
+        # post-warmup window, like the admission percentiles — the
+        # cold-start/jit iterations must not skew the steady-state gate
+        "loop_lag_p95_s": quantile_from_cumulative(lag0, lag1, 0.95),
+        "open_connections_peak": int(peak),
+        "evicted": {r: evict[r].value - base["evict"][r]
+                    for r in evict},
+        "uplinks_shed": shed.value - base["shed"],
+        "connections_drained": drained.value - base["drained"],
+        "recv_thread_deaths": deaths.value - base["deaths"],
+        "dups_suppressed": dups.value - base["dups"],
+        "quarantined": quar.value - base["quar"],
+        "fd_before": fd_before,
+        "fd_after": fd_after,
+        "fd_leaked": (fd_after - fd_before
+                      if fd_before >= 0 and fd_after >= 0 else None),
+        "swarm": swarm_stats,
+        "seed": int(seed),
+    }
+    report["finite"] = bool(all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree.leaves(server.variables)))
     return report

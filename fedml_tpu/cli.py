@@ -135,6 +135,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chaos_seed", type=int, default=0,
                    help="fault-injection seed: same seed = same "
                         "per-stream injected-event trace")
+    # overload-safe reactor transport (ISSUE 11, comm/reactor.py)
+    p.add_argument("--tcp_transport", choices=("reactor", "threads"),
+                   default="reactor",
+                   help="deployment mode, TCP/NATIVE_TCP: 'reactor' "
+                        "(default) = the selector event-loop transport "
+                        "— bounded per-connection buffers, slow-peer "
+                        "stall eviction, per-connection rate ceilings, "
+                        "load shedding and graceful drain (holds 10k "
+                        "live connections); 'threads' = the legacy "
+                        "one-recv-thread-per-connection path "
+                        "(FEDML_TCP_REACTOR=0 forces it process-wide)")
+    p.add_argument("--conn_reactors", type=int, default=1,
+                   help="reactor transport: event loops (≈ one per "
+                        "core on a busy server)")
+    p.add_argument("--conn_max", type=int, default=16384,
+                   help="reactor transport: inbound-connection "
+                        "admission ceiling — accepts past it are shed "
+                        "(counted in comm_uplinks_shed_total)")
+    p.add_argument("--conn_stall_timeout_s", type=float, default=30.0,
+                   help="reactor transport: slowloris eviction — a "
+                        "connection mid-frame with no progress for "
+                        "this long is closed (comm_connections_"
+                        "evicted_total{reason=stall})")
+    p.add_argument("--conn_max_frames_per_sec", type=float, default=None,
+                   help="reactor transport: per-connection frame-rate "
+                        "ceiling (violating windows throttle, repeat "
+                        "offenders evict with reason=rate); unset = "
+                        "no ceiling")
+    p.add_argument("--conn_max_bytes_per_sec", type=float, default=None,
+                   help="reactor transport: per-connection byte-rate "
+                        "ceiling (same throttle-then-evict ladder)")
     # async federation (fedml_tpu/async_): buffered staleness-aware
     # commits over a seeded client-lifecycle simulator — FedBuff-style
     # semi-async (commit on K buffered results or a deadline), FedAsync
@@ -914,6 +945,18 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
             f"(rank 0 is the server); got {args.rank}")
     ip_config = {r: "127.0.0.1" for r in range(size)}
     kw = dict(ip_config=ip_config, base_port=args.base_port)
+    if args.comm_backend in ("TCP", "NATIVE_TCP"):
+        # ISSUE 11: transport choice + the overload-safety knobs are
+        # deployment flags, not code edits — a flash crowd is survived
+        # by configuration
+        from fedml_tpu.comm.reactor import ReactorConfig
+        kw["reactor"] = args.tcp_transport == "reactor"
+        kw["reactor_config"] = ReactorConfig(
+            reactors=args.conn_reactors,
+            max_connections=args.conn_max,
+            stall_timeout_s=args.conn_stall_timeout_s,
+            max_frames_per_sec=args.conn_max_frames_per_sec,
+            max_bytes_per_sec=args.conn_max_bytes_per_sec)
 
     def _harden(manager) -> None:
         """ISSUE 8: opt this rank's transport into the reliability

@@ -23,4 +23,6 @@ from fedml_tpu.comm.base import BaseCommManager, Observer
 from fedml_tpu.comm.chaos import ChaosConfig, ChaosPolicy
 from fedml_tpu.comm.inproc import InProcBackend, InProcRouter
 from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.reactor import (FdExhaustionError, ReactorConfig,
+                                    ReactorGroup)
 from fedml_tpu.comm.reliability import BackoffPolicy, ReliableEndpoint

@@ -57,6 +57,8 @@ class BaseCommManager(abc.ABC):
         self._running = False
         self._draining = False
         self._frame_sink = None
+        self._ingest_pressure = None    # reactor backpressure probe
+        self._ingest_ready_hooks = []   # reactor resume wakeups
         self._chaos = None              # ChaosPolicy (install_chaos)
         self._rel_ep = None             # lazy ReliableEndpoint
         self._reliable_tx = False       # sends are enveloped when True
@@ -110,6 +112,21 @@ class BaseCommManager(abc.ABC):
                 "chaos installed on %s, but this backend never "
                 "materializes wire frames — only the send gate "
                 "(partition/drop/delay) applies", self.backend_name)
+        cfg = getattr(policy, "cfg", None)
+        if (getattr(self, "reactor_mode", False) and cfg is not None
+                and getattr(cfg, "delay", 0.0) > 0.0):
+            # on the reactor transport the receive path runs on a
+            # SHARED event loop: an injected delay sleeps the loop, so
+            # it models a NIC-level stall hitting every conn on that
+            # loop, not one slow peer (the thread transport's shape) —
+            # loud, because the head-of-line coupling changes what the
+            # fault measures
+            log.warning(
+                "chaos delay faults on the %s reactor transport stall "
+                "the shared event loop (head-of-line for every conn on "
+                "it), not just the injected peer — use the thread "
+                "transport (reactor=False) for per-peer delay "
+                "semantics", self.backend_name)
         self._chaos = policy
 
     def enable_reliability(self, policy=None) -> bool:
@@ -238,6 +255,50 @@ class BaseCommManager(abc.ABC):
         blocking sink is the backpressure mechanism: the transport's
         recv loop stalls, and flow control propagates to the sender."""
         self._frame_sink = sink
+
+    def set_ingest_pressure(self, fn) -> None:
+        """Install a non-blocking admission probe (ISSUE 11): `fn()`
+        returns True while the consumer CANNOT take another frame (the
+        decode pool is at its in-flight bound).  Reactor transports
+        consult it BEFORE delivering a reassembled frame and suspend
+        the peer's read interest instead of blocking a shared loop
+        thread — the event-loop twin of the blocking-sink backpressure
+        thread transports get for free.  Thread transports ignore it
+        (their recv thread blocking in the sink IS the backpressure)."""
+        self._ingest_pressure = fn
+
+    def add_ingest_ready_hook(self, fn) -> None:
+        """Register a wakeup a reactor loop installs the first time it
+        suspends a peer for pressure: the consumer calls
+        `_notify_ingest_ready()` whenever capacity frees, so paused
+        reads resume within one event-loop wakeup instead of waiting
+        for the housekeeping scan."""
+        if fn not in self._ingest_ready_hooks:
+            self._ingest_ready_hooks.append(fn)
+
+    def _notify_ingest_ready(self) -> None:
+        for fn in list(self._ingest_ready_hooks):
+            try:
+                fn()
+            except Exception:
+                log.exception("ingest-ready hook failed")
+
+    def _reactor_pressure(self) -> bool:
+        """True while a reactor must NOT deliver another frame: the
+        installed ingest probe says the pool is full, or the bounded
+        inbox is — both resolve by suspending reads, never by blocking
+        the loop."""
+        fn = self._ingest_pressure
+        if fn is not None:
+            try:
+                if fn():
+                    return True
+            except Exception:
+                log.exception("ingest pressure probe failed — treating "
+                              "as no pressure")
+        if self._inbox.maxsize > 0 and self._inbox.full():
+            return True
+        return False
 
     def _deliver_frame(self, payload, reply=None) -> None:
         """Inbound raw-frame chokepoint shared by every codec-framed

@@ -1,0 +1,348 @@
+"""Selector-based connection swarm — the client half of the live-
+connection bench (ISSUE 11).
+
+Driving 10k live uplinks cannot be done with 10k client threads any
+more than serving them can: the swarm is the reactor's mirror image —
+ONE event loop owning N non-blocking client sockets that connect
+(optionally as a storm: every SYN at once, the push-notification
+stampede), keep a paced uplink going (an aggregate offered rate spread
+round-robin across the fleet, each frame riding the FMLR envelope so
+the server's dedup ledger and ack path see production-shaped traffic),
+read-and-discard the acks, and churn (seeded exponential lifetimes →
+close + reconnect; a server-side eviction/shed also reconnects — the
+flash-crowd arrival shape replayed as connection churn).
+
+Runs in-process (a daemon thread, the test path) or as a subprocess
+(`python -m fedml_tpu.comm.connswarm <config.json>`) so the 10k arm
+splits its file descriptors across two processes — the container's
+`ulimit -n` cannot hold both halves of 10k connections in one.
+Everything is seeded: same seed, same connect/churn schedule.
+"""
+from __future__ import annotations
+
+import dataclasses
+import errno
+import heapq
+import json
+import logging
+import selectors
+import socket
+import struct
+import sys
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.comm import reliability
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+_INPROGRESS = (errno.EINPROGRESS, errno.EWOULDBLOCK, errno.EAGAIN)
+
+
+@dataclasses.dataclass
+class SwarmConfig:
+    """Knobs of one swarm run.  The pre-encoded uplink frame is passed
+    as bytes in-process, or via `frame_path` for the subprocess mode."""
+    host: str = "127.0.0.1"
+    port: int = 53600
+    n_connections: int = 256
+    offered_rate: float = 2000.0     # aggregate uplink frames/sec
+    ramp_s: float = 1.0              # clean arm: connects spread over this
+    storm: bool = False              # storm arm: every connect at t=0
+    churn_lifetime_s: float = 0.0    # mean conn lifetime (0 = no churn)
+    reconnect_delay_s: float = 0.05
+    duration_s: float = 600.0        # subprocess self-termination bound
+    seed: int = 0
+    frame_path: Optional[str] = None
+    tick_s: float = 0.01
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "SwarmConfig":
+        return cls(**json.loads(text))
+
+
+class _CConn:
+    __slots__ = ("sock", "fd", "sender", "connected", "pending",
+                 "die_at", "mask")
+
+    def __init__(self, sock: socket.socket, sender: int):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.sender = sender
+        self.connected = False
+        self.pending: Optional[memoryview] = None
+        self.die_at: Optional[float] = None
+        self.mask = 0
+
+
+class ConnectionSwarm:
+    """One event loop, N client connections, paced enveloped uplinks."""
+
+    def __init__(self, cfg: SwarmConfig, frame: bytes):
+        self.cfg = cfg
+        self.frame = bytes(frame)
+        self._crc = zlib.crc32(self.frame) & 0xFFFFFFFF
+        self._rng = np.random.default_rng([cfg.seed, 7])
+        self._sel = selectors.DefaultSelector()
+        self._conns: dict[int, _CConn] = {}
+        self._seq: dict[int, int] = {}       # persists across reconnects
+        self._send_ring: deque = deque()     # round-robin uplink order
+        self._events: list[tuple[float, int]] = []  # heap: (due, sender),
+        #                                             absolute monotonic
+        self.stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"connects": 0, "reconnects": 0, "refused": 0,
+                      "frames_sent": 0, "conn_errors": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ConnectionSwarm":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="conn-swarm")
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float = 10.0) -> None:
+        self.stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    # -- loop ----------------------------------------------------------------
+    def run(self) -> None:
+        cfg = self.cfg
+        t0 = time.monotonic()
+        for sender in range(1, cfg.n_connections + 1):
+            due = 0.0 if cfg.storm else (
+                cfg.ramp_s * sender / cfg.n_connections)
+            heapq.heappush(self._events, (t0 + due, sender))
+        budget = 0.0
+        last = t0
+        deadline = t0 + cfg.duration_s
+        try:
+            while not self.stop.is_set() and time.monotonic() < deadline:
+                now = time.monotonic()
+                while self._events and self._events[0][0] <= now:
+                    _, sender = heapq.heappop(self._events)
+                    self._connect(sender, now)
+                for key, mask in self._sel.select(timeout=cfg.tick_s):
+                    conn = key.data
+                    if self._conns.get(conn.fd) is not conn:
+                        continue
+                    try:
+                        if mask & selectors.EVENT_WRITE:
+                            self._on_writable(conn)
+                        # re-check liveness BETWEEN handlers: a failed
+                        # handshake (READ|WRITE on a refused connect)
+                        # closes + reschedules in the WRITE handler,
+                        # and running READ on the corpse would
+                        # reschedule the same sender a second time —
+                        # doubling the fleet on every refusal
+                        if (mask & selectors.EVENT_READ
+                                and self._conns.get(conn.fd) is conn):
+                            self._on_readable(conn)
+                    except OSError:
+                        if self._conns.get(conn.fd) is conn:
+                            self._drop(conn, error=True)
+                now = time.monotonic()
+                budget = min(budget + cfg.offered_rate * (now - last),
+                             cfg.offered_rate)       # no post-stall burst
+                last = now
+                tried = 0
+                limit = len(self._send_ring)
+                while budget >= 1.0 and tried < limit and self._send_ring:
+                    conn = self._send_ring.popleft()
+                    tried += 1
+                    if self._conns.get(conn.fd) is not conn:
+                        continue          # churned away: drop ring entry
+                    if conn.connected and conn.pending is None:
+                        if self._uplink(conn):
+                            budget -= 1.0
+                    if self._conns.get(conn.fd) is conn:
+                        self._send_ring.append(conn)
+                if cfg.churn_lifetime_s > 0.0:
+                    self._churn(now)
+        finally:
+            for conn in list(self._conns.values()):
+                self._close(conn)
+            try:
+                self._sel.close()
+            except OSError:
+                pass
+
+    # -- connect / churn -----------------------------------------------------
+    def _schedule_reconnect(self, sender: int) -> None:
+        if self.stop.is_set():
+            return
+        delay = self.cfg.reconnect_delay_s * (
+            1.0 + float(self._rng.random()))
+        heapq.heappush(self._events, (time.monotonic() + delay, sender))
+
+    def _connect(self, sender: int, now: float) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setblocking(False)
+        try:
+            rc = s.connect_ex((self.cfg.host, self.cfg.port))
+        except OSError:
+            s.close()
+            self.stats["conn_errors"] += 1
+            self._schedule_reconnect(sender)
+            return
+        if rc not in (0,) and rc not in _INPROGRESS:
+            s.close()
+            self.stats["refused"] += 1
+            self._schedule_reconnect(sender)
+            return
+        conn = _CConn(s, sender)
+        if self.cfg.churn_lifetime_s > 0.0:
+            conn.die_at = now + float(self._rng.exponential(
+                self.cfg.churn_lifetime_s))
+        try:
+            self._sel.register(s, selectors.EVENT_WRITE
+                               | selectors.EVENT_READ, conn)
+        except (ValueError, OSError):
+            # FD pressure / transient selector failure: this sender
+            # must NOT silently vanish from the swarm (a run under
+            # reduced load would masquerade as n_connections of
+            # pressure — the PR-6 dead-client lesson) — count + retry
+            s.close()
+            self.stats["conn_errors"] += 1
+            self._schedule_reconnect(sender)
+            return
+        conn.mask = selectors.EVENT_WRITE | selectors.EVENT_READ
+        self._conns[conn.fd] = conn
+        self.stats["connects"] += 1
+        if self._seq.get(sender, 0) > 0:
+            self.stats["reconnects"] += 1
+        self._send_ring.append(conn)
+
+    def _churn(self, now: float) -> None:
+        for conn in list(self._conns.values()):
+            if conn.die_at is not None and now >= conn.die_at:
+                sender = conn.sender
+                self._close(conn)
+                self._schedule_reconnect(sender)
+
+    # -- socket events -------------------------------------------------------
+    def _on_writable(self, conn: _CConn) -> None:
+        if not conn.connected:
+            err = conn.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+            if err != 0:
+                # refused/reset mid-handshake: the shed gate at work —
+                # retry after the reconnect delay (the storm's churn)
+                self.stats["refused"] += 1
+                sender = conn.sender
+                self._close(conn)
+                self._schedule_reconnect(sender)
+                return
+            conn.connected = True
+            try:
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        if conn.pending is not None:
+            n = conn.sock.send(conn.pending)
+            conn.pending = (conn.pending[n:] if n < len(conn.pending)
+                            else None)
+        self._interest(conn)
+
+    def _on_readable(self, conn: _CConn) -> None:
+        # acks/nacks: drain and discard — the swarm prices the server,
+        # not the client's bookkeeping
+        data = conn.sock.recv(1 << 16)
+        if not data:
+            # server closed us (eviction / shed / drain): reconnect —
+            # exactly the churn pressure the storm arm measures
+            self.stats["conn_errors"] += 1
+            self._drop(conn)
+
+    def _uplink(self, conn: _CConn) -> bool:
+        seq = self._seq.get(conn.sender, 0)
+        self._seq[conn.sender] = seq + 1
+        head = reliability._HEADER.pack(
+            reliability.MAGIC, reliability.KIND_DATA, conn.sender, seq,
+            self._crc)
+        wire = head + self.frame
+        buf = _LEN.pack(len(wire)) + wire
+        try:
+            n = conn.sock.send(buf)
+        except (BlockingIOError, InterruptedError):
+            n = 0
+        except OSError:
+            self._drop(conn, error=True)
+            return False
+        if n < len(buf):
+            conn.pending = memoryview(buf)[n:]
+        self.stats["frames_sent"] += 1
+        self._interest(conn)
+        return True
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _interest(self, conn: _CConn) -> None:
+        mask = selectors.EVENT_READ
+        if conn.pending is not None or not conn.connected:
+            mask |= selectors.EVENT_WRITE
+        if mask != conn.mask:
+            try:
+                self._sel.modify(conn.sock, mask, conn)
+                conn.mask = mask
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _drop(self, conn: _CConn, error: bool = False) -> None:
+        if error:
+            self.stats["conn_errors"] += 1
+        sender = conn.sender
+        self._close(conn)
+        self._schedule_reconnect(sender)
+
+    def _close(self, conn: _CConn) -> None:
+        if self._conns.pop(conn.fd, None) is None:
+            return
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Subprocess entry: `python -m fedml_tpu.comm.connswarm cfg.json`.
+    Runs until SIGTERM (or duration_s), then prints one JSON stats
+    line — the parent torture harness collects it."""
+    import signal
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m fedml_tpu.comm.connswarm <config.json>",
+              file=sys.stderr)
+        return 2
+    cfg = SwarmConfig.from_json(open(argv[0]).read())
+    if not cfg.frame_path:
+        print("subprocess swarm needs frame_path", file=sys.stderr)
+        return 2
+    frame = open(cfg.frame_path, "rb").read()
+    swarm = ConnectionSwarm(cfg, frame)
+
+    def _term(signum, frm):
+        swarm.stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    swarm.run()
+    print(json.dumps(swarm.stats), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,7 +45,9 @@ def _build_backend(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
         # explicit selection may compile the library on first use
         from fedml_tpu.comm.native_tcp import NativeTcpBackend
         return NativeTcpBackend(rank, kw["ip_config"],
-                                kw.get("base_port", 52000))
+                                kw.get("base_port", 52000),
+                                reactor=bool(kw.get("reactor", False)),
+                                reactor_config=kw.get("reactor_config"))
     if b == "TCP":
         # auto-upgrade to the native transport only when the .so is already
         # built (never run a compile inside backend construction)
@@ -53,10 +55,18 @@ def _build_backend(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
         if library_built() and not kw.pop("force_python_tcp", False):
             from fedml_tpu.comm.native_tcp import NativeTcpBackend
             return NativeTcpBackend(rank, kw["ip_config"],
-                                    kw.get("base_port", 52000))
+                                    kw.get("base_port", 52000),
+                                    reactor=bool(kw.get("reactor", False)),
+                                    reactor_config=kw.get("reactor_config"))
         from fedml_tpu.comm.tcp_backend import TcpBackend
+        # reactor=None -> the transport default (reactor unless
+        # FEDML_TCP_REACTOR=0); callers pin either path explicitly —
+        # the ingest torture's legacy arms force threads, the
+        # connection bench forces the reactor with a tuned config
         return TcpBackend(rank, kw["ip_config"],
-                          base_port=kw.get("base_port", 52000))
+                          base_port=kw.get("base_port", 52000),
+                          reactor=kw.get("reactor"),
+                          reactor_config=kw.get("reactor_config"))
     if b == "MQTT":
         from fedml_tpu.comm.mqtt_backend import MqttBackend
         return MqttBackend(rank, size, host=kw.get("host", "127.0.0.1"),

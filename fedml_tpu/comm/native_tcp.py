@@ -10,6 +10,16 @@ comm daemons are Python threads, mpi_receive_thread.py:19-28).
 Falls back is the caller's job: `native_available()` says whether the
 library loaded; managers select backend "NATIVE_TCP" explicitly or "TCP"
 picks native automatically when present.
+
+Reactor receive path (ISSUE 11): `reactor=True` rewires this backend's
+INBOUND side onto the shared selector reactor (comm/reactor.py) — same
+wire format, but with the overload-safety machinery (bounded buffers,
+stall/rate eviction, load shedding, graceful drain, read-suspension
+backpressure) the native drain loop cannot provide.  Outbound sends
+keep the native fh_connect/fh_send fast path either way.  Default is
+the native drain loop (its no-GIL frame reassembly is the point of
+this backend); deployments that need overload safety over raw C++
+throughput opt in per instance or via FEDML_TCP_REACTOR.
 """
 from __future__ import annotations
 
@@ -17,10 +27,11 @@ import ctypes
 import logging
 import threading
 import time
-from typing import Union
+from typing import Optional, Union
 
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.comm.reactor import ReactorConfig, ReactorGroup
 from fedml_tpu.comm.reliability import BackoffPolicy
 from fedml_tpu.native import load_library
 
@@ -38,9 +49,16 @@ def native_available() -> bool:
 
 class NativeTcpBackend(BaseCommManager):
     backend_name = "native_tcp"
+    # fh_* peers never read their dial-out sockets (the API has no
+    # in-band reply channel) — a reactor inbound path must route
+    # acks/nacks through _raw_send (dial the peer's own listener), NOT
+    # back over the accepted socket where they'd rot unread and every
+    # enveloped frame would resend to abandonment
+    reactor_inband_reply = False
 
     def __init__(self, rank: int, ip_config: Union[str, dict],
-                 base_port: int = 52000):
+                 base_port: int = 52000, reactor: bool = False,
+                 reactor_config: Optional[ReactorConfig] = None):
         super().__init__()
         from fedml_tpu.comm.grpc_backend import load_ip_config
         self._lib = load_library()
@@ -49,12 +67,30 @@ class NativeTcpBackend(BaseCommManager):
         self.rank = rank
         self.ip_config = load_ip_config(ip_config)
         self.base_port = base_port
-        self._server = self._lib.fh_server_create(base_port + rank)
-        if not self._server:
-            raise OSError(f"cannot listen on port {base_port + rank}")
         self._conns: dict[int, int] = {}
         self._conn_lock = threading.Lock()
         self._alive = True
+        from fedml_tpu.comm.reactor import reactor_default
+        # FEDML_TCP_REACTOR=0 is PROCESS-WIDE (same hatch TcpBackend
+        # honors): it pins the native drain loop even when a caller
+        # asked for the reactor inbound path
+        self.reactor_mode = bool(reactor) and reactor_default()
+        self._rg: Optional[ReactorGroup] = None
+        self._server = None
+        self._drain = None
+        if self.reactor_mode:
+            # inbound over the Python reactor (overload safety:
+            # eviction deadlines, rate ceilings, shed gate, drain);
+            # outbound stays native fh_send.  Same 8-byte-LE-length
+            # wire, so native and reactor peers interoperate.
+            self._rg = ReactorGroup(
+                self, ("0.0.0.0", base_port + rank), reactor_config,
+                name=f"native-{rank}")
+            self._rg.start()
+            return
+        self._server = self._lib.fh_server_create(base_port + rank)
+        if not self._server:
+            raise OSError(f"cannot listen on port {base_port + rank}")
         self._drain = threading.Thread(target=self._drain_loop, daemon=True)
         self._drain.start()
 
@@ -151,6 +187,13 @@ class NativeTcpBackend(BaseCommManager):
         if not self._alive:
             return
         self._alive = False
+        if self.reactor_mode:
+            self._rg.close()        # drain + close every inbound socket
+            with self._conn_lock:
+                for c in self._conns.values():
+                    self._lib.fh_conn_close(c)
+                self._conns.clear()
+            return
         with self._conn_lock:
             for c in self._conns.values():
                 self._lib.fh_conn_close(c)

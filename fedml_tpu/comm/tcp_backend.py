@@ -5,19 +5,33 @@ covers this niche with Torch-RPC/TensorPipe, trpc_comm_manager.py:26-144 —
 tensor-native, no JSON).  Frame format: 8-byte little-endian length ‖
 MessageCodec bytes.
 
+Two receive transports, one wire format (ISSUE 11):
+
+* **reactor** (default): a `selectors` event loop per core
+  (comm/reactor.py) owns non-blocking accepted sockets with bounded
+  buffers, incremental frame reassembly, stall/rate eviction, load
+  shedding, and graceful drain — the overload-safe path that holds 10k
+  live connections.  Backpressure from the decode pool reaches the
+  peer as read-interest suspension, never as a blocked loop thread.
+* **threads** (`reactor=False`, or FEDML_TCP_REACTOR=0 process-wide):
+  the original one-recv-thread-per-connection path — kept as the
+  behavioral spec, the bitwise anchor (a reactor run commits the same
+  accumulator, pinned in tests/test_reactor.py), and the ingest
+  torture's faithful PR-5/6 A/B arm.
+
 When the native C++ transport (fedml_tpu/native/) is built, `TcpBackend`
 transparently uses it for the socket loop; this pure-Python path is the
 fallback and the behavioral spec.
 
 Reliability (ISSUE 8): with `enable_reliability()` the frame rides the
 FMLR envelope and acks flow back over the SAME connection the data
-arrived on (`_recv_loop` hands `_deliver_frame` a reply callable) — so a
-client that only dials out still gets its acks; outbound connections
-additionally get a reader thread so dial-out acks for OUR enveloped
-sends are seen too.  Resends reuse `_raw_send`, which invalidates the
-cached connection on failure and redials — a server restart (the
-crash-resume scenario) is survived by the backoff schedule, not by the
-caller.
+arrived on (both transports hand `_deliver_frame` a reply callable) —
+so a client that only dials out still gets its acks; outbound
+connections are registered with the reactor for reads (thread mode
+spawns a reader) so dial-out acks for OUR enveloped sends are seen too.
+Resends reuse `_raw_send`, which invalidates the cached connection on
+failure and redials — a server restart (the crash-resume scenario) is
+survived by the backoff schedule, not by the caller.
 """
 from __future__ import annotations
 
@@ -26,10 +40,12 @@ import socket
 import struct
 import threading
 import time
-from typing import Union
+from typing import Optional, Union
 
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message, MessageCodec
+from fedml_tpu.comm.reactor import (ReactorConfig, ReactorGroup,
+                                    accept_exhaustion, reactor_default)
 from fedml_tpu.comm.reliability import BackoffPolicy
 
 log = logging.getLogger(__name__)
@@ -55,7 +71,9 @@ class TcpBackend(BaseCommManager):
     backend_name = "tcp"
 
     def __init__(self, rank: int, ip_config: Union[str, dict],
-                 base_port: int = 52000):
+                 base_port: int = 52000,
+                 reactor: Optional[bool] = None,
+                 reactor_config: Optional[ReactorConfig] = None):
         super().__init__()
         from fedml_tpu.comm.grpc_backend import load_ip_config
         self.rank = rank
@@ -63,16 +81,33 @@ class TcpBackend(BaseCommManager):
         self.base_port = base_port
         self._conns: dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
-        # accepted (inbound) connections, closed on close(): leaving
-        # them established would hold the listen port hostage against a
-        # same-port restart — the crash-resume rebind — and leave peers
-        # talking into a half-dead socket
+        # accepted (inbound) connections (thread mode), closed on
+        # close(): leaving them established would hold the listen port
+        # hostage against a same-port restart — the crash-resume rebind
+        # — and leave peers talking into a half-dead socket
         self._accepted: set[socket.socket] = set()
+        self._alive = True
+        # FEDML_TCP_REACTOR=0 overrides everything (the escape hatch);
+        # an explicit reactor= argument overrides the default
+        if not reactor_default():
+            reactor = False
+        elif reactor is None:
+            reactor = True
+        self.reactor_mode = bool(reactor)
+        self._rg: Optional[ReactorGroup] = None
+        self._listener: Optional[socket.socket] = None
+        if self.reactor_mode:
+            # the group binds synchronously, so a busy port raises from
+            # the constructor exactly like the thread transport
+            self._rg = ReactorGroup(
+                self, ("0.0.0.0", base_port + rank), reactor_config,
+                name=f"tcp-{rank}")
+            self._rg.start()
+            return
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("0.0.0.0", base_port + rank))
         self._listener.listen(64)
-        self._alive = True
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -81,7 +116,16 @@ class TcpBackend(BaseCommManager):
         while self._alive:
             try:
                 conn, _ = self._listener.accept()
-            except OSError:
+            except OSError as e:
+                exh = accept_exhaustion(e)
+                if exh is not None and self._alive:
+                    # ISSUE-11 satellite: fd exhaustion is a NAMED
+                    # error with the current ulimit, and the listener
+                    # SURVIVES with a backoff — a bare OSError used to
+                    # end this loop and silently stop accepting forever
+                    log.error("tcp rank %d: %s", self.rank, exh)
+                    time.sleep(0.5)
+                    continue
                 return
             with self._conn_lock:
                 self._accepted.add(conn)
@@ -122,6 +166,15 @@ class TcpBackend(BaseCommManager):
             with self._conn_lock:
                 self._accepted.discard(conn)
 
+    def _on_outbound_closed(self, sock: socket.socket) -> None:
+        """Reactor callback: a dial-out connection it owned for reads
+        died/was drained — drop the cached handle so the next send
+        redials instead of writing into a closed socket."""
+        with self._conn_lock:
+            for rx, s in list(self._conns.items()):
+                if s is sock:
+                    self._conns.pop(rx, None)
+
     def _connect(self, receiver: int, retry_for: float = 60.0) -> socket.socket:
         with self._conn_lock:
             s = self._conns.get(receiver)
@@ -156,7 +209,12 @@ class TcpBackend(BaseCommManager):
                 s.close()
                 return racer
             self._conns[receiver] = s
-        if self._reliable_tx:
+        if self.reactor_mode:
+            # the reactor owns reads on dial-out conns (acks from an
+            # enveloping peer); the socket stays blocking — sender
+            # threads own the write side via sendall under _conn_lock
+            self._rg.adopt_outbound(s)
+        elif self._reliable_tx:
             # dial-out connections need a reader: the peer's acks for
             # our enveloped frames come back over this socket
             threading.Thread(target=self._recv_loop, args=(s,),
@@ -177,6 +235,8 @@ class TcpBackend(BaseCommManager):
             with self._conn_lock:
                 if self._conns.get(receiver) is sock:
                     self._conns.pop(receiver, None)
+            if self._rg is not None:
+                self._rg.forget(sock)   # BEFORE close: fileno still valid
             try:
                 sock.close()
             except OSError:
@@ -186,10 +246,11 @@ class TcpBackend(BaseCommManager):
     def _chaos_disconnect(self, msg: Message) -> bool:
         """Disconnect-mid-frame fault: send the length prefix plus HALF
         the frame, then hard-close the connection.  The receiver's
-        _read_exact dies with ConnectionError (that conn only) and the
-        next real send redials — the torn-wire case the reliability
-        resend exists for, so under the envelope the frame is registered
-        first and recovers."""
+        reassembly path sees the torn frame end in EOF, drops the
+        partial, and closes that conn only; the next real send redials
+        — the torn-wire case the reliability resend exists for, so
+        under the envelope the frame is registered first and
+        recovers."""
         rx = msg.get_receiver_id()
         payload = MessageCodec.encode(msg)
         if self._reliable_tx:
@@ -200,6 +261,8 @@ class TcpBackend(BaseCommManager):
                 sock.sendall(struct.pack("<Q", len(payload)))
                 sock.sendall(payload[:max(1, len(payload) // 2)])
                 self._conns.pop(rx, None)
+            if self._rg is not None:
+                self._rg.forget(sock)   # BEFORE close: fileno still valid
             sock.close()
         except OSError:
             pass                     # the fault IS a broken connection
@@ -233,6 +296,21 @@ class TcpBackend(BaseCommManager):
 
     def close(self) -> None:
         self._alive = False
+        if self.reactor_mode:
+            # graceful drain: the group stops accepting, flushes
+            # pending writes inside its drain budget, and closes every
+            # socket it owns (accepted AND adopted dial-outs) — the
+            # listen port is free for a same-port restart when this
+            # returns
+            self._rg.close()
+            with self._conn_lock:
+                for s in self._conns.values():
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                self._conns.clear()
+            return
         # shutdown BEFORE close: close() alone does not interrupt the
         # accept(2) the _accept_loop thread is blocked in, and the
         # in-flight syscall keeps the kernel socket alive and LISTENING

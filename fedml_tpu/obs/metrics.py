@@ -83,6 +83,14 @@ CANONICAL_BUCKETS = {
     # the admission pipeline's per-row screen wall (async_/defense.py):
     # one O(P) jitted step, sub-ms like a decode — same ladder
     "defense_screen_seconds": DECODE_SECONDS_BUCKETS,
+    # reactor transport (ISSUE 11, comm/reactor.py): how long one loop
+    # iteration's event batch held the loop — healthy is tens of µs,
+    # an overloaded loop spills into the ms decades the same sub-ms
+    # ladder resolves
+    "reactor_loop_lag_seconds": DECODE_SECONDS_BUCKETS,
+    # admission latency (async_/lifecycle.py): transport hand-off ->
+    # buffer insert; the connection bench's p95 gate
+    "comm_admission_seconds": DECODE_SECONDS_BUCKETS,
 }
 
 

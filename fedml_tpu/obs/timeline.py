@@ -61,13 +61,18 @@ SPAN_STAGES = {
     "async.eval": "eval",
     "eval": "eval",
     "checkpoint": "checkpoint",
+    # ISSUE 11: reactor transport housekeeping/drain (eviction scans,
+    # shed batches, graceful close) — rare, but when overload handling
+    # dominates a round's wall the timeline must say so
+    "reactor.housekeep": "reactor",
+    "reactor.drain": "reactor",
 }
 # commit-family span names: their end times delimit round windows on
 # event-driven paths (the async scheduler's commits, the deployment
 # FSM's aggregates) where no single `round` call frame exists
 COMMIT_SPANS = ("async.commit", "fsm.aggregate")
 STAGE_PRIORITY = ("commit", "decode", "fold", "train", "uplink",
-                  "dispatch", "h2d", "eval", "checkpoint")
+                  "dispatch", "h2d", "eval", "checkpoint", "reactor")
 WAIT_STAGE = "wait"
 
 

@@ -392,6 +392,67 @@ def test_chip_queue_carries_serve_step():
     assert r.returncode == 0, r.stderr
 
 
+def test_bench_json_schema_v10_carries_connections_block():
+    """ISSUE 11: schema v10 adds the connections-mode fields — the
+    "connections" block from `python bench.py --mode connections` with
+    one row per live-connection count, each carrying a clean / chaos /
+    storm arm (committed_updates_per_sec, admission p50/p95, peak open
+    connections, the evicted{stall|rate|shed} + uplinks_shed +
+    recv_thread_deaths + fd_leaked counters, loop-lag p95) and the
+    storm_goodput_ratio headline.  Static source check like the v3-v9
+    guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 10, (
+        "bench schema must stay >= v10 (live-connection block)")
+    for field in ('"connections"', "_bench_connections",
+                  "admission_p50_s", "admission_p95_s",
+                  "storm_goodput_ratio", "open_connections_peak",
+                  "uplinks_shed", "fd_leaked", "loop_lag_p95_s"):
+        assert field in src, (
+            f"bench.py lost the v10 connections field {field} "
+            "(see fedml_tpu/comm/reactor.py and _bench_connections)")
+    # the block's numbers come from the connection torture's report —
+    # names must stay in sync
+    tort = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "async_", "torture.py")).read()
+    for field in ("run_connection_torture", "admission_p95_s",
+                  "open_connections_peak", "fd_leaked", "uplinks_shed",
+                  "loop_lag_p95_s"):
+        assert field in tort, (
+            f"run_connection_torture's report lost {field!r} — "
+            "bench.py's v10 connections block reads it")
+    # and the transport layer itself must exist
+    for mod in ("reactor.py", "connswarm.py"):
+        assert os.path.exists(os.path.join(
+            os.path.dirname(__file__), "..", "fedml_tpu", "comm", mod)), (
+            f"fedml_tpu/comm/{mod} (the ISSUE-11 reactor transport) is "
+            "gone")
+
+
+def test_chip_queue_carries_conn_step():
+    """ISSUE 11: the next chip window must price the live-connection
+    reactor — scripts/run_chip_queue.sh carries the CONN step (13/13)
+    and profile_bench.py defines the exp_CONN experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    src = open(queue).read()
+    assert "profile_bench.py CONN" in src, (
+        "run_chip_queue.sh lost the CONN live-connection reactor step "
+        "(ISSUE 11 queues it for the next chip window)")
+    assert "13/13" in src, (
+        "run_chip_queue.sh lost the 13/13 step numbering — the CONN "
+        "step must be the queue's last step")
+    assert "exp_CONN" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_CONN experiment the queue runs")
+    import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+
+
 def test_chip_queue_carries_chaos_ab():
     """ISSUE 8: the next chip window must price the chaos goodput —
     scripts/run_chip_queue.sh carries the CHAOS step (10/10) and

@@ -1147,6 +1147,39 @@ def exp_SERVE():
                   f"rss {r['rss_bytes'] / 1e6:.0f} MB", flush=True)
 
 
+def exp_CONN():
+    """Live-connection reactor A/B (ISSUE 11): 256 and 1k live sockets
+    against the selector reactor transport, clean vs storm (mixed
+    chaos 5%+1%+0.5% + connection storm + reconnect churn) — the
+    chip-side rerun of `bench.py --mode connections` with the
+    chip-attached jax runtime dispatching the fold/commit.  Gates:
+    storm >= 0.5x clean committed-updates/sec, zero recv-thread
+    deaths, zero leaked FDs."""
+    from fedml_tpu.async_.torture import run_connection_torture
+
+    port = 53760
+    for n in (256, 1000):
+        base = None
+        for tag, kw in (("clean", {}),
+                        ("storm", dict(
+                            chaos={"drop": 0.05, "dup": 0.01,
+                                   "corrupt": 0.005},
+                            storm=True, churn_lifetime_s=5.0))):
+            port += 2
+            r = run_connection_torture(
+                n_connections=n, buffer_k=32, commits=24,
+                warmup_commits=3, ingest_pool=4, offered_rate=2000.0,
+                base_port=port, timeout_s=900, **kw)
+            ups = r["committed_updates_per_sec"]
+            base = ups if base is None else base
+            print(f"CONN n={n} {tag}: {ups:.1f} updates/s "
+                  f"({ups / base:.2f}x vs clean)  admission p95 "
+                  f"{r['admission_p95_s'] * 1e3:.1f} ms  evicted "
+                  f"{r['evicted']}  shed {r['uplinks_shed']:.0f}  "
+                  f"fd leak {r['fd_leaked']}  recv deaths "
+                  f"{r['recv_thread_deaths']:.0f}", flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
