@@ -92,6 +92,17 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     fd_leaked counters and loop_lag_p95_s, plus per-row
 #     storm_goodput_ratio (the >= 0.5x-of-clean acceptance gate) —
 #     null in other modes, so v9 readers keep working
+# v11: + "slo" block (ISSUE 12, fedml_tpu/obs/slo.py) on EVERY mode —
+#     the default serving-spine SLO pack evaluated per bench arm
+#     ({"pack", "arms": {arm: {breaches, breached, healthy}}}); clean
+#     arms must stay breach-free (tools/bench_diff.py's
+#     slo_clean_breaches verdict) while chaos/storm arms breach BY
+#     DESIGN with named attribution — and + "programs" block
+#     (fedml_tpu/obs/programs.py): the per-jit-program-family profile
+#     ({"window_s", "peak_flops", "families": [{family, stage,
+#     dispatches, dispatch_wall_s, dispatch_p50/p95_s, flops/bytes per
+#     dispatch, mfu}], "total"}), the PERF.md stage table as a standing
+#     artifact; v10 readers that ignore unknown keys keep working
 # v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
 #     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
 #     attack x defense arms on the async MNIST-LR workload (each row:
@@ -104,7 +115,45 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
+
+
+# the programs block's window opens when main() configures obs (set
+# there; None until then so helper calls stay harmless)
+_PROGRAMS_T0 = None
+
+
+def _programs_doc():
+    """Schema-v11 programs block: the per-jit-program-family profile
+    over this bench invocation's window (dispatch counts + host walls
+    always; flops/bytes/MFU when the census ran — see main())."""
+    from fedml_tpu.obs import programs
+    return programs.report(_PROGRAMS_T0)
+
+
+def _slo_doc(arms: dict) -> dict:
+    """Schema-v11 slo block: the default-pack verdicts per bench arm.
+    `arms` maps arm name -> an SloEngine.arm_summary() (or a torture
+    report's "slo_arm").  Arm names matter: tools/bench_diff.py treats
+    arms whose name contains chaos/storm/mixed/curve as
+    breach-by-design and judges only the clean ones."""
+    from fedml_tpu.obs import slo
+    return {"pack": slo.DEFAULT_PACK_NAME,
+            "arms": {k: v for k, v in arms.items() if v is not None}}
+
+
+def _slo_window():
+    """A primed default-pack engine for modes that are one arm (sync/
+    async/serve population loops): prime now, summarize at arm end."""
+    from fedml_tpu.obs import slo
+    eng = slo.SloEngine(slo.default_slo_pack())
+    eng.prime()
+    return eng
+
+
+def _slo_close(eng) -> dict:
+    eng.evaluate()
+    return eng.arm_summary()
 
 
 def _critical_path_doc():
@@ -345,6 +394,8 @@ def main() -> None:
             "serve": None,
             "connections": None,
             "critical_path": None,
+            "slo": None,
+            "programs": None,
             "error": "chip_unavailable",
             "detail": detail,
         })))
@@ -359,6 +410,18 @@ def main() -> None:
     # bench run (Chrome trace + Prometheus snapshot land there); the
     # default-off path adds nothing to the timed loop
     obs.configure_from_env()
+    # v11 programs block: open the profile window, and run the one-time
+    # HLO flop/byte census for the torture/serve modes (their programs
+    # are small — one extra AOT compile per family, amortized by the
+    # compile cache).  The sync/async modes compile CHIP-sized round
+    # programs, where a doubled cold compile costs real minutes — they
+    # publish dispatch walls always and MFU only under an explicit
+    # FEDML_OBS_CENSUS=1 opt-in.
+    from fedml_tpu.obs import programs as obs_programs
+    global _PROGRAMS_T0
+    if args.mode in ("ingest", "chaos", "serve", "connections"):
+        obs_programs.enable_census(True)
+    _PROGRAMS_T0 = obs_programs.snapshot()
     if args.mode == "ingest":
         _bench_ingest(args)
         return
@@ -458,6 +521,7 @@ def main() -> None:
     from fedml_tpu.utils.profiling import trace
     trace_dir = os.environ.get("BENCH_TRACE_DIR")
     trace_cm = trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    slo_eng = _slo_window()          # v11: judge the timed window
     with trace_cm:
         t0 = time.perf_counter()
         for _ in range(TIMED_ROUNDS):
@@ -500,6 +564,12 @@ def main() -> None:
         # v6 stage attribution (per-"round" spans on this sync path);
         # null unless the run is traced
         "critical_path": _critical_path_doc(),
+        # v11: the default SLO pack over the timed window (the sync
+        # bench drives no async server, so most specs read no_data and
+        # the block asserts "nothing judged this run unhealthy") + the
+        # per-program-family profile
+        "slo": _slo_doc({"timed": _slo_close(slo_eng)}),
+        "programs": _programs_doc(),
     })
     if obs.enabled():
         obs.export()                   # trace + metrics into FEDML_OBS_DIR
@@ -536,6 +606,7 @@ def _bench_async(cfg, data, trainer) -> None:
                                concurrency=ASYNC_CONCURRENCY,
                                staleness="polynomial", staleness_a=0.5,
                                lifecycle_cfg=lc)
+    slo_eng = _slo_window()          # v11: one arm = the whole run
     total = ASYNC_WARMUP_COMMITS + ASYNC_TIMED_COMMITS
     variables = engine.run(rounds=total)
     jax.block_until_ready(variables)
@@ -569,6 +640,8 @@ def _bench_async(cfg, data, trainer) -> None:
         # v6: commit-to-commit stage attribution from the scheduler's
         # spans (train waves / commits / eval + wait); null untraced
         "critical_path": _critical_path_doc(),
+        "slo": _slo_doc({"run": _slo_close(slo_eng)}),
+        "programs": _programs_doc(),
     })
     if obs.enabled():
         obs.export()
@@ -679,6 +752,15 @@ def _bench_ingest(args) -> None:
                 best["committed_updates_per_sec"] / legacy_ups, 2)
                 if legacy_ups > 0 else None,
         },
+        # v11: per-arm SLO verdicts (every ingest arm is clean traffic
+        # — breaches here regress) + the program profile
+        "slo": _slo_doc({
+            "legacy": legacy.get("slo_arm"),
+            "legacy_bounded_inbox": bounded.get("slo_arm"),
+            **{f"pool_{a['ingest_pool']}": a.get("slo_arm")
+               for a in arms},
+        }),
+        "programs": _programs_doc(),
         # v6: the BEST arm's decode/fold/commit attribution (each
         # torture run computes its own window-scoped report); null
         # untraced
@@ -754,15 +836,22 @@ def _bench_chaos(args) -> None:
             "chaos_injected": rep["chaos_injected"],
         }
 
+    slo_arms: dict = {}
     clean = run("clean reliable")
+    slo_arms["clean"] = clean.get("slo_arm")
     clean_ups = clean["committed_updates_per_sec"]
     curve = []
     for key in ("drop", "dup", "corrupt"):
         for rate in CHAOS_CURVE_RATES:
             rep = run(f"{key}_{int(rate * 100)}", {key: rate})
+            # "curve_" prefix: bench_diff treats these as
+            # breach-by-design fault arms, never clean ones
+            slo_arms[f"curve_{key}_{int(rate * 100)}"] = \
+                rep.get("slo_arm")
             curve.append(row(rep, clean_ups, **{key: rate}))
     mixed = run("mixed (5% loss + 1% dup + 0.5% corrupt)",
                 dict(CHAOS_MIXED))
+    slo_arms["mixed"] = mixed.get("slo_arm")
     doc = _stamp({
         "metric": (f"async_chaos_{args.chaos_backend.lower()}_"
                    f"{args.chaos_clients}clients_"
@@ -799,6 +888,8 @@ def _bench_chaos(args) -> None:
             {k: v for k, v in mixed["critical_path"].items()
              if k != "rounds"}
             if mixed.get("critical_path") else None),
+        "slo": _slo_doc(slo_arms),
+        "programs": _programs_doc(),
     })
     if obs.enabled():
         obs.export()
@@ -969,6 +1060,11 @@ def _bench_attack(args) -> None:
             },
         },
         "critical_path": _critical_path_doc(),
+        # v11: the overhead pair is honest traffic — its SLO arms are
+        # clean; the accuracy matrix runs in-process (no comm metrics)
+        "slo": _slo_doc({"overhead_screen_off": off.get("slo_arm"),
+                         "overhead_screen_on": on.get("slo_arm")}),
+        "programs": _programs_doc(),
     })
     if obs.enabled():
         obs.export()
@@ -1012,13 +1108,16 @@ def _bench_serve(args) -> None:
                             flash_at_s=5.0, flash_duration_s=10.0,
                             flash_boost=5.0, seed=args.serve_seed)
     rows = []
+    slo_arms: dict = {}
     for pop in pops:
+        slo_eng = _slo_window()      # v11: one arm per population
         rep = run_serve_sim(
             pop, commits=args.serve_commits,
             warmup_commits=SERVE_WARMUP_COMMITS,
             buffer_k=args.serve_buffer_k, row_dim=args.serve_row_dim,
             sampler_mode=args.serve_sampler, arrival=arrival,
             dropout_prob=0.02, banned_frac=0.01, seed=args.serve_seed)
+        slo_arms[f"pop_{pop}"] = _slo_close(slo_eng)
         rep["sublinear_ok"] = bool(
             rep["registry_bytes_per_client"] <= SERVE_BYTES_PER_CLIENT_GATE)
         print(f"serve pop={pop}: "
@@ -1078,6 +1177,8 @@ def _bench_serve(args) -> None:
                 if rows[0]["committed_updates_per_sec"] > 0 else None,
         },
         "critical_path": _critical_path_doc(),
+        "slo": _slo_doc(slo_arms),
+        "programs": _programs_doc(),
     })
     if obs.enabled():
         obs.export()
@@ -1157,11 +1258,15 @@ def _bench_connections(args) -> None:
         }
 
     rows = []
+    slo_arms: dict = {}
     for n in counts:
         clean = run(f"n={n} clean", n)
         chaosr = run(f"n={n} chaos", n, chaos=dict(CONN_CHAOS))
         storm = run(f"n={n} storm", n, chaos=dict(CONN_CHAOS),
                     storm=True, churn_lifetime_s=CONN_CHURN_LIFETIME_S)
+        slo_arms[f"n{n}_clean"] = clean.get("slo_arm")
+        slo_arms[f"n{n}_chaos"] = chaosr.get("slo_arm")
+        slo_arms[f"n{n}_storm"] = storm.get("slo_arm")
         clean_ups = clean["committed_updates_per_sec"]
         rows.append({
             "n_connections": n,
@@ -1201,6 +1306,8 @@ def _bench_connections(args) -> None:
             "storm_goodput_ratio": head["storm_goodput_ratio"],
         },
         "critical_path": _critical_path_doc(),
+        "slo": _slo_doc(slo_arms),
+        "programs": _programs_doc(),
     })
     if obs.enabled():
         obs.export()

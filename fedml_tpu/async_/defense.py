@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu import obs
+from fedml_tpu.obs import programs as obs_programs
 from fedml_tpu.core.robust import clip_scale
 
 log = logging.getLogger(__name__)
@@ -232,7 +233,9 @@ def make_admission_fn(cfg: DefenseConfig):
     The stage math is _make_stage_fn — ONE definition with the fused
     path.  The reference state (ref, n_acc, mu, m2) is donated."""
     stages = _make_stage_fn(cfg)
-    return jax.jit(stages, donate_argnums=(2, 3, 4, 5))
+    return obs_programs.instrument(
+        "async_admission",
+        jax.jit(stages, donate_argnums=(2, 3, 4, 5)))
 
 
 def make_screened_fold_fn(cfg: DefenseConfig, staleness_mode: str,
@@ -269,7 +272,11 @@ def make_screened_fold_fn(cfg: DefenseConfig, staleness_mode: str,
         wsum1 = jnp.where(ok, wsum + wt, wsum)
         return acc1, wsum1, ok, reason, new_ref, new_n, new_mu, new_m2
 
-    return jax.jit(sfold, donate_argnums=(0, 1, 4, 5, 6, 7))
+    # ISSUE 12: the fused screen+fold is its own profile family —
+    # its dispatch wall vs async_fold's IS the admission tax, live
+    return obs_programs.instrument(
+        "async_screened_fold",
+        jax.jit(sfold, donate_argnums=(0, 1, 4, 5, 6, 7)))
 
 
 class UpdateAdmission:

@@ -295,6 +295,7 @@ class AsyncServerManager(ServerManager):
         self._m_staleness = obs.histogram(
             "async_staleness", buckets=obs.metrics.STALENESS_BUCKETS)
         self._m_commits = obs.counter("async_commits_total")
+        self._m_updates = obs.counter("async_updates_committed_total")
         self._m_deadline = obs.counter("async_deadline_commits_total")
         self._m_degraded = obs.counter("async_degraded_commits_total")
         self._m_redispatch = obs.counter("async_redispatch_total")
@@ -517,8 +518,21 @@ class AsyncServerManager(ServerManager):
                         msg = None            # not a result frame / skew
                 if msg is None:
                     # fallback (or the decode-into A/B's legacy arm):
-                    # zero-copy views + immediate re-flatten
-                    full = MessageCodec.decode(payload, copy="never")
+                    # zero-copy views + immediate re-flatten.  An
+                    # undecodable (corrupt/alien) frame QUARANTINES —
+                    # the same counter + semantics as the sink-less
+                    # inline path in comm/base.py; before ISSUE 12 a
+                    # pool-path corrupt frame died as a generic "ingest
+                    # task failed" log, invisible to the quarantine
+                    # accounting the chaos bench and the SLO pack read
+                    try:
+                        full = MessageCodec.decode(payload, copy="never")
+                    except Exception as e:
+                        self.com_manager._m_quarantined.inc()
+                        log.warning(
+                            "ingest pool: undecodable frame (%d bytes) "
+                            "quarantined: %s", len(payload), e)
+                        return
                     if (full.get_type()
                             != AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT):
                         # control traffic: hand to the FSM dispatch loop
@@ -706,6 +720,10 @@ class AsyncServerManager(ServerManager):
         self.commit_walls.append(time.perf_counter())
         self.commit_sizes.append(n_real)
         self._m_commits.inc()
+        # ISSUE 12: the SLO pack's committed-updates/sec floor reads
+        # this counter — the throughput signal as a metric, not just
+        # the report's post-hoc arithmetic
+        self._m_updates.inc(n_real)
         if deadline_fired:
             self.partial_commits += 1
             self._m_deadline.inc()

@@ -168,6 +168,7 @@ class AsyncFedAvgEngine(FedAvgEngine):
         self._m_staleness = obs.histogram(
             "async_staleness", buckets=obs.metrics.STALENESS_BUCKETS)
         self._m_commits = obs.counter("async_commits_total")
+        self._m_updates = obs.counter("async_updates_committed_total")
         self._m_dispatches = obs.counter("async_dispatches_total")
 
     def _one_client(self, variables, shard, crng):
@@ -428,6 +429,8 @@ class AsyncFedAvgEngine(FedAvgEngine):
             last_commit_t = now
             deadline_armed_version = -1
             self._m_commits.inc()
+            # ISSUE 12: the SLO pack's committed-updates floor
+            self._m_updates.inc(n_real)
             if deadline_fired:
                 self.commits_deadline += 1
                 obs.counter("async_deadline_commits_total").inc()

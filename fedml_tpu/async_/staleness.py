@@ -62,6 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.obs import programs as obs_programs
 
 Pytree = Any
 
@@ -213,7 +214,12 @@ def make_commit_fn(template: Pytree, mode: str = "constant",
             jnp.sum(weights), 1e-12)}
         return new, stats
 
-    return jax.jit(commit, donate_argnums=(0,) if donate else ())
+    # ISSUE 12: every dispatch of the legacy drain commit counts
+    # into the async_commit profile family (obs/programs.py) —
+    # host-side wall + compile attribution, values untouched
+    return obs_programs.instrument(
+        "async_commit",
+        jax.jit(commit, donate_argnums=(0,) if donate else ()))
 
 
 # ---------------------------------------------------------------------------
@@ -241,7 +247,10 @@ def make_fold_fn(mode: str = "constant", a: float = 0.5, b: float = 4.0):
         wt = jnp.asarray(weight, jnp.float32) * lam
         return acc + wt * row, wsum + wt
 
-    return jax.jit(fold, donate_argnums=(0, 1))
+    # ISSUE 12: the arrival fold is the ingestion hot path — its
+    # per-dispatch wall histogram is the async_fold profile family
+    return obs_programs.instrument(
+        "async_fold", jax.jit(fold, donate_argnums=(0, 1)))
 
 
 def make_drain_fold_fn(mode: str = "constant", a: float = 0.5,
@@ -270,7 +279,8 @@ def make_drain_fold_fn(mode: str = "constant", a: float = 0.5,
                                       (rows, weights, staleness))
         return acc, wsum
 
-    return jax.jit(drain)
+    return obs_programs.instrument("async_drain_fold",
+                                   jax.jit(drain))
 
 
 def make_stream_commit_fn(template: Pytree, donate: bool = True):
@@ -296,7 +306,9 @@ def make_stream_commit_fn(template: Pytree, donate: bool = True):
             variables, avg)
         return new, {"discount_wsum": wsum}
 
-    return jax.jit(commit, donate_argnums=(0, 2) if donate else ())
+    return obs_programs.instrument(
+        "async_stream_commit",
+        jax.jit(commit, donate_argnums=(0, 2) if donate else ()))
 
 
 BUCKET_COMBINE_MODES = ("mean", "trimmed_mean", "median")
@@ -403,7 +415,9 @@ def make_bucket_commit_fn(template: Pytree, combine: str = "trimmed_mean",
     # variables alias the update in place; accs alias the bucket_means
     # stats passthrough (same [B, P] f32 shape); wsums alias their own
     # passthrough — the 0-copy `async_bucket_commit` audit family
-    return jax.jit(commit, donate_argnums=(0, 1, 2) if donate else ())
+    return obs_programs.instrument(
+        "async_bucket_commit",
+        jax.jit(commit, donate_argnums=(0, 1, 2) if donate else ()))
 
 
 # ---------------------------------------------------------------------------

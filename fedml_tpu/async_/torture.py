@@ -36,6 +36,7 @@ import numpy as np
 
 from fedml_tpu import obs
 from fedml_tpu.obs import propagate
+from fedml_tpu.obs import slo as obs_slo
 from fedml_tpu.obs.metrics import quantile_from_cumulative
 from fedml_tpu.async_.lifecycle import AsyncMessage, AsyncServerManager
 from fedml_tpu.comm import reliability
@@ -314,6 +315,13 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
     policy = None
     if chaos:
         policy = ChaosPolicy(ChaosConfig(seed=chaos_seed, **chaos))
+    # ISSUE 12: one arm = one SLO evaluation window of the default
+    # serving-spine pack — primed before the server starts, judged
+    # after it quiesces, so the bench's v11 `slo` block attributes
+    # breaches (quarantines, evictions, starved commits) per ARM
+    slo_eng = obs_slo.SloEngine(obs_slo.default_slo_pack(),
+                                dump_min_interval_s=30.0)
+    slo_eng.prime()
     server = AsyncServerManager(
         template, total, buffer_k, 0, n_clients + 1, backend,
         staleness_mode="constant", mix=1.0, streaming=streaming,
@@ -466,6 +474,11 @@ def run_ingest_torture(*, n_clients: int = 32, backend: str = "TCP",
         "recv_thread_deaths": rob["recv_thread_deaths"].value
                               - rob0["recv_thread_deaths"],
     }
+    # the run-scoped SLO verdict (full report + the compact per-arm
+    # summary bench.py's v11 `slo` block embeds)
+    slo_eng.evaluate()
+    report["slo"] = slo_eng.report()
+    report["slo_arm"] = slo_eng.arm_summary()
     # the torture server's final variables must be finite — a NaN here
     # means the fold/commit math broke under concurrency
     report["finite"] = bool(all(
@@ -584,6 +597,10 @@ def run_connection_torture(*, n_connections: int = 256, p: int = 1024,
     policy = None
     if chaos:
         policy = ChaosPolicy(ChaosConfig(seed=chaos_seed, **chaos))
+    # ISSUE 12: arm-scoped SLO window, same shape as run_ingest_torture
+    slo_eng = obs_slo.SloEngine(obs_slo.default_slo_pack(),
+                                dump_min_interval_s=30.0)
+    slo_eng.prime()
     server = AsyncServerManager(
         template, total, buffer_k, 0, n_connections + 1, "TCP",
         staleness_mode="constant", mix=1.0, streaming=True,
@@ -706,6 +723,9 @@ def run_connection_torture(*, n_connections: int = 256, p: int = 1024,
         "swarm": swarm_stats,
         "seed": int(seed),
     }
+    slo_eng.evaluate()
+    report["slo"] = slo_eng.report()
+    report["slo_arm"] = slo_eng.arm_summary()
     report["finite"] = bool(all(
         np.isfinite(np.asarray(leaf)).all()
         for leaf in jax.tree.leaves(server.variables)))

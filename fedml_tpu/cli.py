@@ -446,6 +446,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "without SIGUSR1 shell access.  Works without "
                         "--obs_dir (metrics are always on); "
                         "FEDML_OBS_HTTP_PORT is the env twin")
+    p.add_argument("--slo", action="store_true",
+                   help="run the default serving-spine SLO pack "
+                        "(fedml_tpu/obs/slo.py) as a periodic "
+                        "background evaluator: committed-updates/sec "
+                        "floor, admission/loop-lag p95 ceilings, zero "
+                        "quarantines/evictions/sheds/recv-deaths.  A "
+                        "breach increments slo_breaches_total{slo}, "
+                        "fires a throttled flight dump (with "
+                        "--obs_dir), and surfaces on the httpd /slo "
+                        "endpoint and obs.rollup().  Works without "
+                        "--obs_dir (metrics are always on)")
+    p.add_argument("--slo_period_s", type=float, default=5.0,
+                   help="with --slo: seconds between SLO evaluation "
+                        "windows (each window judges the metric DELTAS "
+                        "since the previous one)")
     p.add_argument("--run_dir", type=str, default="./runs")
     p.add_argument("--run_name", type=str, default=None)
     p.add_argument("--ckpt_dir", type=str, default=None)
@@ -1045,7 +1060,15 @@ def main(argv: Optional[list[str]] = None) -> int:
         port = obs.serve_http(args.obs_http_port).port
         logging.getLogger(__name__).info(
             "obs introspection endpoint on http://127.0.0.1:%d "
-            "(/metrics /rollup /flight)", port)
+            "(/metrics /rollup /healthz /slo /flight)", port)
+    slo_engine = None
+    if args.slo:
+        if args.slo_period_s <= 0:
+            raise SystemExit(
+                f"--slo_period_s must be > 0, got {args.slo_period_s}")
+        from fedml_tpu.obs import slo as slo_mod
+        slo_engine = slo_mod.SloEngine(
+            slo_mod.default_slo_pack()).start(args.slo_period_s)
     if args.multihost:
         from fedml_tpu.parallel.multihost import init_multihost
         init_multihost(required=True)
@@ -1057,6 +1080,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     def _finish_obs():
         # explicit export (atexit also fires, but in-process callers —
         # tests, sweep drivers — want artifacts before main() returns)
+        if slo_engine is not None:
+            # one final window so a breach in the run's tail still
+            # lands in the exported counters/rollup
+            slo_engine.stop(final_evaluate=True)
         if obs.enabled():
             obs.export()
 

@@ -147,6 +147,9 @@ def reset() -> None:
         old_http.close()
     from fedml_tpu.obs import propagate
     propagate.reset_clocks()
+    from fedml_tpu.obs import programs, slo
+    programs.reset()
+    slo.reset()
 
 
 # -- tracing -----------------------------------------------------------------
@@ -364,7 +367,17 @@ def rollup() -> dict:
     """Small summary for embedding in bench JSON lines: where the
     artifacts are plus the headline counters."""
     t = _tracer
+    from fedml_tpu.obs import programs, slo
+    eng = slo.active()
     return {
+        # ISSUE 12: the judgment layer's verdict rides every rollup —
+        # the installed SLO engine's pack state (None when no engine
+        # runs) plus the process-total breach count either way
+        "slo": (eng.report() if eng is not None else None),
+        "slo_breaches_total": sum(
+            m.value for m in _registry.metrics()
+            if m.name == "slo_breaches_total"),
+        "program_families": sorted(programs.families()),
         "obs_dir": _dir,
         "spans_recorded": (0 if t is None
                            else len(t.events()) + t.dropped),
@@ -393,9 +406,30 @@ def _on_jax_duration_event(event: str, duration: float, **kw) -> None:
     if event.endswith("backend_compile_duration"):
         _registry.counter("jit_compile_total").inc()
         _registry.counter("jit_compile_seconds_total").inc(duration)
+        # compile-accounting attribution (ISSUE 12): when the compile
+        # was triggered from inside an instrumented program family's
+        # dispatch (obs/programs.py marks the calling thread), the
+        # labeled series name the culprit — a recompile storm then
+        # reads "fedavg_streaming recompiled 40x", not one global
+        # counter ticking.  The unlabeled pair above stays the
+        # process-total (rollup() and older consumers read it).
+        fam = _program_family_of_thread()
+        _registry.counter("jit_compile_total",
+                          family=fam or "unattributed").inc()
+        _registry.counter("jit_compile_seconds_total",
+                          family=fam or "unattributed").inc(duration)
         t = _tracer
         if t is not None:
-            t.instant("jit.backend_compile", seconds=duration)
+            t.instant("jit.backend_compile", seconds=duration,
+                      family=fam)
+
+
+def _program_family_of_thread():
+    try:
+        from fedml_tpu.obs import programs
+        return programs.current()
+    except Exception:                         # pragma: no cover - import
+        return None
 
 
 def _register_jax_listener() -> None:

@@ -8,7 +8,14 @@ ThreadingHTTPServer (zero dependencies) serves:
 
     /metrics   Prometheus text exposition (the always-on registry)
     /rollup    obs.rollup() JSON — headline counters + artifact paths
-    /flight    POST/GET: trigger a flight-recorder dump, return its path
+    /healthz   200 + {status, pid, uptime_s} — the liveness probe
+    /slo       the installed SLO engine's pack report (obs/slo.py);
+               503 until one is installed (cli --slo or SloEngine.start)
+    /flight    POST: trigger a flight-recorder dump, return its path.
+               GET: return the LAST dump's path WITHOUT triggering —
+               a metrics scraper or browser prefetch walking the
+               endpoints must never mutate (the ISSUE-12 fix: the old
+               ``do_POST = do_GET`` alias made every GET a dump)
 
 Enable with ``FEDML_OBS_HTTP_PORT=<port>`` (picked up by
 ``obs.configure``/``configure_from_env``), the CLI's
@@ -19,13 +26,17 @@ this is an operator loopback hatch, not a service."""
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 class ObsHttpServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         from fedml_tpu import obs
+        from fedml_tpu.obs import slo as slo_mod
+        started = time.monotonic()
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes, ctype: str) -> None:
@@ -35,6 +46,10 @@ class ObsHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _json(self, code: int, doc) -> None:
+                self._send(code, json.dumps(doc).encode(),
+                           "application/json")
+
             def do_GET(self):                        # noqa: N802 (stdlib)
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 if path == "/metrics":
@@ -42,22 +57,44 @@ class ObsHttpServer:
                                obs.registry().to_prometheus().encode(),
                                "text/plain; version=0.0.4")
                 elif path == "/rollup":
-                    self._send(200, json.dumps(obs.rollup()).encode(),
-                               "application/json")
+                    self._json(200, obs.rollup())
+                elif path == "/healthz":
+                    self._json(200, {"status": "ok", "pid": os.getpid(),
+                                     "uptime_s": round(
+                                         time.monotonic() - started, 3)})
+                elif path == "/slo":
+                    eng = slo_mod.active()
+                    if eng is None:
+                        self._json(503, {"error": "no SLO engine "
+                                                  "installed (cli --slo "
+                                                  "or SloEngine.start)"})
+                    else:
+                        self._json(200, eng.report())
                 elif path == "/flight":
+                    # READ-ONLY: report the last dump, never trigger —
+                    # GETs must stay safe (scrapers, prefetchers)
+                    f = obs.flight()
+                    dumps = list(f.dumps) if f is not None else []
+                    self._json(200, {"last_dump": (dumps[-1] if dumps
+                                                   else None),
+                                     "dumps": len(dumps),
+                                     "trigger": "POST /flight"})
+                else:
+                    self._json(404, {"error": "unknown path"})
+
+            def do_POST(self):                       # noqa: N802 (stdlib)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/flight":
                     dump = obs.dump_flight("http_trigger")
                     body = {"dump": dump,
                             "error": (None if dump is not None
                                       else "obs not configured "
                                            "(no --obs_dir)")}
-                    self._send(200 if dump is not None else 503,
-                               json.dumps(body).encode(),
-                               "application/json")
+                    self._json(200 if dump is not None else 503, body)
                 else:
-                    self._send(404, b'{"error": "unknown path"}',
-                               "application/json")
-
-            do_POST = do_GET
+                    # every other endpoint is a read — POST falls
+                    # through to the same representation
+                    self.do_GET()
 
             def log_message(self, *a):               # silence stderr spam
                 pass

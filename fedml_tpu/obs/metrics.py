@@ -91,6 +91,11 @@ CANONICAL_BUCKETS = {
     # admission latency (async_/lifecycle.py): transport hand-off ->
     # buffer insert; the connection bench's p95 gate
     "comm_admission_seconds": DECODE_SECONDS_BUCKETS,
+    # per-jit-program-family host-side dispatch walls (ISSUE 12,
+    # obs/programs.py): an arrival fold dispatches in tens of µs, a
+    # full engine round in seconds — the same sub-ms-to-seconds ladder
+    # the decode walls use resolves both ends
+    "program_dispatch_seconds": DECODE_SECONDS_BUCKETS,
 }
 
 

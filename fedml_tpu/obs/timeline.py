@@ -71,6 +71,31 @@ SPAN_STAGES = {
 # event-driven paths (the async scheduler's commits, the deployment
 # FSM's aggregates) where no single `round` call frame exists
 COMMIT_SPANS = ("async.commit", "fsm.aggregate")
+
+# jit-program family -> canonical stage (ISSUE 12): the per-family
+# profile registry (obs/programs.py) groups its dispatch-wall/MFU rows
+# into the SAME stage taxonomy this analyzer attributes round walls to,
+# so the PERF.md stage table and the program table speak one language.
+# Families not listed here report stage "other" (profiled, unmapped).
+PROGRAM_FAMILY_STAGES = {
+    # the sync engines' round programs — cohort training + aggregation
+    # in one compiled dispatch
+    "fedavg_resident": "train", "fedavg_streaming": "train",
+    "fedavg_blockstream": "train",
+    "fednova_resident": "train", "fednova_streaming": "train",
+    "fednova_blockstream": "train",
+    "fedprox_resident": "train", "fedprox_streaming": "train",
+    "fedprox_blockstream": "train",
+    "fedopt_resident": "train", "fedopt_streaming": "train",
+    "fedopt_blockstream": "train",
+    "robust_orderstat": "train", "robust_blockstream": "train",
+    "hierarchical": "train", "gossip": "train",
+    # the async ingestion/commit pipeline
+    "async_fold": "fold", "async_drain_fold": "fold",
+    "async_screened_fold": "fold", "async_admission": "fold",
+    "async_commit": "commit", "async_stream_commit": "commit",
+    "async_bucket_commit": "commit",
+}
 STAGE_PRIORITY = ("commit", "decode", "fold", "train", "uplink",
                   "dispatch", "h2d", "eval", "checkpoint", "reactor")
 WAIT_STAGE = "wait"

@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu import obs
+from fedml_tpu.obs import programs as obs_programs
 from fedml_tpu.algorithms.fedavg import FedAvgEngine
 from fedml_tpu.algorithms.fedopt import make_server_optimizer
 from fedml_tpu.core import robust as robust_ops
@@ -410,25 +411,38 @@ class MeshFedAvgEngine(FedAvgEngine):
         self._stack_weights = None
         # stack/stack_w are explicit (pre-sharded) args, not closed-over
         # constants, so the jit never embeds the dataset in the program.
-        self.round_fn = jax.jit(self._mesh_round,
-                                donate_argnums=(0, 1) if donate else ())
+        # ISSUE 12: every engine names its jit-program FAMILY — the
+        # hlo_copy_audit taxonomy (fedavg_resident/fedavg_streaming/
+        # fedavg_blockstream, subclass stems override) — and its round
+        # programs dispatch through the obs/programs.py profile
+        # registry: per-family dispatch counts + host-wall histograms +
+        # compile attribution, values untouched (obs-off results stay
+        # bitwise, the standing pins)
+        self.program_family = self._program_family_name(streaming,
+                                                        stream_block)
+        self.round_fn = obs_programs.instrument(
+            self.program_family,
+            jax.jit(self._mesh_round,
+                    donate_argnums=(0, 1) if donate else ()))
         # streaming variant: the gather happened on host; cohort arrives
         # pre-sharded [K, ...] with K = padded cohort size.  This public
         # entry donates variables/server_state ONLY — bench.py and the
         # convergence tools upload one cohort and replay it for every
         # round, so the cohort args must survive the call.
-        self.round_fn_streaming = jax.jit(
-            self._mesh_round_streaming,
-            donate_argnums=(0, 1) if donate else ())
+        self.round_fn_streaming = obs_programs.instrument(
+            self.program_family,
+            jax.jit(self._mesh_round_streaming,
+                    donate_argnums=(0, 1) if donate else ()))
         # ...but the run() loop gathers a FRESH cohort every round
         # (_round_args), each consumed exactly once — donate it too, so
         # a retired cohort's HBM is recycled into the round instead of
         # sitting next to the prefetched next one (same rationale as the
         # block-step input donation; results are bitwise donate-on/off,
         # pinned in tests/test_parallel_stream.py)
-        self._round_fn_streaming_consume = jax.jit(
-            self._mesh_round_streaming,
-            donate_argnums=(0, 1, 2, 3) if donate else ())
+        self._round_fn_streaming_consume = obs_programs.instrument(
+            self.program_family,
+            jax.jit(self._mesh_round_streaming,
+                    donate_argnums=(0, 1, 2, 3) if donate else ()))
         if streaming:
             self.round_fn = self._round_fn_streaming_consume
         if self.stream_block is not None:
@@ -444,16 +458,32 @@ class MeshFedAvgEngine(FedAvgEngine):
             # consumed exactly once, and without donation a retired
             # block would stay resident in HBM next to the prefetched
             # one, breaking the O(2·block) device-data bound
-            self._block_step = jax.jit(self._block_step_impl,
-                                       donate_argnums=(1, 2, 3, 4))
+            self._block_step = obs_programs.instrument(
+                self.program_family,
+                jax.jit(self._block_step_impl,
+                        donate_argnums=(1, 2, 3, 4)))
             # sums (argnum 2) is engine-internal and dead after finalize
             # — always donated; variables/server_state follow the
             # user-visible donate flag
-            self._block_finalize = jax.jit(
-                self._block_finalize_impl,
-                donate_argnums=(0, 1, 2) if donate else (2,))
+            self._block_finalize = obs_programs.instrument(
+                self.program_family,
+                jax.jit(self._block_finalize_impl,
+                        donate_argnums=(0, 1, 2) if donate else (2,)))
             self.round_fn = self._round_blockstream
 
+
+    # jit-program family stem (ISSUE 12): subclasses override so their
+    # profile rows and compile attribution name the right family in the
+    # hlo_copy_audit taxonomy
+    _family_stem = "fedavg"
+
+    def _program_family_name(self, streaming: bool,
+                             stream_block) -> str:
+        if stream_block is not None:
+            return f"{self._family_stem}_blockstream"
+        if streaming:
+            return f"{self._family_stem}_streaming"
+        return f"{self._family_stem}_resident"
 
     # -- hooks ---------------------------------------------------------------
     def client_transform(self, client_variables: Pytree, weight: jax.Array,
@@ -947,6 +977,8 @@ class MeshFedProxEngine(MeshFedAvgEngine):
     """FedProx on the mesh: the proximal term lives in the trainer's loss
     (reference keeps the same aggregator, fedprox/ mirrors fedavg/)."""
 
+    _family_stem = "fedprox"
+
     def __init__(self, trainer, data, cfg, **kw):
         if trainer.prox_mu <= 0:
             # don't mutate the caller's (possibly shared) trainer — other
@@ -961,6 +993,8 @@ class MeshFedOptEngine(MeshFedAvgEngine):
     """Server-optimizer FL: pseudo-gradient w_global − w_avg fed to an optax
     server optimizer (FedOptAggregator.py:94-123, optrepo.py:11-39).  The
     optimizer state persists across rounds in server_state."""
+
+    _family_stem = "fedopt"
 
     def __init__(self, trainer, data, cfg, **kw):
         self.server_tx = make_server_optimizer(
@@ -989,6 +1023,8 @@ class MeshFedNovaEngine(MeshFedAvgEngine):
     with τ_eff = Σᵢ pᵢτᵢ.  All three reductions are linear, so the whole
     aggregation stays two psum tiers like FedAvg; the only extra device
     state is one weighted τ accumulator in the chunk-scan carry."""
+
+    _family_stem = "fednova"
 
     @staticmethod
     def _split(v):
@@ -1092,6 +1128,14 @@ class MeshRobustEngine(MeshFedAvgEngine):
     evenly over the mesh (zero-weight pad lanes have no principled place
     in a median), enforced at construction."""
 
+    def _program_family_name(self, streaming: bool, stream_block) -> str:
+        # the audit taxonomy's names: the resident order-stat round is
+        # "robust_orderstat", the two-phase beyond-HBM path
+        # "robust_blockstream" (norm_clip shares the resident program
+        # shape and books under the same family)
+        return ("robust_blockstream" if stream_block is not None
+                else "robust_orderstat")
+
     def __init__(self, trainer, data, cfg, defense: str = "norm_clip",
                  n_byzantine: int = 0, multi_krum_m: Optional[int] = None,
                  param_block_bytes: int = 128 << 20, **kw):
@@ -1139,9 +1183,10 @@ class MeshRobustEngine(MeshFedAvgEngine):
                 # PARAMETER-major through the mesh for exact order stats
                 # accumulators AND block inputs donated, same rationale
                 # as the linear _block_step (O(2·block) device bound)
-                self._block_step_flats = jax.jit(
-                    self._block_step_flats_impl,
-                    donate_argnums=(1, 2, 3, 4))
+                self._block_step_flats = obs_programs.instrument(
+                    self.program_family,
+                    jax.jit(self._block_step_flats_impl,
+                            donate_argnums=(1, 2, 3, 4)))
                 # phase-2 [K, Pb] slices are uploaded fresh per call and
                 # consumed exactly once — donate them, so a retired
                 # slice's device memory recycles instead of stacking
@@ -1150,17 +1195,21 @@ class MeshRobustEngine(MeshFedAvgEngine):
                 # donated sums) so donate=False stays a complete
                 # escape hatch and the bitwise donate-A/B pin really
                 # compiles these programs both ways
-                self._colstat = jax.jit(
-                    self._colstat_impl,
-                    donate_argnums=(0,) if self.donate else ())
-                self._gram = jax.jit(
-                    self._gram_impl,
-                    donate_argnums=(0,) if self.donate else ())
+                self._colstat = obs_programs.instrument(
+                    self.program_family,
+                    jax.jit(self._colstat_impl,
+                            donate_argnums=(0,) if self.donate else ()))
+                self._gram = obs_programs.instrument(
+                    self.program_family,
+                    jax.jit(self._gram_impl,
+                            donate_argnums=(0,) if self.donate else ()))
                 # new_flat (argnum 3) is engine-internal and dead after
                 # the finalize — donated with the flag too
-                self._orderstat_finalize = jax.jit(
-                    self._orderstat_finalize_impl,
-                    donate_argnums=(0, 1, 2, 3) if self.donate else (2,))
+                self._orderstat_finalize = obs_programs.instrument(
+                    self.program_family,
+                    jax.jit(self._orderstat_finalize_impl,
+                            donate_argnums=(0, 1, 2, 3)
+                            if self.donate else (2,)))
                 self.round_fn = self._round_blockstream_orderstat
 
     def client_transform(self, client_variables, weight, global_variables):

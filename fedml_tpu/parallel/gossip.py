@@ -65,8 +65,12 @@ class MeshGossipEngine(FedAvgEngine):
             f"{self.n_workers} workers over {self.n_shards} shards")
         self._stack = None
         self._stack_w = None
-        self.round_fn = jax.jit(self._gossip_round,
-                                donate_argnums=(0,) if donate else ())
+        from fedml_tpu.obs import programs as obs_programs
+        self.program_family = "gossip"
+        self.round_fn = obs_programs.instrument(
+            self.program_family,
+            jax.jit(self._gossip_round,
+                    donate_argnums=(0,) if donate else ()))
 
     def _device_stack(self):
         if self._stack is None:

@@ -75,8 +75,12 @@ class MeshHierarchicalEngine(FedAvgEngine):
         self.clients_per_silo = C // self.n_silos
         self._stack = None
         self._stack_w = None
-        self.round_fn = jax.jit(self._global_round,
-                                donate_argnums=(0, 1) if donate else ())
+        from fedml_tpu.obs import programs as obs_programs
+        self.program_family = "hierarchical"
+        self.round_fn = obs_programs.instrument(
+            self.program_family,
+            jax.jit(self._global_round,
+                    donate_argnums=(0, 1) if donate else ()))
 
     # -- data layout: [S, C/S, B, bs, ...] sharded (silo, clients) ----------
     def _device_stack(self):

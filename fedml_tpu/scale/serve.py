@@ -170,9 +170,13 @@ def run_serve_sim(population: int, *, commits: int = 30,
                 if full:
                     with obs.span("serve.commit", version=version,
                                   t_virtual=round(now, 3)):
-                        acc, wsum, _w, _s, _n, _raw = buffer.take_stream()
+                        acc, wsum, _w, _s, n_commit, _raw = \
+                            buffer.take_stream()
                         variables, _stats = commit_fn(
                             variables, acc, wsum, jnp.float32(1.0))
+                    # ISSUE 12: the SLO pack's committed-updates floor
+                    obs.counter("async_updates_committed_total").inc(
+                        n_commit)
                     version += 1
                     for ids in rejoin_at_commit:
                         for c in ids:

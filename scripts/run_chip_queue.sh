@@ -45,6 +45,10 @@
 #      storm (mixed chaos + connection storm + reconnect churn) — gates
 #      >= 0.5x clean goodput under storm, zero recv-thread deaths,
 #      zero leaked FDs
+#  14. bench_diff              — cross-run regression differ (ISSUE 12):
+#      the fresh bench.json vs the committed BENCH_r05 record, per-mode
+#      verdicts with the encoded noise bands — regressions are NAMED in
+#      the queue log instead of waiting for a human PERF.md re-read
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-runs/chip_queue_$(date +%m%d_%H%M)}"
@@ -56,46 +60,57 @@ if ! timeout 180 python -c "import jax; assert jax.devices()[0].platform in ('tp
   echo "chip unavailable; aborting queue"; exit 1
 fi
 
-echo "== 1/13 bench.py"
+echo "== 1/14 bench.py"
 timeout 1500 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.json"
 
-echo "== 2/13 nwp_convergence (600 rounds, vocab 10004 — must match the"
+echo "== 2/14 nwp_convergence (600 rounds, vocab 10004 — must match the"
 echo "   600-round band pinned in test_quality_regression.py)"
 timeout 3600 python tools/nwp_convergence.py 600 \
     --out benchmarks/nwp_convergence_r5.json 2>"$OUT/nwp.err" \
     | tee "$OUT/nwp.log"
 
-echo "== 3/13 profile_bench C4096B (block-streamed 4096 clients)"
+echo "== 3/14 profile_bench C4096B (block-streamed 4096 clients)"
 timeout 5400 python tools/profile_bench.py C4096B 2>&1 | tee "$OUT/c4096b.log"
 
-echo "== 4/13 profile_bench OS256 OSB256 (order-stat timing)"
+echo "== 4/14 profile_bench OS256 OSB256 (order-stat timing)"
 timeout 3600 python tools/profile_bench.py OS256 OSB256 2>&1 | tee "$OUT/os.log"
 
-echo "== 5/13 profile_bench DN128 (donate on/off + restructured carry A/B)"
+echo "== 5/14 profile_bench DN128 (donate on/off + restructured carry A/B)"
 timeout 1800 python tools/profile_bench.py DN128 2>&1 | tee "$OUT/dn128.log"
 
-echo "== 6/13 profile_bench PF512 SD512 (prefetch + stack-dtype A/Bs)"
+echo "== 6/14 profile_bench PF512 SD512 (prefetch + stack-dtype A/Bs)"
 timeout 3600 python tools/profile_bench.py PF512 SD512 2>&1 | tee "$OUT/pfsd.log"
 
-echo "== 7/13 profile_bench ASYNC (async federation K=8 vs K=32 A/B)"
+echo "== 7/14 profile_bench ASYNC (async federation K=8 vs K=32 A/B)"
 timeout 3600 python tools/profile_bench.py ASYNC 2>&1 | tee "$OUT/async.log"
 
-echo "== 8/13 profile_bench INGEST (uplink ingestion legacy-vs-streaming A/B)"
+echo "== 8/14 profile_bench INGEST (uplink ingestion legacy-vs-streaming A/B)"
 timeout 1800 python tools/profile_bench.py INGEST 2>&1 | tee "$OUT/ingest.log"
 
-echo "== 9/13 profile_bench TRACE (traced-vs-untraced ingest overhead gate)"
+echo "== 9/14 profile_bench TRACE (traced-vs-untraced ingest overhead gate)"
 timeout 1200 python tools/profile_bench.py TRACE 2>&1 | tee "$OUT/trace.log"
 
-echo "== 10/13 profile_bench CHAOS (chaos goodput under seeded wire faults)"
+echo "== 10/14 profile_bench CHAOS (chaos goodput under seeded wire faults)"
 timeout 1800 python tools/profile_bench.py CHAOS 2>&1 | tee "$OUT/chaos.log"
 
-echo "== 11/13 profile_bench ATTACK (adversarial attack x defense matrix)"
+echo "== 11/14 profile_bench ATTACK (adversarial attack x defense matrix)"
 timeout 3600 python tools/profile_bench.py ATTACK 2>&1 | tee "$OUT/attack.log"
 
-echo "== 12/13 profile_bench SERVE (million-client serving spine)"
+echo "== 12/14 profile_bench SERVE (million-client serving spine)"
 timeout 1800 python tools/profile_bench.py SERVE 2>&1 | tee "$OUT/serve.log"
 
-echo "== 13/13 profile_bench CONN (live-connection reactor A/B)"
+echo "== 13/14 profile_bench CONN (live-connection reactor A/B)"
 timeout 1800 python tools/profile_bench.py CONN 2>&1 | tee "$OUT/conn.log"
+
+echo "== 14/14 bench_diff (cross-run regression verdicts, ISSUE 12)"
+# judge the fresh chip record against the committed trajectory: named
+# regression/improvement verdicts with the encoded noise bands; a
+# nonzero exit flags the queue log, it does not abort banked artifacts.
+# pipefail inside the subshell: without it tee's 0 would mask the
+# differ's exit 1 and the flag line below would be dead code
+( set -o pipefail; timeout 300 python tools/bench_diff.py \
+    BENCH_r05.json "$OUT/bench.json" --json "$OUT/bench_diff.json" \
+    2>&1 | tee "$OUT/bench_diff.log" ) \
+    || echo "bench_diff: REGRESSIONS NAMED ABOVE (see $OUT/bench_diff.json)"
 
 echo "== queue complete; artifacts in $OUT + benchmarks/"

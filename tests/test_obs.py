@@ -494,6 +494,79 @@ def test_histogram_quantile_matches_legacy_torture_math():
     assert h.quantile(0.95, since=after) == 0.0
 
 
+# -- ISSUE 12: histogram edge cases the SLO evaluator leans on ---------------
+
+def test_quantile_empty_delta_window_and_extremes():
+    """The SLO engine's quantile_max spec evaluates windowed deltas: an
+    EMPTY window (no new observations) must read 0.0 — never NaN, never
+    a stale all-time value — and q=0.0/1.0 must stay inside the bucket
+    ladder at both extremes."""
+    from fedml_tpu.obs.metrics import quantile_from_cumulative
+    reg = MetricsRegistry()
+    h = reg.histogram("edge_seconds", buckets=(0.01, 0.1, 1.0))
+    snap0 = h.cumulative()
+    # empty delta: before == after (both all-zero and mid-run)
+    assert quantile_from_cumulative(snap0, snap0, 0.95) == 0.0
+    h.observe(0.05)
+    h.observe(0.5)
+    snap1 = h.cumulative()
+    assert quantile_from_cumulative(snap1, snap1, 0.5) == 0.0
+    # q extremes on a populated window: 0.0 sits at the window's floor
+    # (the first populated bucket's lower edge, interpolated from 0),
+    # 1.0 at its populated ceiling — both finite, ordered, in-ladder
+    q0 = quantile_from_cumulative(snap0, snap1, 0.0)
+    q1 = quantile_from_cumulative(snap0, snap1, 1.0)
+    assert 0.0 <= q0 <= q1 <= 1.0
+    assert q1 >= 0.1                 # the 0.5 observation's bucket
+
+
+def test_quantile_single_bucket_ladder():
+    """A one-bucket ladder (everything <= le or overflow) still
+    interpolates sanely: in-bucket mass reads inside [0, le], overflow
+    mass clamps to the last finite edge (the +Inf bucket has no upper
+    edge to interpolate toward)."""
+    from fedml_tpu.obs.metrics import quantile_from_cumulative
+    reg = MetricsRegistry()
+    h = reg.histogram("one_bucket_seconds", buckets=(1.0,))
+    before = h.cumulative()
+    for _ in range(10):
+        h.observe(0.25)
+    after = h.cumulative()
+    q = quantile_from_cumulative(before, after, 0.5)
+    assert 0.0 <= q <= 1.0
+    # overflow-only window: every observation past the ladder
+    before = after
+    for _ in range(10):
+        h.observe(5.0)
+    after = h.cumulative()
+    assert quantile_from_cumulative(before, after, 0.95) == 1.0
+
+
+def test_quantile_merge_law():
+    """merge_counts then quantile == quantile of the union: the
+    federation's rollup (merge_delta is bucket-wise add) must report
+    the same percentiles as one registry that saw every observation —
+    the law the SLO evaluator's cross-series merge relies on."""
+    from fedml_tpu.obs.metrics import quantile_from_cumulative
+    buckets = (0.001, 0.01, 0.1, 1.0)
+    reg = MetricsRegistry()
+    ha = reg.histogram("m_seconds", side="a", buckets=buckets)
+    hb = reg.histogram("m_seconds", side="b", buckets=buckets)
+    hu = reg.histogram("m_seconds", side="union", buckets=buckets)
+    rs = np.random.RandomState(3)
+    xs = rs.lognormal(-3.0, 1.5, size=400)
+    for i, v in enumerate(xs):
+        (ha if i % 2 else hb).observe(float(v))
+        hu.observe(float(v))
+    counts, vsum, vcount = hb.raw_state()
+    ha.merge_counts(counts, vsum, vcount)
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert ha.quantile(q) == hu.quantile(q)      # bitwise
+    # and a ladder-mismatched merge refuses loudly
+    with pytest.raises(ValueError):
+        ha.merge_counts([0, 0], 0.0, 0)
+
+
 # -- ISSUE 7: tracer spill + digest ------------------------------------------
 
 def test_tracer_spill_keeps_head_ring_keeps_tail(tmp_path):
@@ -567,9 +640,19 @@ def test_http_endpoint_metrics_rollup_flight(clean_obs, tmp_path):
     assert 'http_hits_total{backend="t"} 3' in prom
     ru = json.loads(urllib.request.urlopen(f"{base}/rollup").read())
     assert ru["http_port"] == srv.port
+    # ISSUE 12: GET /flight is READ-ONLY (a scraper or browser prefetch
+    # must never trigger dumps) — the dump trigger moved to POST
     fl = json.loads(urllib.request.urlopen(f"{base}/flight").read())
+    assert fl["last_dump"] is None and fl["dumps"] == 0
+    assert not glob.glob(str(tmp_path / "flight-*.json"))
+    fl = json.loads(urllib.request.urlopen(
+        urllib.request.Request(f"{base}/flight", method="POST"),
+        data=b"").read())
     assert fl["dump"] and os.path.exists(fl["dump"])       # dump trigger
     assert json.load(open(fl["dump"]))["reason"] == "http_trigger"
+    # and the GET now reports that dump without adding another
+    fl2 = json.loads(urllib.request.urlopen(f"{base}/flight").read())
+    assert fl2["last_dump"] == fl["dump"] and fl2["dumps"] == 1
     try:
         urllib.request.urlopen(f"{base}/nope")
         assert False, "unknown path must 404"

@@ -1,0 +1,504 @@
+"""Performance-observatory tests (ISSUE 12): the SLO engine
+(fedml_tpu/obs/slo.py), the per-program-family profile registry
+(fedml_tpu/obs/programs.py), the httpd endpoint semantics, and the
+cross-run bench differ (tools/bench_diff.py).
+
+Pinned invariants:
+
+* SLO specs evaluate as WINDOWED deltas over the live registry: green
+  windows stay green, a quarantine/eviction delta breaches with named
+  attribution, breaches increment slo_breaches_total{slo} and fire ONE
+  throttled flight dump;
+* the default serving-spine pack is green on a clean ingest arm and
+  counts >= 1 breach on a chaos arm (the bench v11 acceptance shape);
+* instrumented programs count dispatches + dispatch walls per family,
+  attribute backend compiles to the registering family (fallback
+  `unattributed`), join the HLO flop/byte census into MFU, and NEVER
+  change results (the jit passes through untouched — `lower` included,
+  so the hlo audit keeps working);
+* bench_diff reports zero regressions against itself, names mode +
+  field + delta vs noise band for a synthetic 20% degradation, and
+  exits nonzero from the CLI.
+"""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu import obs
+from fedml_tpu.obs import programs, slo
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+BENCH_DIFF = os.path.join(REPO, "tools", "bench_diff.py")
+BASELINE = os.path.join(REPO, "benchmarks", "bench_baseline_2core.json")
+
+
+@pytest.fixture
+def clean_obs():
+    prev = signal.getsignal(signal.SIGUSR1)
+    obs.reset()
+    yield
+    obs.reset()
+    signal.signal(signal.SIGUSR1, prev)
+
+
+# -- SLO engine --------------------------------------------------------------
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        slo.spec("x", "m", "nope", 1.0)
+    with pytest.raises(ValueError):
+        slo.spec("x", "m", "quantile_max", 1.0, q=1.5)
+    with pytest.raises(ValueError):
+        slo.spec("x", "m", "rate_min", 1.0, burn_windows=0)
+    with pytest.raises(ValueError):
+        slo.SloEngine([slo.spec("dup", "m", "delta_max", 0.0)] * 2)
+
+
+def test_slo_green_then_breach_with_attribution(clean_obs):
+    eng = slo.SloEngine([
+        slo.spec("floor", "work_total", "rate_min", 1.0),
+        slo.spec("no_bad", "bad_total", "delta_max", 0.0),
+    ], dump_min_interval_s=1e9)
+    eng.prime()
+    obs.counter("work_total").inc(100)
+    time.sleep(0.02)
+    rep = eng.evaluate()
+    assert rep["healthy"] and rep["breached"] == []
+    # a breach names its spec and lands in the counter
+    obs.counter("work_total").inc(100)
+    obs.counter("bad_total", backend="tcp").inc(2)     # label-subset match
+    rep = eng.evaluate()
+    assert rep["breached"] == ["no_bad"]
+    assert obs.counter("slo_breaches_total", slo="no_bad").value == 1
+    assert obs.gauge("slo_healthy", slo="no_bad").value == 0.0
+    row = next(r for r in rep["slos"] if r["name"] == "no_bad")
+    assert row["value"] == 2.0 and row["status"] == "breach"
+    # the NEXT window is clean again: deltas, not cumulative state
+    obs.counter("work_total").inc(100)
+    rep = eng.evaluate()
+    row = next(r for r in rep["slos"] if r["name"] == "no_bad")
+    assert row["status"] == "ok"
+
+
+def test_slo_quantile_window_and_no_data(clean_obs):
+    eng = slo.SloEngine([
+        slo.spec("p95", "lat_seconds", "quantile_max", 0.1, q=0.95),
+        slo.spec("ghost", "never_registered_total", "delta_max", 0.0),
+    ])
+    eng.prime()
+    h = obs.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for _ in range(50):
+        h.observe(0.005)
+    rep = eng.evaluate()
+    assert rep["healthy"]
+    ghost = next(r for r in rep["slos"] if r["name"] == "ghost")
+    assert ghost["status"] == "no_data"      # absent metric: not a breach
+    # a slow window breaches on the WINDOW's p95, not all-time
+    for _ in range(200):
+        h.observe(0.5)
+    rep = eng.evaluate()
+    assert rep["breached"] == ["p95"]
+    # ... and an idle window has nothing to judge (empty delta)
+    rep = eng.evaluate()
+    p95 = next(r for r in rep["slos"] if r["name"] == "p95")
+    assert p95["status"] == "no_data"
+
+
+def test_slo_burn_windows(clean_obs):
+    eng = slo.SloEngine([
+        slo.spec("slowburn", "bad2_total", "delta_max", 0.0,
+                 burn_windows=2),
+    ])
+    eng.prime()
+    obs.counter("bad2_total").inc()
+    rep = eng.evaluate()                     # 1st breaching window: budget
+    assert rep["breaches"] == 0
+    assert next(r for r in rep["slos"])["burn"] == 1
+    obs.counter("bad2_total").inc()
+    rep = eng.evaluate()                     # 2nd consecutive: fires
+    assert rep["breaches"] == 1 and rep["breached"] == ["slowburn"]
+    obs.counter("bad2_total").inc()
+    rep = eng.evaluate()                     # still burning: fires again
+    assert rep["breaches"] == 2
+
+
+def test_slo_breach_flight_dump_throttled(clean_obs, tmp_path):
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    eng = slo.SloEngine([
+        slo.spec("no_bad", "bad3_total", "delta_max", 0.0),
+    ], dump_min_interval_s=60.0)
+    eng.prime()
+    obs.counter("bad3_total").inc()
+    eng.evaluate()
+    obs.counter("bad3_total").inc()
+    eng.evaluate()                           # breaches again, inside throttle
+    dumps = glob.glob(str(tmp_path / "flight-*.json"))
+    assert len(dumps) == 1, "breach storm must not storm the recorder"
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"].startswith("slo_breach:no_bad")
+    assert doc["slo"]["breached"] == ["no_bad"]
+
+
+def test_slo_rollup_and_httpd_endpoints(clean_obs, tmp_path):
+    import urllib.request
+    eng = slo.SloEngine([slo.spec("ok", "x_total", "delta_max", 10.0)])
+    eng.prime()
+    eng.evaluate()
+    slo.install(eng)
+    ru = obs.rollup()
+    assert ru["slo"]["pack"] == slo.DEFAULT_PACK_NAME
+    assert ru["slo"]["healthy"]
+    srv = obs.serve_http(0)
+    base = f"http://127.0.0.1:{srv.port}"
+    hz = json.loads(urllib.request.urlopen(f"{base}/healthz").read())
+    assert hz["status"] == "ok" and hz["pid"] == os.getpid()
+    assert hz["uptime_s"] >= 0
+    sl = json.loads(urllib.request.urlopen(f"{base}/slo").read())
+    assert sl["healthy"] and sl["slos"][0]["name"] == "ok"
+    # no engine installed -> 503, not a bogus empty 200
+    slo.install(None)
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{base}/slo")
+    assert ei.value.code == 503
+
+
+def test_slo_background_evaluator_installs_and_stops(clean_obs):
+    eng = slo.SloEngine([slo.spec("ok", "y_total", "delta_max", 10.0)])
+    eng.start(period_s=0.05)
+    assert slo.active() is eng
+    time.sleep(0.2)
+    eng.stop()
+    assert eng.report()["windows_evaluated"] >= 2
+
+
+# -- default pack vs real bench arms -----------------------------------------
+
+def test_default_pack_green_on_clean_breach_on_chaos(clean_obs):
+    """The ISSUE-12 acceptance shape at test scale: one clean INPROC
+    ingest arm evaluates green, one corrupt-chaos arm counts >= 1
+    breach with named attribution (the same per-arm windows bench.py's
+    v11 `slo` block records)."""
+    from fedml_tpu.async_.torture import run_ingest_torture
+    clean = run_ingest_torture(
+        n_clients=3, backend="INPROC", p=4096, buffer_k=4, commits=5,
+        warmup_commits=2, ingest_pool=2, decode_into=True,
+        streaming=True)
+    assert clean["slo_arm"]["healthy"]
+    assert clean["slo_arm"]["breaches"] == 0
+    chaos = run_ingest_torture(
+        n_clients=3, backend="INPROC", p=4096, buffer_k=4, commits=5,
+        warmup_commits=2, ingest_pool=2, decode_into=True,
+        streaming=True, chaos={"corrupt": 0.3})
+    assert chaos["slo_arm"]["breaches"] >= 1
+    assert "no_quarantines" in chaos["slo_arm"]["breached"]
+    # pool-path corrupt frames land in the SAME quarantine counter the
+    # inline path uses (the ISSUE-12 accounting fix)
+    assert chaos["quarantined"] >= 1
+
+
+# -- program profile registry ------------------------------------------------
+
+def test_programs_instrument_counts_walls_and_passthrough(clean_obs):
+    import jax
+    calls = []
+
+    def f(x):
+        calls.append(1)
+        return x * 2.0
+    prog = programs.instrument("async_commit", jax.jit(f))
+    x = np.arange(8, dtype=np.float32)
+    snap = programs.snapshot()
+    for _ in range(3):
+        out = prog(x)
+    np.testing.assert_array_equal(np.asarray(out), x * 2.0)
+    ctr = obs.counter("program_dispatches_total", family="async_commit")
+    assert ctr.value == 3
+    rep = programs.report(snap)
+    row = next(r for r in rep["families"]
+               if r["family"] == "async_commit")
+    assert row["dispatches"] == 3
+    assert row["stage"] == "commit"          # the timeline stage mapping
+    assert row["dispatch_p95_s"] > 0
+    # `lower` passes through (the hlo audit's AOT path)
+    assert prog.lower(x).compile() is not None
+    # double-instrumentation re-tags instead of double-timing
+    again = programs.instrument("async_commit", prog)
+    assert again.inner is prog.inner
+
+
+def test_programs_compile_attribution(clean_obs):
+    """A backend compile triggered inside an instrumented dispatch
+    books under the family's labeled compile counters; one triggered
+    outside books as `unattributed`."""
+    import jax
+    prog = programs.instrument(
+        "async_fold", jax.jit(lambda x: x + 1.0))
+    prog(np.zeros((17,), np.float32))        # unique shape -> compile
+    fam = obs.registry().counter("jit_compile_total", family="async_fold")
+    assert fam.value >= 1
+    base = obs.registry().counter("jit_compile_total",
+                                  family="unattributed").value
+    jax.jit(lambda x: x - 1.0)(np.zeros((19,), np.float32))
+    un = obs.registry().counter("jit_compile_total",
+                                family="unattributed")
+    assert un.value >= base + 1
+    assert obs.registry().counter("jit_compile_seconds_total",
+                                  family="async_fold").value > 0
+
+
+def test_programs_census_and_mfu(clean_obs):
+    """Census mode reads the compiled program's cost analysis once and
+    report() turns dispatch counts into MFU against the peak estimate
+    (the 64x64 matmul's flops are exactly 2·64^3 on this backend)."""
+    import jax
+    programs.enable_census(True)
+    try:
+        prog = programs.instrument("fedavg_resident",
+                                   jax.jit(lambda x: x @ x))
+        a = np.zeros((64, 64), np.float32)
+        snap = programs.snapshot()
+        t0 = time.perf_counter()
+        for _ in range(4):
+            prog(a)
+        rep = programs.report(snap, peak=1e9)
+        row = next(r for r in rep["families"]
+                   if r["family"] == "fedavg_resident")
+        assert row["flops_per_dispatch"] == 2 * 64 ** 3
+        assert row["bytes_per_dispatch"] > 0
+        assert row["stage"] == "train"
+        window = time.perf_counter() - t0
+        # MFU sanity: flops_total / (window x peak), within slop of the
+        # report's own window measurement
+        expect = 4 * 2 * 64 ** 3 / (window * 1e9)
+        assert row["mfu"] == pytest.approx(expect, rel=0.5)
+        assert rep["total"]["mfu"] is not None
+        # report() rounds the row to 6 decimals; the gauge carries the
+        # unrounded value
+        assert obs.gauge("program_mfu", family="fedavg_resident").value \
+            == pytest.approx(row["mfu"], abs=1e-6)
+    finally:
+        programs.enable_census(False)
+
+
+def test_programs_census_from_audit_artifact(clean_obs):
+    """load_census joins a tools/hlo_copy_audit.py artifact's
+    flops/bytes into already-registered families."""
+    report = {"families": {
+        "async_stream_commit": {"programs": {
+            "stream_commit": {"flops": 1000.0, "bytes_accessed": 4000.0},
+        }},
+        "no_census_family": {"programs": {"p": {"copy_ops": 0}}},
+    }}
+    assert programs.load_census(report) == 1
+    fam = programs.register("async_stream_commit")
+    assert fam.flops_per_dispatch == 1000.0
+    assert fam.census_source == "hlo_copy_audit"
+
+
+def test_engine_round_dispatches_profiled(clean_obs):
+    """The sync engine's round program books its dispatches under the
+    engine's program family (the ISSUE-12 acceptance table's sync-engine
+    row), and the family name follows the audit taxonomy."""
+    import jax
+    from parallel_case import _mnist_like_cfg, _setup
+    from fedml_tpu.parallel import MeshFedAvgEngine
+    from fedml_tpu.parallel.mesh import make_mesh
+    cfg = _mnist_like_cfg(comm_round=1)
+    trainer, data = _setup(cfg)
+    eng = MeshFedAvgEngine(trainer, data, cfg, mesh=make_mesh(8))
+    assert eng.program_family == "fedavg_resident"
+    variables = eng._prepare_variables(eng.init_variables())
+    server_state = eng.server_init(variables)
+    snap = programs.snapshot()
+    stack, stack_w = eng._device_stack()
+    ids, wmask = eng.sample_padded(0)
+    eng.round_fn(variables, server_state, stack, stack_w, ids, wmask,
+                 jax.random.PRNGKey(0))
+    rep = programs.report(snap)
+    row = next(r for r in rep["families"]
+               if r["family"] == "fedavg_resident")
+    assert row["dispatches"] == 1
+
+
+# -- bench_diff --------------------------------------------------------------
+
+def _load_bench_diff():
+    import importlib.util
+    spec_ = importlib.util.spec_from_file_location("_bench_diff_under_test",
+                                                   BENCH_DIFF)
+    bd = importlib.util.module_from_spec(spec_)
+    sys.modules[spec_.name] = bd
+    spec_.loader.exec_module(bd)
+    return bd
+
+
+def _degraded_baseline(tmp_path, mode: str, field: str, factor: float):
+    doc = json.load(open(BASELINE))
+    doc["modes"][mode][field] = round(doc["modes"][mode][field] * factor,
+                                      6)
+    p = tmp_path / "degraded.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_bench_diff_self_compare_is_clean():
+    bd = _load_bench_diff()
+    rows, rc = bd.run_diff(BASELINE, BASELINE)
+    assert rc == 0
+    assert all(r["status"] != "regressed" for r in rows)
+    # every baseline mode produced comparable fields
+    modes = {r["mode"] for r in rows}
+    assert {"sync", "ingest", "chaos", "attack", "serve",
+            "connections"} <= modes
+
+
+def test_bench_diff_names_synthetic_regression(tmp_path):
+    """Degrade one headline field 20% -> the verdict names mode +
+    field + delta vs the noise band, and the CLI exits nonzero (the
+    ISSUE-12 acceptance wording)."""
+    degraded = _degraded_baseline(tmp_path, "attack", "defended_acc",
+                                  0.8)
+    r = subprocess.run(
+        [sys.executable, BENCH_DIFF, BASELINE, degraded],
+        capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    line = next(l for l in r.stdout.splitlines()
+                if l.startswith("regressed"))
+    assert "attack" in line and "defended_acc" in line
+    assert "noise band" in line and "-20" in line
+    # improvements are reported but never fatal
+    improved = _degraded_baseline(tmp_path, "sync", "rounds_per_sec",
+                                  1.5)
+    r = subprocess.run(
+        [sys.executable, BENCH_DIFF, BASELINE, improved],
+        capture_output=True, text=True)
+    assert r.returncode == 0
+    assert "improved" in r.stdout
+
+
+def test_bench_diff_gates_and_noise_bands(tmp_path):
+    """A 20% drop INSIDE a wide GIL-noise band is ok (the encoded
+    0.75-2.7x spread), while crossing an absolute gate regresses even
+    within-band."""
+    bd = _load_bench_diff()
+    inside = _degraded_baseline(tmp_path, "ingest",
+                                "best_updates_per_sec", 0.8)
+    rows, rc = bd.run_diff(BASELINE, inside)
+    assert rc == 0, "20% inside the 65% GIL-noise band must not page"
+    gated = _degraded_baseline(tmp_path, "chaos", "goodput_vs_clean",
+                               0.4)                      # 0.33 < gate 0.5
+    rows, rc = bd.run_diff(BASELINE, gated)
+    assert rc == 1
+    row = next(r for r in rows if r["status"] == "regressed")
+    assert row["field"] == "goodput_vs_clean"
+    assert "gate" in row["detail"]
+
+
+def test_bench_diff_handles_schema_range_and_wrappers(tmp_path):
+    """v4-v11 bench lines and BENCH_r*.json driver wrappers normalize;
+    fields absent on one side report `missing`, never a regression."""
+    bd = _load_bench_diff()
+    v4 = {"schema_version": 4, "mode": "async", "value": 2.0,
+          "async": {"staleness_p95": 3.0}}
+    v11 = {"schema_version": 11, "mode": "async", "value": 2.1,
+           "async": {"staleness_p95": 3.0,
+                     "buffer_occupancy_mean": 6.5},
+           "slo": {"pack": "serving_spine_default",
+                   "arms": {"run": {"breaches": 0}}}}
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"parsed": v4}))     # driver wrapper shape
+    b.write_text(json.dumps(v11))
+    rows, rc = bd.run_diff(str(a), str(b))
+    assert rc == 0
+    by_field = {r["field"]: r for r in rows}
+    assert by_field["commits_per_sec"]["status"] in ("ok", "improved")
+    assert by_field["buffer_occupancy_mean"]["status"] == "missing"
+    assert by_field["slo_clean_breaches"]["status"] == "missing"
+
+
+# -- overhead gate -----------------------------------------------------------
+
+def test_slo_evaluator_cost_bound(clean_obs):
+    """The >= 0.99x acceptance gate, argued by construction: the SLO
+    engine runs ONLY at evaluation time (snapshot diffs over the
+    registry — no per-event hook anywhere on the hot path), so its e2e
+    tax is evaluations/sec x cost/evaluation.  Bound the cost directly
+    over a realistically-populated registry: at the default 5 s period
+    an evaluation must stay well under 50 ms (1% of one window) — the
+    measured cost is ~1 ms, so the bound is 50x slack against box
+    noise, and a regression that makes evaluation do real work (a
+    per-event path, an O(series^2) scan) trips it immediately."""
+    # populate the registry like a busy server: 200 counter series,
+    # 40 histograms with observations
+    for i in range(200):
+        obs.counter("busy_total", backend=f"b{i % 8}",
+                    reason=f"r{i}").inc(i)
+    for i in range(40):
+        h = obs.histogram("busy_seconds", shard=f"s{i}")
+        for k in range(50):
+            h.observe(0.001 * (k + 1))
+    eng = slo.SloEngine(slo.default_slo_pack())
+    eng.prime()
+    obs.counter("async_updates_committed_total").inc(100)
+    t0 = time.perf_counter()
+    n = 20
+    for _ in range(n):
+        eng.evaluate()
+    per_eval = (time.perf_counter() - t0) / n
+    assert per_eval < 0.05, (
+        f"SLO evaluation costs {per_eval * 1e3:.1f} ms — at the 5 s "
+        f"default period that breaks the >= 0.99x overhead gate")
+
+
+@pytest.mark.slow
+def test_slo_engine_overhead_paired(clean_obs):
+    """The e2e half of the overhead gate, PR-7's paired protocol
+    (alternating order, median of per-pair ratios, warmup pair
+    discarded): torture rate with the default pack evaluating at an
+    AGGRESSIVE 0.25 s period vs SLO-off.  The CI-box tripwire gates at
+    the DOCUMENTED arm-noise floor (>= 0.75 — these INPROC arms repeat
+    at 0.75-2.7x on 2 cores under suite load, the PR-11 GIL spread, so
+    any tighter gate here measures the box, not the evaluator; 0.99 is
+    only resolvable on the chip-attached runtime — the same CI-vs-chip
+    split PR 9 used for its 0.9x screen gate).  It exists to catch a
+    GROSS regression (an accidental per-event hook would halve the
+    rate); the deterministic per-evaluation cost bound above carries
+    the tight 0.99x argument."""
+    from fedml_tpu.async_.torture import run_ingest_torture
+
+    def arm(with_slo: bool, tag: int) -> float:
+        eng = None
+        if with_slo:
+            eng = slo.SloEngine(slo.default_slo_pack()).start(0.25)
+        try:
+            rep = run_ingest_torture(
+                n_clients=4, backend="INPROC", p=262144, buffer_k=8,
+                commits=16, warmup_commits=4, ingest_pool=2,
+                decode_into=True, streaming=True)
+            return rep["committed_updates_per_sec"]
+        finally:
+            if eng is not None:
+                eng.stop()
+                slo.install(None)
+    arm(True, -1), arm(False, -1)            # discarded warmup pair
+    ratios = []
+    for pair in range(5):
+        if pair % 2:
+            on = arm(True, pair)
+            off = arm(False, pair)
+        else:
+            off = arm(False, pair)
+            on = arm(True, pair)
+        ratios.append(on / off)
+    med = sorted(ratios)[len(ratios) // 2]
+    assert med >= 0.75, f"SLO-on/off paired ratios {ratios}"

@@ -440,14 +440,107 @@ def test_chip_queue_carries_conn_step():
     assert "profile_bench.py CONN" in src, (
         "run_chip_queue.sh lost the CONN live-connection reactor step "
         "(ISSUE 11 queues it for the next chip window)")
-    assert "13/13" in src, (
-        "run_chip_queue.sh lost the 13/13 step numbering — the CONN "
-        "step must be the queue's last step")
+    assert "13/14" in src, (
+        "run_chip_queue.sh lost the CONN step numbering (13/14 since "
+        "ISSUE 12 appended the bench_diff step)")
     assert "exp_CONN" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
         "profile_bench.py lost the exp_CONN experiment the queue runs")
     import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_bench_json_schema_v11_carries_slo_and_programs_blocks():
+    """ISSUE 12: schema v11 adds the judgment layer's fields on every
+    mode — the "slo" block (the default serving-spine pack's per-arm
+    breach verdicts from fedml_tpu/obs/slo.py) and the "programs" block
+    (the per-jit-program-family dispatch/MFU profile from
+    fedml_tpu/obs/programs.py).  Static source check like the v3-v10
+    guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 11, (
+        "bench schema must stay >= v11 (slo + programs blocks)")
+    for field in ('"slo"', '"programs"', "_slo_doc", "_programs_doc",
+                  "_slo_window"):
+        assert field in src, (
+            f"bench.py lost the v11 observability field {field} "
+            "(see fedml_tpu/obs/slo.py + programs.py)")
+    # the torture harness feeds the per-arm verdicts
+    tort = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "async_", "torture.py")).read()
+    for field in ('"slo_arm"', "default_slo_pack"):
+        assert field in tort, (
+            f"torture.py lost {field!r} — bench.py's v11 slo block "
+            "reads the per-arm summaries from the torture reports")
+    # the layer itself must exist
+    for mod in ("slo.py", "programs.py"):
+        assert os.path.exists(os.path.join(
+            os.path.dirname(__file__), "..", "fedml_tpu", "obs", mod)), (
+            f"fedml_tpu/obs/{mod} (the ISSUE-12 observatory) is gone")
+    # and the profile registry must keep its report fields in sync
+    prog = open(os.path.join(os.path.dirname(__file__), "..",
+                             "fedml_tpu", "obs", "programs.py")).read()
+    for field in ("dispatch_wall_s", "dispatch_p95_s",
+                  "flops_per_dispatch", '"mfu"'):
+        assert field in prog, (
+            f"programs.report lost {field!r} — bench.py's v11 programs "
+            "block reads it")
+
+
+def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
+    """ISSUE 12: tools/bench_diff.py must exist, exit 0 on a
+    self-compare of the committed baseline, and exit nonzero NAMING the
+    metric when a headline field is synthetically degraded — the
+    regression gate's own regression gate."""
+    import json as _json
+    import subprocess
+    import sys
+    diff = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "bench_diff.py")
+    base = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        "bench_baseline_2core.json")
+    assert os.path.exists(diff), "tools/bench_diff.py is gone"
+    assert os.path.exists(base), (
+        "benchmarks/bench_baseline_2core.json (the bench_diff "
+        "regression anchor) is gone")
+    doc = _json.load(open(base))
+    assert doc["kind"] == "bench_baseline" and doc["modes"], base
+    assert "recalibration_protocol" in doc["calibration"], (
+        "the baseline lost its recalibration note (the "
+        "quality_bands.json-mirrored protocol)")
+    r = subprocess.run([sys.executable, diff, base, base],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc["modes"]["attack"]["defended_acc"] = round(
+        doc["modes"]["attack"]["defended_acc"] * 0.8, 4)
+    degraded = tmp_path / "degraded.json"
+    degraded.write_text(_json.dumps(doc))
+    r = subprocess.run([sys.executable, diff, base, str(degraded)],
+                       capture_output=True, text=True)
+    assert r.returncode == 1, (
+        "bench_diff must exit nonzero on a synthetically injected "
+        "regression")
+    assert "defended_acc" in r.stdout and "regressed" in r.stdout
+
+
+def test_chip_queue_carries_bench_diff_step():
+    """ISSUE 12: the chip queue's last step judges the fresh bench
+    record against the committed trajectory (14/14), and the script
+    stays shell-valid."""
+    import subprocess
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    src = open(queue).read()
+    assert "bench_diff.py" in src, (
+        "run_chip_queue.sh lost the bench_diff regression step "
+        "(ISSUE 12 appends it as the queue's judgment pass)")
+    assert "14/14" in src, (
+        "run_chip_queue.sh lost the 14/14 step numbering — bench_diff "
+        "must be the queue's last step")
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr

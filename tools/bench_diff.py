@@ -1,0 +1,457 @@
+"""Cross-run bench regression differ (ISSUE 12).
+
+Five BENCH_r*.json records and seven bench modes exist; until now a
+regression was caught by a human re-reading PERF.md.  This tool
+compares two bench JSON documents (or a directory trajectory) per mode
+with EXPLICIT noise bands — the measured run-to-run spreads from the
+CHANGES/PERF history are encoded here once, not rediscovered per
+review — and emits named regression/improvement verdicts:
+
+    python tools/bench_diff.py OLD.json NEW.json
+    python tools/bench_diff.py benchmarks/bench_baseline_2core.json NEW.json
+    python tools/bench_diff.py --dir .          # BENCH_r*.json trajectory
+    python tools/bench_diff.py OLD NEW --json out.json
+
+Accepted input shapes (schema v4-v11, normalized by `prune()`):
+
+  * a raw bench.py JSON line (any --mode);
+  * a driver record wrapping one under "parsed" (BENCH_r*.json);
+  * a pruned baseline snapshot {"kind": "bench_baseline",
+    "modes": {mode: fields}} — benchmarks/bench_baseline_2core.json is
+    the committed anchor (see its "calibration" note for the
+    recalibration protocol, mirrored from quality_bands.json).
+
+Exit status: 0 = no regressions (improvements and missing fields are
+reported, not fatal), 1 = at least one regression, 2 = usage/parse
+error.  The regression verdict names mode + field + delta vs the noise
+band, which is what the tooling-guard test asserts against a
+synthetically degraded document.
+
+Noise-band sources (don't tighten without re-measuring):
+
+  * sync rounds/sec: chip run-to-run 0.544-0.549 (~1%, BENCH_r04/r05);
+    10% band absorbs box-load spread while catching the 20%+ drops
+    that have historically meant a real regression;
+  * ingest/chaos/connections committed-updates/sec: the in-process
+    swarm/fold split is GIL noise — PR 11 measured the same arm at
+    0.75-2.7x across repeats, PR 6's headline repeated 28-80x —
+    so absolute rates carry a 65% band and the GATED ratios
+    (speedup_vs_legacy >= 2, goodput >= 0.5) carry the judgment;
+  * attack accuracies: the quality-band convention (+-0.04 absolute,
+    benchmarks/quality_bands.json);
+  * serve: registry bytes/client is deterministic (1% band); the
+    sustain ratio carries PR-10's 0.5 floor.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+SCHEMA_MIN, SCHEMA_MAX = 2, 11
+
+
+# ---------------------------------------------------------------------------
+# normalization: any accepted input -> {mode: {field: value}}
+# ---------------------------------------------------------------------------
+
+def load_doc(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    # bench.py prints one JSON object; driver logs may append lines —
+    # take the first parseable JSON value in the file
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if doc is None:
+            raise SystemExit(f"bench_diff: {path} holds no JSON document")
+    if isinstance(doc, dict) and "parsed" in doc and isinstance(
+            doc["parsed"], dict):
+        doc = doc["parsed"]          # BENCH_r*.json driver wrapper
+    return doc
+
+
+def _slo_breaches(block) -> Optional[float]:
+    """Total breaches across the CLEAN arms of a v11 slo block (chaos/
+    storm arms breach BY DESIGN — only clean-arm breaches regress)."""
+    if not isinstance(block, dict):
+        return None
+    arms = block.get("arms") or {}
+    total, seen = 0.0, False
+    for name, arm in arms.items():
+        if not isinstance(arm, dict):
+            continue
+        if any(tag in name for tag in ("chaos", "storm", "mixed",
+                                       "curve")):
+            continue
+        seen = True
+        total += float(arm.get("breaches", 0))
+    return total if seen else None
+
+
+def prune(doc: dict) -> dict:
+    """One bench document -> {mode: pruned-headline fields}.  This IS
+    the baseline-snapshot schema: bench_baseline_2core.json stores
+    exactly prune()'s output."""
+    if doc.get("kind") == "bench_baseline" or "modes" in doc:
+        return {m: dict(v) for m, v in (doc.get("modes") or {}).items()}
+    sv = doc.get("schema_version")
+    if sv is not None and not (SCHEMA_MIN <= int(sv) <= SCHEMA_MAX):
+        print(f"bench_diff: schema_version {sv} outside the known "
+              f"v{SCHEMA_MIN}-v{SCHEMA_MAX} range — fields this tool "
+              f"doesn't know about are ignored", file=sys.stderr)
+    mode = doc.get("mode", "sync")
+    out: dict = {}
+    if doc.get("error"):
+        # chip-unavailable marker rows never fold into trends
+        return {mode: {"error": doc["error"]}}
+    f: dict = {}
+    if mode == "sync":
+        f["rounds_per_sec"] = doc.get("value")
+        f["vs_baseline"] = doc.get("vs_baseline")
+        f["overlap_fraction"] = doc.get("overlap_fraction")
+    elif mode == "async":
+        a = doc.get("async") or {}
+        f["commits_per_sec"] = doc.get("value")
+        f["staleness_p95"] = a.get("staleness_p95")
+        f["buffer_occupancy_mean"] = a.get("buffer_occupancy_mean")
+    elif mode == "ingest":
+        i = doc.get("ingest") or {}
+        f["best_updates_per_sec"] = doc.get("value")
+        f["legacy_updates_per_sec"] = (i.get("legacy") or {}).get(
+            "committed_updates_per_sec")
+        f["speedup_vs_legacy"] = i.get("speedup_vs_legacy")
+        arms = i.get("arms") or []
+        if arms:
+            best = max(arms,
+                       key=lambda a: a.get("committed_updates_per_sec", 0))
+            f["decode_p95_s"] = best.get("decode_p95_s")
+    elif mode == "chaos":
+        c = doc.get("chaos") or {}
+        f["mixed_updates_per_sec"] = doc.get("value")
+        f["clean_updates_per_sec"] = (c.get("clean") or {}).get(
+            "committed_updates_per_sec")
+        f["goodput_vs_clean"] = c.get("goodput_vs_clean")
+        f["recv_thread_deaths"] = (c.get("mixed") or {}).get(
+            "recv_thread_deaths")
+    elif mode == "attack":
+        a = doc.get("attack") or {}
+        f["defended_acc"] = a.get("defended_acc", doc.get("value"))
+        f["undefended_acc"] = a.get("undefended_acc")
+        f["clean_acc"] = a.get("clean_acc")
+        f["false_positive_quarantines"] = a.get(
+            "false_positive_quarantines")
+        f["screen_throughput_ratio"] = (a.get("overhead") or {}).get(
+            "throughput_ratio")
+    elif mode == "serve":
+        s = doc.get("serve") or {}
+        f["headline_updates_per_sec"] = doc.get("value")
+        f["sustain_ratio_vs_smallest"] = s.get("sustain_ratio_vs_smallest")
+        pops = s.get("populations") or []
+        if pops:
+            f["registry_bytes_per_client"] = max(
+                p.get("registry_bytes_per_client", 0.0) for p in pops)
+        f["sublinear_ok"] = s.get("sublinear_ok")
+    elif mode == "connections":
+        c = doc.get("connections") or {}
+        deaths, leaks = 0.0, 0.0
+        for row in c.get("rows") or []:
+            n = row.get("n_connections")
+            sg = row.get("storm_goodput_ratio")
+            if sg is not None:
+                f[f"storm_goodput_ratio[n={n}]"] = sg
+            cl = (row.get("clean") or {})
+            if cl.get("committed_updates_per_sec") is not None:
+                f[f"clean_updates_per_sec[n={n}]"] = cl[
+                    "committed_updates_per_sec"]
+            for arm in ("clean", "chaos", "storm"):
+                a = row.get(arm) or {}
+                deaths += float(a.get("recv_thread_deaths") or 0)
+                leaks += float(a.get("fd_leaked") or 0)
+        f["recv_thread_deaths"] = deaths
+        f["fd_leaked"] = leaks
+    # v11: clean-arm SLO breaches ride every mode
+    b = _slo_breaches(doc.get("slo"))
+    if b is not None:
+        f["slo_clean_breaches"] = b
+    out[mode] = {k: v for k, v in f.items() if v is not None}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# noise bands + gates per (mode, field)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """Judgment for one field: `direction` +1 = higher is better,
+    -1 = lower is better, 0 = informational (delta reported, never a
+    verdict).  Degradation tolerance = max(abs_band,
+    rel_band x |old|); absolute gates override the band."""
+    direction: int
+    rel_band: float = 0.10
+    abs_band: float = 0.0
+    gate_min: Optional[float] = None
+    gate_max: Optional[float] = None
+    note: str = ""
+
+
+RULES: dict[tuple, Rule] = {
+    # -- sync: chip headline.  Run-to-run 0.544-0.549 (~1%); 10% band.
+    ("sync", "rounds_per_sec"): Rule(+1, 0.10,
+                                     note="chip spread ~1%; 10% band "
+                                          "absorbs box load"),
+    ("sync", "vs_baseline"): Rule(+1, 0.10),
+    ("sync", "overlap_fraction"): Rule(0),
+    # -- async
+    ("async", "commits_per_sec"): Rule(+1, 0.25,
+                                       note="vmapped-wave wall, CPU-"
+                                            "noisy"),
+    ("async", "staleness_p95"): Rule(0),
+    ("async", "buffer_occupancy_mean"): Rule(0),
+    # -- ingest: absolute rates are GIL-noisy (PR 6: headline repeated
+    # 28-80x vs legacy; PR 11: 0.75-2.7x arm spread) — wide bands, the
+    # gated speedup carries the judgment.
+    ("ingest", "best_updates_per_sec"): Rule(+1, 0.65,
+                                             note="GIL-noise band, "
+                                                  "PR-6/11 repeats"),
+    ("ingest", "legacy_updates_per_sec"): Rule(0),
+    ("ingest", "speedup_vs_legacy"): Rule(+1, 0.75, gate_min=2.0,
+                                          note="ISSUE-6 >=2x gate; "
+                                               "spread 28-80x"),
+    ("ingest", "decode_p95_s"): Rule(-1, 0.75),
+    # -- chaos
+    ("chaos", "mixed_updates_per_sec"): Rule(+1, 0.65,
+                                             note="GIL-noise band"),
+    ("chaos", "clean_updates_per_sec"): Rule(0),
+    ("chaos", "goodput_vs_clean"): Rule(+1, 0.35, gate_min=0.5,
+                                        note="ISSUE-8 >=0.5x gate"),
+    ("chaos", "recv_thread_deaths"): Rule(-1, 0.0, gate_max=0.0,
+                                          note="zero-deaths gate"),
+    # -- attack: quality-band convention, +-0.04 absolute.
+    ("attack", "defended_acc"): Rule(+1, 0.0, abs_band=0.04,
+                                     note="quality-band +-0.04"),
+    ("attack", "clean_acc"): Rule(+1, 0.0, abs_band=0.04),
+    ("attack", "undefended_acc"): Rule(0,
+                                       note="lower = attack working"),
+    ("attack", "false_positive_quarantines"): Rule(-1, 0.0, gate_max=0.0,
+                                                   note="zero honest "
+                                                        "quarantines"),
+    ("attack", "screen_throughput_ratio"): Rule(+1, 0.30,
+                                                note="fold-bound 2-core "
+                                                     "~0.73x; chip gate "
+                                                     "0.9x"),
+    # -- serve
+    ("serve", "headline_updates_per_sec"): Rule(+1, 0.50,
+                                                note="virtual-time CPU "
+                                                     "wall"),
+    ("serve", "sustain_ratio_vs_smallest"): Rule(+1, 0.30, gate_min=0.5,
+                                                 note="ISSUE-10 sustain "
+                                                      "gate"),
+    ("serve", "registry_bytes_per_client"): Rule(-1, 0.01, gate_max=100.0,
+                                                 note="deterministic "
+                                                      "layout; <=100 "
+                                                      "B/client gate"),
+    # -- connections: the 0.75-2.7x storm/GIL spread from PR 11,
+    # encoded once.
+    ("connections", "recv_thread_deaths"): Rule(-1, 0.0, gate_max=0.0),
+    ("connections", "fd_leaked"): Rule(-1, 0.0, gate_max=0.0),
+}
+# pattern rules for the per-count connection fields
+PATTERN_RULES: list[tuple] = [
+    ("connections", "storm_goodput_ratio[",
+     Rule(+1, 0.65, gate_min=0.5,
+          note="ISSUE-11 >=0.5x gate; 0.75-2.7x repeat spread")),
+    ("connections", "clean_updates_per_sec[",
+     Rule(+1, 0.65, note="GIL-noise band")),
+]
+# v11 slo block: clean arms must stay breach-free in EVERY mode
+SLO_RULE = Rule(-1, 0.0, gate_max=0.0,
+                note="clean-arm SLO breaches (v11)")
+
+
+def rule_for(mode: str, field: str) -> Rule:
+    if field == "slo_clean_breaches":
+        return SLO_RULE
+    r = RULES.get((mode, field))
+    if r is not None:
+        return r
+    for m, prefix, pr in PATTERN_RULES:
+        if m == mode and field.startswith(prefix):
+            return pr
+    return Rule(0, note="unknown field: informational")
+
+
+# ---------------------------------------------------------------------------
+# the diff
+# ---------------------------------------------------------------------------
+
+def diff_modes(old: dict, new: dict) -> list[dict]:
+    """Verdict rows over the union of modes/fields of two prune()d
+    documents."""
+    rows = []
+    for mode in sorted(set(old) | set(new)):
+        o, n = old.get(mode), new.get(mode)
+        if o is None or n is None:
+            rows.append({"mode": mode, "field": "*",
+                         "status": "missing",
+                         "detail": f"mode only in "
+                                   f"{'new' if o is None else 'old'} doc"})
+            continue
+        for field in sorted(set(o) | set(n)):
+            ov, nv = o.get(field), n.get(field)
+            if ov is None or nv is None:
+                rows.append({"mode": mode, "field": field,
+                             "status": "missing",
+                             "old": ov, "new": nv,
+                             "detail": "field absent on one side "
+                                       "(schema skew)"})
+                continue
+            if isinstance(ov, bool) or isinstance(nv, bool):
+                status = ("ok" if bool(ov) == bool(nv) else
+                          ("regressed" if ov and not nv else "improved"))
+                rows.append({"mode": mode, "field": field, "old": ov,
+                             "new": nv, "status": status,
+                             "detail": "boolean gate"})
+                continue
+            if not isinstance(ov, (int, float)) or not isinstance(
+                    nv, (int, float)):
+                rows.append({"mode": mode, "field": field, "old": ov,
+                             "new": nv,
+                             "status": ("ok" if ov == nv else "changed"),
+                             "detail": "non-numeric"})
+                continue
+            r = rule_for(mode, field)
+            delta = nv - ov
+            pct = (delta / abs(ov)) if ov else None
+            band = max(r.abs_band, r.rel_band * abs(ov))
+            status, detail = "ok", ""
+            if r.gate_min is not None and nv < r.gate_min:
+                status = "regressed"
+                detail = (f"below absolute gate {r.gate_min} "
+                          f"({nv:.4g})")
+            elif r.gate_max is not None and nv > r.gate_max:
+                status = "regressed"
+                detail = (f"above absolute gate {r.gate_max} "
+                          f"({nv:.4g})")
+            elif r.direction > 0 and delta < -band:
+                status = "regressed"
+                detail = (f"dropped {-delta:.4g} "
+                          f"({pct:+.1%}) vs noise band +-{band:.4g}"
+                          if pct is not None else
+                          f"dropped {-delta:.4g} vs band {band:.4g}")
+            elif r.direction < 0 and delta > band:
+                status = "regressed"
+                detail = (f"rose {delta:.4g} "
+                          f"({pct:+.1%}) vs noise band +-{band:.4g}"
+                          if pct is not None else
+                          f"rose {delta:.4g} vs band {band:.4g}")
+            elif r.direction > 0 and delta > band:
+                status, detail = "improved", f"+{delta:.4g}"
+            elif r.direction < 0 and delta < -band:
+                status, detail = "improved", f"{delta:.4g}"
+            rows.append({"mode": mode, "field": field,
+                         "old": ov, "new": nv,
+                         "delta": delta,
+                         "delta_pct": (round(pct, 4)
+                                       if pct is not None else None),
+                         "band": band, "status": status,
+                         "detail": detail, "note": r.note})
+    return rows
+
+
+def format_rows(rows: list[dict]) -> str:
+    order = {"regressed": 0, "missing": 1, "changed": 2, "improved": 3,
+             "ok": 4}
+    lines = [f"{'status':<10}{'mode':<13}{'field':<34}"
+             f"{'old':>12}{'new':>12}  detail"]
+    for r in sorted(rows, key=lambda r: (order.get(r["status"], 9),
+                                         r["mode"], r["field"])):
+        def fmt(v):
+            if isinstance(v, float):
+                return f"{v:.4g}"
+            return "-" if v is None else str(v)
+        lines.append(f"{r['status']:<10}{r['mode']:<13}"
+                     f"{r['field']:<34}{fmt(r.get('old')):>12}"
+                     f"{fmt(r.get('new')):>12}  {r.get('detail', '')}")
+    n_reg = sum(1 for r in rows if r["status"] == "regressed")
+    n_imp = sum(1 for r in rows if r["status"] == "improved")
+    n_miss = sum(1 for r in rows if r["status"] == "missing")
+    lines.append(f"-- {n_reg} regression(s), {n_imp} improvement(s), "
+                 f"{n_miss} missing")
+    return "\n".join(lines)
+
+
+def run_diff(old_path: str, new_path: str) -> tuple[list[dict], int]:
+    old = prune(load_doc(old_path))
+    new = prune(load_doc(new_path))
+    rows = diff_modes(old, new)
+    rc = 1 if any(r["status"] == "regressed" for r in rows) else 0
+    return rows, rc
+
+
+def run_trajectory(directory: str) -> tuple[list[dict], int]:
+    paths = sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
+    if len(paths) < 2:
+        raise SystemExit(
+            f"bench_diff: --dir needs >= 2 BENCH_r*.json under "
+            f"{directory}, found {len(paths)}")
+    rows, rc = [], 0
+    for a, b in zip(paths, paths[1:]):
+        step_rows, step_rc = run_diff(a, b)
+        tag = f"{os.path.basename(a)} -> {os.path.basename(b)}"
+        for r in step_rows:
+            r["step"] = tag
+        rows.extend(step_rows)
+        rc = max(rc, step_rc)
+    return rows, rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("old", nargs="?",
+                    help="older bench JSON / baseline snapshot")
+    ap.add_argument("new", nargs="?", help="newer bench JSON")
+    ap.add_argument("--dir", default=None,
+                    help="diff the BENCH_r*.json trajectory in this "
+                         "directory (consecutive pairs) instead of two "
+                         "files")
+    ap.add_argument("--json", default=None,
+                    help="also write the verdict rows as JSON here")
+    args = ap.parse_args(argv)
+    try:
+        if args.dir:
+            rows, rc = run_trajectory(args.dir)
+        else:
+            if not args.old or not args.new:
+                ap.print_usage(sys.stderr)
+                return 2
+            rows, rc = run_diff(args.old, args.new)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    print(format_rows(rows))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"rows": rows, "regressions": rc != 0}, f,
+                      indent=1)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,9 +123,18 @@ def analyze_hlo_text(txt: str) -> dict:
 
 
 def audit_program(jit_fn, args) -> dict:
-    """Lower + compile one jitted program and analyze its optimized HLO."""
+    """Lower + compile one jitted program and analyze its optimized HLO.
+    Besides the copy census, the report carries the backend's cost
+    analysis (ISSUE 12): `flops` and `bytes_accessed` per dispatch —
+    obs/programs.py joins them with live dispatch counts into the
+    per-family MFU/bytes-moved accounting (programs.load_census)."""
     compiled = jit_fn.lower(*args).compile()
-    return analyze_hlo_text(compiled.as_text())
+    report = analyze_hlo_text(compiled.as_text())
+    from fedml_tpu.obs.programs import cost_analysis_of
+    flops, nbytes = cost_analysis_of(compiled)
+    report["flops"] = flops
+    report["bytes_accessed"] = nbytes
+    return report
 
 
 # ---------------------------------------------------------------------------
@@ -361,12 +370,20 @@ def audit_families(families: list[str] | None = None,
         per = {}
         for name, fn, args in programs:
             per[name] = audit_program(fn, args)
+        flops = [p["flops"] for p in per.values()
+                 if p.get("flops") is not None]
+        nbytes = [p["bytes_accessed"] for p in per.values()
+                  if p.get("bytes_accessed") is not None]
         fams[family] = {
             "copy_ops": sum(p["copy_ops"] for p in per.values()),
             "copy_bytes": sum(p["copy_bytes"] for p in per.values()),
             "donated_args": sum(p["donated_args"] for p in per.values()),
             "aliased_outputs": sum(p["aliased_outputs"]
                                    for p in per.values()),
+            # ISSUE 12: the family's per-round-dispatch cost census
+            # (None when the backend exposes no cost analysis)
+            "flops": sum(flops) if flops else None,
+            "bytes_accessed": sum(nbytes) if nbytes else None,
             "programs": per,
         }
         obs.gauge("engine_copy_bytes_compiled", family=family).set(
