@@ -103,6 +103,19 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     dispatches, dispatch_wall_s, dispatch_p50/p95_s, flops/bytes per
 #     dispatch, mfu}], "total"}), the PERF.md stage table as a standing
 #     artifact; v10 readers that ignore unknown keys keep working
+# v12: + "multihost" block (`python bench.py --mode multihost`,
+#     ISSUE 13 — fedml_tpu/parallel/multihost.py): the weak-scaling
+#     two-level-aggregation sweep — N worker processes (spawn_cluster,
+#     one block of clients per process, constant per-process work)
+#     each train their cohort blocks on a LOCAL mesh and allreduce the
+#     P-sized flat f32 carry over the HostChannel, one row per process
+#     count (default 1/2/4) carrying rounds_per_sec,
+#     carry_allreduce_bytes_per_round, ranks_agree and process_deaths,
+#     plus weak_efficiency_2p/4p (rounds/sec vs the 1-process arm; the
+#     >= 0.5x-at-2 gate is the documented GIL/gloo floor on the 2-core
+#     box — exp_POD prices it on a real pod slice) and
+#     bitwise_2proc_ok (the 1-vs-2-process same-block-partition digest
+#     pin); null in other modes, so v11 readers keep working
 # v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
 #     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
 #     attack x defense arms on the async MNIST-LR workload (each row:
@@ -115,7 +128,7 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 
 # the programs block's window opens when main() configures obs (set
@@ -265,7 +278,7 @@ def main() -> None:
     ap = argparse.ArgumentParser("bench")
     ap.add_argument("--mode",
                     choices=("sync", "async", "ingest", "chaos", "attack",
-                             "serve", "connections"),
+                             "serve", "connections", "multihost"),
                     default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
@@ -295,7 +308,14 @@ def main() -> None:
                          "(ISSUE 11, fedml_tpu/comm/reactor.py) — "
                          "sustained committed-updates/sec + p95 admission "
                          "latency vs live socket count (256/1k/10k), "
-                         "clean vs mixed-chaos vs storm arms")
+                         "clean vs mixed-chaos vs storm arms; multihost: "
+                         "the weak-scaling two-level-aggregation sweep "
+                         "(ISSUE 13, fedml_tpu/parallel/multihost.py) — "
+                         "N spawned processes train one client block "
+                         "each on local meshes and allreduce the flat "
+                         "f32 carry over the HostChannel; rounds/sec + "
+                         "carry bytes vs process count (1/2/4) plus the "
+                         "1-vs-2-process bitwise pin")
     ap.add_argument("--ingest_clients", type=int, default=32,
                     help="ingest mode: concurrent uplink clients")
     ap.add_argument("--ingest_backend", default="TCP",
@@ -369,6 +389,31 @@ def main() -> None:
     ap.add_argument("--conn_seed", type=int, default=0,
                     help="connections mode: one seed drives the swarm "
                          "schedule and the chaos injector")
+    ap.add_argument("--mh_procs", default="1,2,4",
+                    help="multihost mode: comma-separated process "
+                         "counts (one weak-scaling row each; per-"
+                         "process work is constant — one client block "
+                         "per process)")
+    ap.add_argument("--mh_rounds", type=int, default=10,
+                    help="multihost mode: rounds per arm (first "
+                         "--mh_warmup excluded from the rate)")
+    ap.add_argument("--mh_warmup", type=int, default=2,
+                    help="multihost mode: warmup rounds per arm")
+    ap.add_argument("--mh_clients_per_block", type=int, default=64,
+                    help="multihost mode: population per block (the "
+                         "id-range each process owns)")
+    ap.add_argument("--mh_k_per_block", type=int, default=8,
+                    help="multihost mode: sampled cohort per block per "
+                         "round")
+    ap.add_argument("--mh_dim", type=int, default=256,
+                    help="multihost mode: LR input dim (sets the flat "
+                         "carry size P that crosses hosts)")
+    ap.add_argument("--mh_local_devices", type=int, default=1,
+                    help="multihost mode: virtual devices per process "
+                         "(the intra-host psum tier width on CPU)")
+    ap.add_argument("--mh_seed", type=int, default=0,
+                    help="multihost mode: workload seed (same seed = "
+                         "same cohorts = the bitwise pin's premise)")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -393,6 +438,7 @@ def main() -> None:
             "attack": None,
             "serve": None,
             "connections": None,
+            "multihost": None,
             "critical_path": None,
             "slo": None,
             "programs": None,
@@ -436,6 +482,9 @@ def main() -> None:
         return
     if args.mode == "connections":
         _bench_connections(args)
+        return
+    if args.mode == "multihost":
+        _bench_multihost(args)
         return
     import jax.numpy as jnp
 
@@ -545,6 +594,7 @@ def main() -> None:
         "attack": None,
         "serve": None,
         "connections": None,
+        "multihost": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -637,6 +687,7 @@ def _bench_async(cfg, data, trainer) -> None:
         "attack": None,
         "serve": None,
         "connections": None,
+        "multihost": None,
         # v6: commit-to-commit stage attribution from the scheduler's
         # spans (train waves / commits / eval + wait); null untraced
         "critical_path": _critical_path_doc(),
@@ -727,6 +778,7 @@ def _bench_ingest(args) -> None:
         "attack": None,
         "serve": None,
         "connections": None,
+        "multihost": None,
         "ingest": {
             "backend": legacy["backend"],
             "n_clients": legacy["n_clients"],
@@ -869,6 +921,7 @@ def _bench_chaos(args) -> None:
         "attack": None,
         "serve": None,
         "connections": None,
+        "multihost": None,
         "chaos": {
             "backend": clean["backend"],
             "n_clients": clean["n_clients"],
@@ -1032,6 +1085,7 @@ def _bench_attack(args) -> None:
         "chaos": None,
         "serve": None,
         "connections": None,
+        "multihost": None,
         "attack": {
             "workload": "async_mnist_lr (quality-band shape, K=8, "
                         "conc 16, poly a=0.5)",
@@ -1144,6 +1198,7 @@ def _bench_serve(args) -> None:
         "chaos": None,
         "attack": None,
         "connections": None,
+        "multihost": None,
         "serve": {
             "buffer_k": args.serve_buffer_k,
             "row_dim": args.serve_row_dim,
@@ -1307,6 +1362,176 @@ def _bench_connections(args) -> None:
         },
         "critical_path": _critical_path_doc(),
         "slo": _slo_doc(slo_arms),
+        "programs": _programs_doc(),
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+# multihost-mode shape (ISSUE 13): weak scaling — per-process work is
+# CONSTANT (one client block per process: mh_clients_per_block
+# population, mh_k_per_block sampled per round), so the ideal curve is
+# flat rounds/sec while total clients/round grows with the process
+# count.  On the 2-core box 2+ processes oversubscribe the cores and
+# the carry rides loopback TCP, so >= 0.5x at 2 processes is the
+# documented GIL/gloo floor; the chip gate rides exp_POD (chip queue
+# step 15) where each process owns real chips and the carry rides DCN.
+MH_BITWISE_ROUNDS = 3
+
+
+def _bench_multihost(args) -> None:
+    """Weak-scaling sweep of the two-level multihost runtime: one
+    spawned cluster per process count, each rank reporting rounds/sec
+    and carry-allreduce bytes (fedml_tpu/parallel/mh_worker.py), plus
+    the 1-vs-2-process same-block-partition bitwise commit pin."""
+    import tempfile
+
+    from fedml_tpu import obs
+    from fedml_tpu.parallel.multihost import (MultihostLaunchError,
+                                              spawn_cluster)
+
+    procs_list = sorted({int(p) for p in str(args.mh_procs).split(",")
+                         if p.strip()})
+    if not procs_list or procs_list[0] < 1:
+        raise SystemExit(f"--mh_procs must be positive process counts, "
+                         f"got {args.mh_procs!r}")
+    if args.mh_rounds <= args.mh_warmup:
+        raise SystemExit(f"--mh_rounds ({args.mh_rounds}) must exceed "
+                         f"--mh_warmup ({args.mh_warmup})")
+
+    def run_arm(procs: int, n_blocks: int, rounds: int,
+                modes: list) -> dict:
+        """Spawn one cluster; returns {rank: worker JSON doc}."""
+        cfg = {
+            "clients": args.mh_clients_per_block * n_blocks,
+            "spc": 24, "dim": args.mh_dim, "classes": 10,
+            "k_per_round": args.mh_k_per_block * n_blocks,
+            "n_blocks": n_blocks, "rounds": rounds,
+            "warmup": args.mh_warmup, "seed": args.mh_seed,
+            "modes": modes, "local_devices": args.mh_local_devices,
+        }
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump(cfg, f)
+            path = f.name
+        try:
+            outs = spawn_cluster(
+                [sys.executable, "-m", "fedml_tpu.parallel.mh_worker",
+                 path], procs, timeout_s=900.0)
+        finally:
+            os.unlink(path)
+        docs = {}
+        for out in outs:
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    d = json.loads(line)
+                    docs[d["rank"]] = d
+        if len(docs) != procs:
+            raise MultihostLaunchError(
+                f"{len(docs)}/{procs} ranks reported")
+        return docs
+
+    slo_eng = _slo_window()
+    rows = []
+    deaths_total = 0
+    for n in procs_list:
+        try:
+            docs = run_arm(n, n, args.mh_rounds, ["streaming"])
+        except MultihostLaunchError as e:
+            print(f"multihost arm procs={n} FAILED: {e}",
+                  file=sys.stderr)
+            deaths_total += 1
+            rows.append({"procs": n, "n_blocks": n, "error": str(e),
+                         "process_deaths": 1})
+            continue
+        d0 = docs[0]
+        agree = all(docs[r]["digests"] == d0["digests"]
+                    for r in docs)
+        row = {
+            "procs": n,
+            "n_blocks": n,
+            "clients_per_round": args.mh_k_per_block * n,
+            "population": args.mh_clients_per_block * n,
+            "rounds_per_sec": round(d0["rounds_per_sec"], 4),
+            "round_wall_p50_s": round(
+                d0["per_mode"]["streaming"]["round_wall_p50_s"], 5),
+            "carry_allreduce_bytes_per_round": round(
+                max(docs[r]["carry_allreduce_bytes_per_round"]
+                    for r in docs), 1),
+            "ranks_agree": bool(agree),
+            "process_deaths": 0,
+        }
+        print(f"multihost procs={n}: "
+              f"{row['rounds_per_sec']:.3f} rounds/s  carry "
+              f"{row['carry_allreduce_bytes_per_round']:.0f} B/round  "
+              f"agree={agree}", file=sys.stderr)
+        rows.append(row)
+
+    ok_rows = {r["procs"]: r for r in rows if "error" not in r}
+    base = ok_rows.get(procs_list[0])
+
+    def _eff(n: int):
+        r = ok_rows.get(n)
+        if (base is None or r is None
+                or base["rounds_per_sec"] <= 0):
+            return None
+        return round(r["rounds_per_sec"] / base["rounds_per_sec"], 4)
+
+    # the bitwise pin arm: SAME block partition (n_blocks=2) at 1 and
+    # 2 processes, both residency modes — the commit digests must be
+    # byte-identical (the anchor that lets the weak-scaling numbers be
+    # trusted as the same computation)
+    bitwise_ok = None
+    try:
+        one = run_arm(1, 2, MH_BITWISE_ROUNDS,
+                      ["streaming", "resident"])
+        two = run_arm(2, 2, MH_BITWISE_ROUNDS,
+                      ["streaming", "resident"])
+        bitwise_ok = bool(
+            one[0]["digests"] == two[0]["digests"] == two[1]["digests"])
+        print(f"multihost bitwise 1p-vs-2p pin: "
+              f"{'OK' if bitwise_ok else 'MISMATCH'} "
+              f"({one[0]['digests']})", file=sys.stderr)
+    except MultihostLaunchError as e:
+        print(f"multihost bitwise arm FAILED: {e}", file=sys.stderr)
+        deaths_total += 1
+        bitwise_ok = False
+
+    head = rows[-1] if "error" not in rows[-1] else (
+        base or rows[-1])
+    doc = _stamp({
+        "metric": "multihost_weak_scaling_rounds_per_sec",
+        "value": round(head.get("rounds_per_sec", 0.0), 4),
+        "unit": "rounds/sec",
+        "vs_baseline": None,
+        "mode": "multihost",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": None,
+        "chaos": None,
+        "attack": None,
+        "serve": None,
+        "connections": None,
+        "multihost": {
+            "rows": rows,
+            "weak_efficiency_2p": _eff(2),
+            "weak_efficiency_4p": _eff(4),
+            "bitwise_2proc_ok": bitwise_ok,
+            "process_deaths": deaths_total,
+            "k_per_block": args.mh_k_per_block,
+            "clients_per_block": args.mh_clients_per_block,
+            "dim": args.mh_dim,
+            "local_devices": args.mh_local_devices,
+            "rounds": args.mh_rounds,
+            "warmup": args.mh_warmup,
+            "seed": args.mh_seed,
+        },
+        "critical_path": _critical_path_doc(),
+        "slo": _slo_doc({"sweep": _slo_close(slo_eng)}),
         "programs": _programs_doc(),
     })
     if obs.enabled():

@@ -390,6 +390,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--multihost", action="store_true",
                    help="join the multi-host runtime first "
                         "(jax.distributed.initialize; replaces mpirun)")
+    p.add_argument("--multihost_procs", type=int, default=None,
+                   help="self-spawn this many processes as a multihost "
+                        "cluster on this box (the dev harness; equals "
+                        "`tools/launch_multihost.py --procs N -- <this "
+                        "command>`): each process trains its client-id "
+                        "range's blocks on a LOCAL mesh and the P-sized "
+                        "carry allreduces across processes "
+                        "(two-level aggregation, ISSUE 13)")
+    p.add_argument("--agg_blocks", type=int, default=None,
+                   help="multihost: block count of the two-level "
+                        "reduction tree (default: the process count). "
+                        "The tree is a function of the BLOCK partition, "
+                        "not the topology — pin it across runs to keep "
+                        "commits bitwise comparable at different "
+                        "process counts")
     p.add_argument("--group_num", type=int, default=2,
                    help="hierarchical: silo count")
     p.add_argument("--group_comm_round", type=int, default=2)
@@ -706,7 +721,14 @@ def build_engine(args, cfg: FedConfig, data):
             mesh = make_mesh_batch(n_dev // args.mesh_batch,
                                    args.mesh_batch)
         else:
-            mesh = make_mesh()
+            from fedml_tpu.parallel.multihost import (MultihostContext,
+                                                      make_local_mesh)
+            # under a launched multihost cluster the engine's mesh is
+            # the LOCAL (intra-host) tier — cross-host traffic is the
+            # runner's carry allreduce, never an in-program collective
+            mesh = (make_local_mesh()
+                    if MultihostContext.from_env() is not None
+                    else make_mesh())
 
     if mesh is not None and algo not in ("fedavg", "fedopt", "fedprox",
                                          "fednova", "fedavg_robust",
@@ -1039,11 +1061,53 @@ def _notify_sweep(args) -> None:
         post_complete_message_to_sweep_process(vars(args), pipe_path=pipe)
 
 
+def _strip_arg(argv: list[str], flag: str) -> list[str]:
+    """Remove `flag` (and its value, both `--f N` and `--f=N` forms)
+    from an argv copy — the multihost self-spawn must not recurse."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == flag:
+            skip = True
+            continue
+        if a.startswith(flag + "="):
+            continue
+        out.append(a)
+    return out
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from fedml_tpu.parallel.multihost import MultihostContext
+    mh_ctx = MultihostContext.from_env()
+    if args.multihost_procs is not None and mh_ctx is None:
+        # self-spawn harness: re-exec this exact command N times wired
+        # as one cluster (children see FEDML_MH_* and take the runner
+        # path below instead of re-spawning)
+        if args.multihost_procs < 1:
+            raise SystemExit(f"--multihost_procs must be >= 1, got "
+                             f"{args.multihost_procs}")
+        from fedml_tpu.parallel.multihost import (MultihostLaunchError,
+                                                  spawn_cluster)
+        child = ([sys.executable, "-m", "fedml_tpu.cli"]
+                 + _strip_arg(list(argv if argv is not None
+                                   else sys.argv[1:]),
+                              "--multihost_procs"))
+        try:
+            for rank, out in enumerate(spawn_cluster(
+                    child, args.multihost_procs,
+                    jax_distributed=args.multihost, echo=True)):
+                for line in out.splitlines():
+                    print(f"[rank {rank}] {line}")
+        except MultihostLaunchError as e:
+            print(f"multihost launch failed: {e}", file=sys.stderr)
+            return 1
+        return 0
     if args.batch_unroll is not None and args.batch_unroll < 1:
         # here, not in build_engine: the --deploy path builds its
         # trainer without build_engine and must get the same clean error
@@ -1053,7 +1117,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     cfg.ci = bool(args.ci)
     from fedml_tpu import obs
     if args.obs_dir:
-        obs.configure(args.obs_dir)
+        obs_dir = args.obs_dir
+        if mh_ctx is not None and mh_ctx.world > 1:
+            # one obs dir per RANK: co-launched processes handed the
+            # same --obs_dir race each other's export tmp files (and
+            # silently interleave traces); per-rank subdirs are also
+            # what tools/trace_timeline.py wants as inputs
+            obs_dir = os.path.join(obs_dir, f"rank{mh_ctx.rank}")
+        obs.configure(obs_dir)
     else:
         obs.configure_from_env()     # FEDML_OBS_DIR (tools/isolate_hang)
     if args.obs_http_port is not None:
@@ -1069,7 +1140,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         from fedml_tpu.obs import slo as slo_mod
         slo_engine = slo_mod.SloEngine(
             slo_mod.default_slo_pack()).start(args.slo_period_s)
-    if args.multihost:
+    if mh_ctx is not None and mh_ctx.jax_coordinator:
+        # launcher-wired jax.distributed (chip path: makes each host's
+        # local chips visible); must run before any backend init
+        from fedml_tpu.parallel.multihost import init_multihost
+        init_multihost(coordinator_address=mh_ctx.jax_coordinator,
+                       num_processes=mh_ctx.world,
+                       process_id=mh_ctx.rank, required=True)
+    elif args.multihost:
         from fedml_tpu.parallel.multihost import init_multihost
         init_multihost(required=True)
 
@@ -1118,10 +1196,29 @@ def main(argv: Optional[list[str]] = None) -> int:
     eng = build_engine(args, cfg, data)
 
     import inspect
+    mh_runner = None
+    if mh_ctx is not None or args.agg_blocks is not None:
+        from fedml_tpu.parallel.multihost import MultihostRunner
+        if not args.mesh:
+            raise SystemExit(
+                "multihost execution drives the mesh engines: add --mesh")
+        if ckpt is not None:
+            logging.getLogger(__name__).warning(
+                "--ckpt_dir is ignored under multihost execution (the "
+                "two-level runner does not checkpoint yet)")
+        mh_runner = MultihostRunner(eng, mh_ctx,
+                                    n_blocks=args.agg_blocks)
+
     run_params = inspect.signature(eng.run).parameters
     engine_logs = "logger" in run_params
 
     def _run():
+        if mh_runner is not None:
+            try:
+                mh_runner.run(logger=logger)
+            finally:
+                mh_runner.close()
+            return
         kw = {}
         if engine_logs:
             kw = dict(logger=logger, ckpt=ckpt,

@@ -329,6 +329,9 @@ def report(since: Optional[dict] = None, *,
              dispatch_p50_s, dispatch_p95_s, compile_seconds,
              flops_per_dispatch, bytes_per_dispatch, flops_total,
              bytes_total, mfu}, ...],
+         "processes": [...],        # per-process breakdown rows from a
+                                    # multihost run's origin-labeled
+                                    # merged series (ISSUE 13)
          "total": {...}}            # the whole-run row
 
     MFU = flops_total / (window_s x peak_flops) — null without census
@@ -411,8 +414,50 @@ def report(since: Optional[dict] = None, *,
                      else None),
         "peak_flops": peak,
         "families": rows,
+        "processes": _per_process_rows(reg),
         "total": total,
     }
+
+
+def _per_process_rows(reg) -> list:
+    """Per-process breakdown (ISSUE 13): an N-process multihost run
+    folds each rank's metric deltas into rank 0's registry under an
+    ``origin`` label (MultihostRunner._rollup_metrics — the PR-7
+    remote-fold shape, so no gauge is last-writer-wins across
+    processes); these rows surface the merged per-family dispatch
+    series per origin.  All-time, not windowed: the fold happens once
+    at run end, so a window baseline taken mid-run has nothing to
+    subtract."""
+    from fedml_tpu.obs.metrics import MERGE_ORIGIN_LABEL
+    counts: dict[tuple, float] = {}
+    hists: dict[tuple, object] = {}
+    for m in reg.metrics():
+        labels = dict(m.labels)
+        fam = labels.get("family")
+        org = labels.get(MERGE_ORIGIN_LABEL)
+        if fam is None or org is None:
+            continue
+        if m.name == "program_dispatches_total":
+            counts[(fam, org)] = m.value
+        elif m.name == "program_dispatch_seconds":
+            hists[(fam, org)] = m
+    rows = []
+    for (fam, org) in sorted(counts):
+        row = {"family": fam, "process": org,
+               "dispatches": int(counts[(fam, org)]),
+               "dispatch_wall_s": None, "dispatch_p50_s": None,
+               "dispatch_p95_s": None}
+        h = hists.get((fam, org))
+        if h is not None:
+            after = h.cumulative()
+            row.update(
+                dispatch_wall_s=round(h.sum, 6),
+                dispatch_p50_s=quantile_from_cumulative(None, after,
+                                                        0.5),
+                dispatch_p95_s=quantile_from_cumulative(None, after,
+                                                        0.95))
+        rows.append(row)
+    return rows
 
 
 def format_table(rep: dict) -> str:

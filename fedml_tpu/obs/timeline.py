@@ -90,6 +90,11 @@ PROGRAM_FAMILY_STAGES = {
     "fedopt_blockstream": "train",
     "robust_orderstat": "train", "robust_blockstream": "train",
     "hierarchical": "train", "gossip": "train",
+    # the two-level multihost programs (ISSUE 13): per-block partials
+    # are training work, the replicated carry commit is aggregation
+    "fedavg_twolevel": "train", "fedprox_twolevel": "train",
+    "fedopt_twolevel": "train", "fednova_twolevel": "train",
+    "twolevel_commit": "commit",
     # the async ingestion/commit pipeline
     "async_fold": "fold", "async_drain_fold": "fold",
     "async_screened_fold": "fold", "async_admission": "fold",

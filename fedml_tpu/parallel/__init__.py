@@ -24,5 +24,8 @@ from fedml_tpu.parallel.engine import (MeshFedAvgEngine, MeshFedNovaEngine,
                                        MeshRobustEngine)
 from fedml_tpu.parallel.hierarchical import MeshHierarchicalEngine
 from fedml_tpu.parallel.gossip import MeshGossipEngine
-from fedml_tpu.parallel.multihost import (init_multihost, make_global_mesh,
-                                          make_hierarchical_host_mesh)
+from fedml_tpu.parallel.multihost import (HostChannel, MultihostContext,
+                                          MultihostRunner, init_multihost,
+                                          make_global_mesh,
+                                          make_hierarchical_host_mesh,
+                                          make_local_mesh, spawn_cluster)

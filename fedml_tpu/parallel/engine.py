@@ -705,6 +705,92 @@ class MeshFedAvgEngine(FedAvgEngine):
         return self._train_and_update(variables, server_state, cohort,
                                       weights, rng)
 
+    # -- two-level (multi-host) aggregation programs (ISSUE 13) --------------
+    # The multihost runner (parallel/multihost.py) decomposes a round
+    # into per-block PARTIALS (this engine's linear sums, psum'd over
+    # the LOCAL mesh only — the ICI tier) and one replicated COMMIT
+    # after the host-level inter-process fold of the P-sized flat
+    # carries (the DCN tier).  The partial returns the carry FLAT
+    # (flatten_carry_f32 over the engine's sums pytree) because the
+    # flat f32 vector is exactly what crosses hosts; the commit
+    # unflattens, divides, and applies the server update — so subclass
+    # overrides of _shard_sums/_zero_sums/_finalize_from_sums/
+    # server_update (FedNova's tau sums, FedOpt's optimizer, robust
+    # norm_clip's noise) ride the two-level path unchanged.
+    def _ensure_twolevel(self) -> None:
+        """Build the two-level programs lazily (most engines never run
+        multihost; the extra jits must not tax single-host
+        construction)."""
+        if getattr(self, "_twolevel_ready", False):
+            return
+        if getattr(self, "defense", "norm_clip") != "norm_clip":
+            raise ValueError(
+                f"two-level aggregation is linear: order-statistic "
+                f"defense {self.defense!r} cannot fold per-host "
+                f"partials (it needs the full [K, P] cohort matrix)")
+        fam = f"{self._family_stem}_twolevel"
+        # block cohorts are gathered fresh per round and consumed
+        # exactly once — donated like the streaming-consume round
+        self._twolevel_partial = obs_programs.instrument(
+            fam, jax.jit(self._twolevel_partial_impl,
+                         donate_argnums=(1, 2, 3) if self.donate
+                         else ()))
+        self._twolevel_partial_resident = obs_programs.instrument(
+            fam, jax.jit(self._twolevel_partial_resident_impl))
+        # flat_sums (argnum 2) is NOT donated: a 1-D [S] carry can never
+        # alias the variables-shaped outputs, so donating it only buys
+        # an unusable-donation warning per compile — unlike the
+        # block-finalize sums, whose variables-shaped num tree aliases
+        # the averaged output
+        self._twolevel_commit = obs_programs.instrument(
+            "twolevel_commit",
+            jax.jit(self._twolevel_commit_impl,
+                    donate_argnums=(0, 1) if self.donate else ()))
+        self._twolevel_ready = True
+
+    def _twolevel_partial_body(self, variables, cohort, weights, rngs):
+        specs = {k: stack_leaf_spec(self.mesh, v)
+                 for k, v in cohort.items()}
+        csh = P(self.client_axes)
+        sums = jax.shard_map(
+            self._shard_sums, mesh=self.mesh,
+            in_specs=(P(), specs, csh, csh), out_specs=P())(
+                variables, cohort, weights, rngs)
+        return flatten_carry_f32(sums)[0]
+
+    def _twolevel_partial_impl(self, variables, cohort, weights, rngs):
+        """One block's partial from a host-gathered cohort (streaming
+        residency): intra-host psum'd linear sums, returned as ONE flat
+        f32 carry — the vector the inter-host allreduce folds."""
+        return self._twolevel_partial_body(variables, cohort, weights,
+                                           rngs)
+
+    def _twolevel_partial_resident_impl(self, variables, stack, stack_w,
+                                        ids, wmask, rngs):
+        """Resident variant: the process's id-range stack lives on
+        device; the block cohort is a device-side take by LOCAL index.
+        Gather values are bitwise the host-gather's, so both residency
+        modes feed the identical partial math."""
+        cohort = {k: jax.lax.with_sharding_constraint(
+            jnp.take(v, ids, axis=0), stack_leaf_sharding(self.mesh, v))
+            for k, v in stack.items()}
+        weights = jnp.take(stack_w, ids) * wmask
+        return self._twolevel_partial_body(variables, cohort, weights,
+                                           rngs)
+
+    def _twolevel_commit_impl(self, variables, server_state, flat_sums,
+                              agg_rng):
+        """Replicated commit from the globally-folded flat carry:
+        unflatten into the engine's sums structure, divide, apply the
+        server update — run identically on every host (audited as the
+        `twolevel_commit` hlo family: 0 copy ops, donation
+        complete)."""
+        sums = unflatten_carry_f32(flat_sums, self._zero_sums(variables))
+        avg, loss = self._finalize_from_sums(variables, sums)
+        new_variables, server_state = self.server_update(
+            avg, variables, server_state, agg_rng)
+        return new_variables, server_state, {"train_loss": loss}
+
     def _host_gather_upload(self, ids) -> dict:
         """THE host-gather upload pipeline (shared by stream_cohort and
         _upload_block so the two streaming granularities can never

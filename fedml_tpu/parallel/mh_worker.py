@@ -1,0 +1,174 @@
+"""Multihost worker entry — one rank of a launched cluster.
+
+    python -m fedml_tpu.parallel.mh_worker CONFIG.json
+
+Reads its rank/world from the FEDML_MH_* env (set by
+tools/launch_multihost.py / spawn_cluster), builds a synthetic
+LR workload, drives MultihostRunner for the configured residency
+mode(s), and prints ONE JSON line per rank:
+
+    {"rank", "world", "n_blocks", "digests": {mode: md5},
+     "rounds_per_sec", "carry_allreduce_bytes_per_round", ...}
+
+Used by bench.py --mode multihost (the weak-scaling sweep) and
+tests/test_multihost_spmd.py (the 2-vs-1-process bitwise pin, the
+crash-of-one-rank naming case).  Not a test file itself.
+
+Config keys (all optional; defaults in DEFAULTS):
+    clients, spc, dim, classes, k_per_round, n_blocks, rounds, warmup,
+    seed, modes ["streaming","resident"], local_devices, lr,
+    channel_timeout_s, die_rank/die_at_round (crash injection: that
+    rank hard-exits rc=3 at the end of that round), jax_distributed,
+    eval (bool: report final test_acc from rank 0)
+"""
+import json
+import os
+import sys
+import time
+
+DEFAULTS = {
+    "clients": 16, "spc": 24, "dim": 16, "classes": 10,
+    "k_per_round": 8, "n_blocks": None, "rounds": 3, "warmup": 1,
+    "seed": 0, "modes": ["streaming", "resident"], "local_devices": 1,
+    "lr": 0.1, "channel_timeout_s": 60.0, "die_rank": None,
+    "die_at_round": None, "jax_distributed": False, "eval": False,
+}
+
+
+def _setup_jax(cfg: dict) -> None:
+    """Platform/device-count/compile-cache config — BEFORE any jax
+    backend init (the init_multihost contract)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count="
+              f"{cfg['local_devices']}")
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    cache = os.path.expanduser("~/.cache/fedml_tpu_jax_tests")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:
+        pass
+
+
+def build_case(cfg: dict):
+    """Synthetic separable-LR federated case — same shape as
+    tests/multihost_case.py but parameterized and package-local (the
+    bench worker must not import tests/)."""
+    import numpy as np
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.federated import (FederatedData,
+                                          build_client_shards,
+                                          build_eval_shard)
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel.engine import MeshFedAvgEngine
+    from fedml_tpu.parallel.multihost import make_local_mesh
+    from fedml_tpu.utils.config import FedConfig
+
+    C, spc, dim, classes = (cfg["clients"], cfg["spc"], cfg["dim"],
+                            cfg["classes"])
+    bs = min(8, spc)
+    rs = np.random.RandomState(7)
+    n = C * spc
+    w = rs.randn(dim, classes)
+    x = rs.randn(n, dim).astype(np.float32)
+    y = np.argmax(x @ w + 0.2 * rs.randn(n, classes),
+                  axis=1).astype(np.int64)
+    idx = {i: np.arange(i * spc, (i + 1) * spc) for i in range(C)}
+    data = FederatedData(
+        train_data_num=n, test_data_num=n,
+        train_global=build_eval_shard(x, y, n),
+        test_global=build_eval_shard(x, y, n),
+        client_shards=build_client_shards(x, y, idx, bs),
+        client_num_samples=np.full(C, spc, np.float32),
+        test_client_shards=None, class_num=classes)
+    fedcfg = FedConfig(client_num_in_total=C,
+                       client_num_per_round=cfg["k_per_round"],
+                       comm_round=cfg["rounds"], epochs=1,
+                       batch_size=bs, lr=cfg["lr"], seed=cfg["seed"],
+                       frequency_of_the_test=10_000)
+    model = create_model("lr", output_dim=classes)
+
+    def make_engine(streaming: bool):
+        return MeshFedAvgEngine(ClientTrainer(model, lr=fedcfg.lr),
+                                data, fedcfg, mesh=make_local_mesh(),
+                                streaming=streaming)
+
+    return make_engine
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m fedml_tpu.parallel.mh_worker CONFIG.json",
+              file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        cfg = {**DEFAULTS, **json.load(f)}
+    _setup_jax(cfg)
+    import jax
+
+    from fedml_tpu.parallel.multihost import (HostChannel,
+                                              MultihostContext,
+                                              MultihostRunner,
+                                              init_multihost,
+                                              variables_digest)
+    ctx = MultihostContext.from_env() or MultihostContext.single()
+    if cfg["jax_distributed"] and ctx.jax_coordinator:
+        init_multihost(coordinator_address=ctx.jax_coordinator,
+                       num_processes=ctx.world, process_id=ctx.rank,
+                       required=True)
+    make_engine = build_case(cfg)
+    n_blocks = cfg["n_blocks"] or ctx.world
+
+    def on_round_end(round_idx: int) -> None:
+        if (cfg["die_rank"] == ctx.rank
+                and cfg["die_at_round"] == round_idx):
+            print(f"rank {ctx.rank}: injected crash at round "
+                  f"{round_idx}", file=sys.stderr, flush=True)
+            os._exit(3)
+
+    # ONE channel for the whole worker (both residency modes ride it;
+    # re-binding the coordinator port between modes would race peers)
+    channel = HostChannel(ctx, timeout_s=cfg["channel_timeout_s"])
+    out = {"rank": ctx.rank, "world": ctx.world, "n_blocks": n_blocks,
+           "digests": {}, "per_mode": {}}
+    try:
+        for mode in cfg["modes"]:
+            if mode not in ("streaming", "resident"):
+                raise SystemExit(f"unknown residency mode {mode!r}")
+            engine = make_engine(streaming=(mode == "streaming"))
+            runner = MultihostRunner(
+                engine, ctx, n_blocks=n_blocks, channel=channel,
+                timeout_s=cfg["channel_timeout_s"],
+                on_round_end=on_round_end)
+            t0 = time.perf_counter()
+            variables = runner.run(rounds=cfg["rounds"])
+            wall = time.perf_counter() - t0
+            rep = runner.report(warmup_rounds=cfg["warmup"])
+            rep["total_wall_s"] = wall
+            out["digests"][mode] = variables_digest(variables)
+            out["per_mode"][mode] = rep
+            if cfg["eval"] and ctx.rank == 0:
+                out.setdefault("eval", {})[mode] = \
+                    engine.evaluate(variables)["test_acc"]
+        # headline timing: the streaming mode when run, else the first
+        head = ("streaming" if "streaming" in out["per_mode"]
+                else next(iter(out["per_mode"])))
+        out["rounds_per_sec"] = out["per_mode"][head]["rounds_per_sec"]
+        out["carry_allreduce_bytes_per_round"] = \
+            out["per_mode"][head]["carry_allreduce_bytes_per_round"]
+        out["jax"] = jax.__version__
+        print(json.dumps(out), flush=True)
+    finally:
+        channel.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

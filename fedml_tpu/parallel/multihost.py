@@ -1,4 +1,5 @@
-"""Multi-host (DCN) runtime bootstrap.
+"""Multi-host (DCN) runtime — bootstrap, host channel, and the
+two-level round loop (ISSUE 13).
 
 The reference scales across machines with `mpirun -np N -hostfile ...`
 (run_fedavg_distributed_pytorch.sh:16-35) — one OS process per client rank
@@ -8,6 +9,44 @@ runtime, and `jax.devices()` becomes the global chip list.  The engines in
 parallel/ are already global-view (shard_map over a Mesh, device_put with
 NamedShardings), so they run unchanged on a multi-host mesh — XLA routes
 in-slice collectives over ICI and cross-slice traffic over DCN.
+
+ISSUE 13 adds the runnable-today runtime on top of that seam, following
+the MLPerf pod recipe (arXiv:1909.09756 — per-host input pipelines,
+hierarchical gradient reduction) mapped onto FedML's hierarchical
+aggregation (arXiv:2007.13518):
+
+* `MultihostContext` / `spawn_cluster` / `tools/launch_multihost.py` —
+  a multi-process launcher: N OS processes wired by env
+  (`FEDML_MH_RANK/WORLD/COORD`), optionally joined into one jax runtime
+  via `init_multihost` (`FEDML_MH_JAX_COORD`; on TPU pods this is what
+  makes the local chips visible).
+* `HostChannel` — the DCN tier executed for real: a tiny TCP
+  coordinator (rank 0) carrying the P-sized flat f32 carry between
+  hosts.  On the CPU dev box this stands in for gloo/DCN; it needs NO
+  backend collective support, which is what makes the runtime runnable
+  on jaxlib builds whose CPU backend lacks cross-process computations
+  (the 0.4.x line — see tests/test_multihost_spmd.py's version gate on
+  the in-program gloo path).  Every wait is BOUNDED: a dead or hung
+  rank raises `DeadRankError` NAMING the rank instead of hanging the
+  cluster.
+* `MultihostRunner` — the two-level round loop: intra-host psum over
+  the flat f32 carry on the LOCAL mesh (the engine's new
+  `{family}_twolevel` partial program, ICI tier), then an inter-host
+  allreduce of the P-sized per-block partials over the HostChannel
+  (DCN tier), then a replicated commit (`twolevel_commit` program) on
+  every host.
+
+Bitwise anchor (the pin that anchors this subsystem, like the reactor
+and async ones): the reduction tree is a function of the BLOCK
+PARTITION, not the process count.  The cohort is sampled per block
+from fixed population ranges (`BlockCohortSampler`, rng streams keyed
+[seed, round, block]), each block's partial is one compiled program on
+a same-shaped local mesh, and every host folds ALL block partials in
+global block order.  Any process count that tiles the same blocks
+therefore commits bitwise-identically — `n_blocks=2` at 1 process and
+at 2 processes produce the same bits (tests/test_multihost_spmd.py).
+This is STRONGER than an in-program psum can promise (a topology
+change reorders XLA's reduction ring).
 
 Mesh layout guidance (the scaling-book recipe): put the axis with the
 heaviest collective traffic (the client/cohort axis — its psum moves the
@@ -33,15 +72,32 @@ addressable.
 """
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import logging
-from typing import Optional
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh
 
+from fedml_tpu import obs
 from fedml_tpu.parallel.mesh import CLIENT_AXIS, make_mesh, make_mesh_2d
 
 log = logging.getLogger(__name__)
+
+ENV_RANK = "FEDML_MH_RANK"
+ENV_WORLD = "FEDML_MH_WORLD"
+ENV_COORD = "FEDML_MH_COORD"           # host:port of the HostChannel
+ENV_JAX_COORD = "FEDML_MH_JAX_COORD"   # host:port for jax.distributed
 
 
 def init_multihost(coordinator_address: Optional[str] = None,
@@ -107,17 +163,898 @@ def make_global_mesh(axis_name: str = CLIENT_AXIS) -> Mesh:
     return make_mesh(axis_name=axis_name)
 
 
+def make_local_mesh(axis_name: str = CLIENT_AXIS) -> Mesh:
+    """1-D mesh over THIS process's chips only — the intra-host tier of
+    the two-level aggregation (MultihostRunner requires a local-only
+    mesh: its cross-host traffic is the HostChannel carry exchange, not
+    in-program collectives)."""
+    return make_mesh(axis_name=axis_name, devices=jax.local_devices())
+
+
 def make_hierarchical_host_mesh(silos: Optional[int] = None) -> Mesh:
     """2-D (silo × clients) mesh with one silo per host by default: the
     inner FedAvg psum stays on each host's ICI, only the per-silo means
     cross DCN — the two-tier reduction of hierarchical FL mapped onto the
-    physical network (SURVEY.md §2.5 'hierarchical aggregation')."""
+    physical network (SURVEY.md §2.5 'hierarchical aggregation').
+
+    VIRTUAL-SILO semantics (single process, silos>1): with only one
+    process there is no host boundary to place the silo tier on — the
+    requested silo rows are carved out of THIS host's devices, so the
+    "DCN tier" is simulated on local links.  That is the intended
+    dev/test topology (the virtual-CPU oracles in
+    tests/multihost_case.py rely on it), but it measures NOTHING about
+    cross-host cost — a loud warning says so, because on a real pod the
+    same call with one process per host is the genuine two-tier layout
+    and silently accepting the single-process shape has masked
+    misconfigured launches (ISSUE 13 satellite)."""
     devs = jax.devices()
-    silos = silos or max(jax.process_count(), 1)
+    procs = max(jax.process_count(), 1)
+    silos = silos or procs
     if len(devs) % silos != 0:
         raise ValueError(f"{len(devs)} devices not divisible into "
                          f"{silos} silos")
+    if procs == 1 and silos > 1:
+        log.warning(
+            "make_hierarchical_host_mesh: building %d VIRTUAL silos on a "
+            "single process — every silo row shares this host's devices, "
+            "so the cross-silo tier rides local links, not DCN.  This is "
+            "the dev/test topology (virtual-CPU oracles); on a pod, "
+            "launch one process per host so the silo tier really crosses "
+            "hosts.", silos)
     # global device order is NOT guaranteed host-contiguous; sort by
     # process so each silo row really sits on one host's ICI
     devs = sorted(devs, key=lambda d: (d.process_index, d.id))
     return make_mesh_2d(n_silos=silos, devices=devs)
+
+
+# ---------------------------------------------------------------------------
+# process context + cluster spawning
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MultihostContext:
+    """One process's place in the launched cluster (env-carried so any
+    entry point — cli, bench worker, test worker — resolves the same
+    way)."""
+    rank: int
+    world: int
+    coordinator: str                    # "host:port" of the HostChannel
+    jax_coordinator: Optional[str] = None   # jax.distributed, when wired
+
+    @classmethod
+    def from_env(cls) -> Optional["MultihostContext"]:
+        if ENV_RANK not in os.environ or ENV_WORLD not in os.environ:
+            return None
+        world = int(os.environ[ENV_WORLD])
+        rank = int(os.environ[ENV_RANK])
+        if not 0 <= rank < world:
+            raise ValueError(f"{ENV_RANK}={rank} outside world "
+                             f"{world}")
+        return cls(rank=rank, world=world,
+                   coordinator=os.environ.get(ENV_COORD,
+                                              "localhost:0"),
+                   jax_coordinator=os.environ.get(ENV_JAX_COORD))
+
+    @classmethod
+    def single(cls) -> "MultihostContext":
+        return cls(rank=0, world=1, coordinator="localhost:0")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+class MultihostLaunchError(RuntimeError):
+    """A launched rank failed/hung; the message names it."""
+
+
+def spawn_cluster(cmd: list[str], procs: int, *,
+                  env: Optional[dict] = None,
+                  timeout_s: float = 600.0,
+                  jax_distributed: bool = False,
+                  echo: bool = False,
+                  coordinator_host: str = "localhost") -> list[str]:
+    """Fork `procs` copies of `cmd` wired as one multihost cluster (env
+    FEDML_MH_RANK/WORLD/COORD [+ FEDML_MH_JAX_COORD with
+    jax_distributed]); returns each rank's stdout, rank-ordered.
+
+    Failure policy: the first rank to exit nonzero kills the rest and
+    raises MultihostLaunchError NAMING that rank (with its stderr
+    tail); a deadline overrun kills everything and names the ranks
+    still running.  `echo` streams child stderr line-prefixed
+    (`[rank i]`) for interactive launches."""
+    if procs < 1:
+        raise ValueError(f"procs must be >= 1, got {procs}")
+    if not cmd:
+        raise ValueError("empty worker command")
+    coord = f"{coordinator_host}:{free_port()}"
+    base_env = {**os.environ, **(env or {}),
+                ENV_WORLD: str(procs), ENV_COORD: coord}
+    if jax_distributed:
+        base_env[ENV_JAX_COORD] = f"{coordinator_host}:{free_port()}"
+    ps = []
+    for r in range(procs):
+        e = dict(base_env)
+        e[ENV_RANK] = str(r)
+        ps.append(subprocess.Popen(cmd, env=e, text=True,
+                                   stdout=subprocess.PIPE,
+                                   stderr=subprocess.PIPE))
+    outs: list = [None] * procs
+    errs: list = [None] * procs
+
+    def _drain(i):
+        buf_out, buf_err = [], []
+
+        def _pump(stream, buf, is_err):
+            for line in stream:
+                buf.append(line)
+                if echo and is_err:
+                    # stderr streams live (progress/tracebacks); stdout
+                    # is returned buffered so machine-readable lines
+                    # stay contiguous per rank
+                    print(f"[rank {i}] {line}", end="", file=sys.stderr,
+                          flush=True)
+        t_err = threading.Thread(target=_pump,
+                                 args=(ps[i].stderr, buf_err, True))
+        t_err.start()
+        _pump(ps[i].stdout, buf_out, False)
+        t_err.join()
+        outs[i], errs[i] = "".join(buf_out), "".join(buf_err)
+
+    drains = [threading.Thread(target=_drain, args=(i,))
+              for i in range(procs)]
+    for t in drains:
+        t.start()
+    deadline = time.monotonic() + timeout_s
+    first_failed: Optional[int] = None
+    try:
+        while True:
+            live = [i for i, p in enumerate(ps) if p.poll() is None]
+            failed = [i for i, p in enumerate(ps)
+                      if p.poll() is not None and p.returncode != 0]
+            if failed and first_failed is None:
+                first_failed = failed[0]
+            if failed or not live:
+                break
+            if time.monotonic() > deadline:
+                for p in ps:
+                    if p.poll() is None:
+                        p.kill()
+                raise MultihostLaunchError(
+                    f"multihost launch timed out after {timeout_s:.0f}s: "
+                    f"rank(s) {live} still running (of {procs})")
+            time.sleep(0.05)
+        if failed:
+            # give survivors a short grace (a dead peer's channel EOF
+            # usually fails them promptly with their OWN named error),
+            # then kill
+            grace = time.monotonic() + 5.0
+            while (time.monotonic() < grace
+                   and any(p.poll() is None for p in ps)):
+                time.sleep(0.05)
+            for p in ps:
+                if p.poll() is None:
+                    p.kill()
+    finally:
+        for t in drains:
+            t.join()
+    bad = [i for i, p in enumerate(ps) if p.returncode != 0]
+    if bad:
+        # blame the FIRST rank observed failing (the injected/original
+        # fault), not a survivor that died of the resulting channel EOF
+        i = first_failed if first_failed in bad else bad[0]
+        tail = (errs[i] or "")[-3000:]
+        raise MultihostLaunchError(
+            f"multihost rank {i}/{procs} failed first "
+            f"(rc={ps[i].returncode}; {len(bad)}/{procs} ranks "
+            f"failed):\n{tail}")
+    return [o or "" for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# HostChannel — the DCN tier, executed for real
+# ---------------------------------------------------------------------------
+
+class DeadRankError(RuntimeError):
+    """A peer rank died or stalled past the bounded channel timeout; the
+    message names it (the crash-of-one-process acceptance case)."""
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class HostChannel:
+    """Small-payload allgather/barrier between the cluster's processes —
+    the inter-host (DCN) tier of the two-level aggregation, carrying the
+    P-sized flat f32 carry partials.
+
+    Star topology: rank 0 coordinates (gathers every rank's payload,
+    broadcasts the rank-ordered list).  Deliberately NOT a ring: the
+    payloads are O(P) model-carry vectors, tiny next to the cohort data
+    that never crosses processes, and a star gives every failure a
+    single observer that can NAME the dead rank.  All waits are bounded
+    (`timeout_s`): a dead peer raises DeadRankError naming it instead
+    of hanging the round loop (the PR-8 crash lesson, applied to the
+    cluster tier).  Byte/time accounting lands in
+    multihost_bytes_sent/received_total and multihost_allgather_seconds
+    (the bench's carry-allreduce bytes read)."""
+
+    def __init__(self, ctx: MultihostContext, *,
+                 timeout_s: float = 120.0,
+                 connect_timeout_s: float = 60.0):
+        self.ctx = ctx
+        self.timeout_s = float(timeout_s)
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self._seq = 0
+        self._peers: dict[int, socket.socket] = {}
+        self._sock: Optional[socket.socket] = None
+        self._listener: Optional[socket.socket] = None
+        if ctx.world <= 1:
+            return
+        host, port = ctx.coordinator.rsplit(":", 1)
+        port = int(port)
+        if ctx.rank == 0:
+            self._listener = socket.create_server((host, port))
+            self._listener.settimeout(connect_timeout_s)
+            deadline = time.monotonic() + connect_timeout_s
+
+            def _setup_dead(reason: str):
+                missing = sorted(set(range(1, ctx.world))
+                                 - set(self._peers))
+                for s in self._peers.values():
+                    s.close()
+                self._listener.close()
+                raise DeadRankError(
+                    f"multihost channel setup: rank(s) {missing} "
+                    f"{reason} within {connect_timeout_s:.0f}s")
+
+            while len(self._peers) < ctx.world - 1:
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    conn = None
+                if conn is None or time.monotonic() > deadline:
+                    _setup_dead("never connected")
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # accepted sockets are BLOCKING regardless of the
+                # listener's timeout — bound the rank handshake too, or
+                # a connected-but-stalled peer hangs setup unboundedly
+                conn.settimeout(max(0.001, deadline - time.monotonic()))
+                try:
+                    (r,) = struct.unpack("<I", _recv_exact(conn, 4))
+                except (socket.timeout, ConnectionError, OSError):
+                    conn.close()
+                    _setup_dead("connected but never sent a rank "
+                                "handshake")
+                self._peers[r] = conn
+        else:
+            deadline = time.monotonic() + connect_timeout_s
+            last_err: Optional[Exception] = None
+            while True:
+                try:
+                    self._sock = socket.create_connection(
+                        (host, port), timeout=5.0)
+                    break
+                except OSError as e:
+                    last_err = e
+                    if time.monotonic() > deadline:
+                        raise DeadRankError(
+                            f"multihost channel setup: rank {ctx.rank} "
+                            f"could not reach the rank-0 coordinator at "
+                            f"{ctx.coordinator} within "
+                            f"{connect_timeout_s:.0f}s: {e}") from e
+                    time.sleep(0.1)
+            del last_err
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+            self._sock.sendall(struct.pack("<I", ctx.rank))
+
+    # -- collective ops ------------------------------------------------------
+    def allgather(self, payload: bytes,
+                  timeout_s: Optional[float] = None) -> list[bytes]:
+        """Every rank contributes `payload`; every rank receives the
+        rank-ordered list.  Bounded: a silent rank raises DeadRankError
+        naming it."""
+        t0 = time.perf_counter()
+        timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        self._seq += 1
+        ctx = self.ctx
+        if ctx.world <= 1:
+            return [payload]
+        deadline = time.monotonic() + timeout
+        try:
+            if ctx.rank == 0:
+                parts: list[Optional[bytes]] = [None] * ctx.world
+                parts[0] = payload
+                for r in sorted(self._peers):
+                    sock = self._peers[r]
+                    sock.settimeout(max(0.001,
+                                        deadline - time.monotonic()))
+                    try:
+                        parts[r] = _recv_frame(sock)
+                    except (socket.timeout, ConnectionError, OSError) as e:
+                        missing = sorted(r2 for r2 in range(1, ctx.world)
+                                         if parts[r2] is None)
+                        raise DeadRankError(
+                            f"multihost allgather #{self._seq}: no "
+                            f"payload from rank(s) {missing} within "
+                            f"{timeout:.0f}s ({type(e).__name__}: "
+                            f"process dead or hung)") from e
+                    self.bytes_received += len(parts[r])
+                blob = struct.pack("<I", ctx.world) + b"".join(
+                    struct.pack("<Q", len(p)) + p for p in parts)
+                for r in sorted(self._peers):
+                    try:
+                        _send_frame(self._peers[r], blob)
+                    except (socket.timeout, ConnectionError, OSError) as e:
+                        raise DeadRankError(
+                            f"multihost allgather #{self._seq}: "
+                            f"broadcast to rank {r} failed "
+                            f"({type(e).__name__}: rank died after "
+                            f"contributing)") from e
+                    self.bytes_sent += len(blob) + 8
+                return list(parts)          # type: ignore[arg-type]
+            # non-root: ship ours, await the broadcast.  Reset the
+            # send-side timeout first — settimeout() PERSISTS on the
+            # socket, so without this the send runs under whatever
+            # near-expired recv deadline the previous allgather left
+            self._sock.settimeout(max(0.001,
+                                      deadline - time.monotonic()))
+            try:
+                _send_frame(self._sock, payload)
+            except (socket.timeout, ConnectionError, OSError) as e:
+                raise DeadRankError(
+                    f"multihost allgather #{self._seq}: rank {ctx.rank} "
+                    f"could not ship its payload to the rank-0 "
+                    f"coordinator ({type(e).__name__}: coordinator dead "
+                    f"or backpressured past {timeout:.0f}s)") from e
+            self.bytes_sent += len(payload) + 8
+            self._sock.settimeout(max(0.001, deadline - time.monotonic()))
+            try:
+                blob = _recv_frame(self._sock)
+            except (socket.timeout, ConnectionError, OSError) as e:
+                raise DeadRankError(
+                    f"multihost allgather #{self._seq}: rank {ctx.rank} "
+                    f"got no broadcast from the rank-0 coordinator "
+                    f"within {timeout:.0f}s ({type(e).__name__}: "
+                    f"coordinator dead, or a peer stalled it)") from e
+            self.bytes_received += len(blob)
+            (world,) = struct.unpack_from("<I", blob, 0)
+            off, parts = 4, []
+            for _ in range(world):
+                (n,) = struct.unpack_from("<Q", blob, off)
+                off += 8
+                parts.append(blob[off:off + n])
+                off += n
+            return parts
+        finally:
+            obs.histogram("multihost_allgather_seconds").observe(
+                time.perf_counter() - t0)
+
+    def barrier(self, timeout_s: Optional[float] = None) -> None:
+        self.allgather(b"", timeout_s=timeout_s)
+
+    def export_byte_counters(self) -> None:
+        """Publish the cumulative byte counters as obs metrics (called
+        at round boundaries — the counters themselves stay cheap plain
+        ints on the hot path)."""
+        r = str(self.ctx.rank)
+        sent = obs.counter("multihost_bytes_sent_total", rank=r)
+        recv = obs.counter("multihost_bytes_received_total", rank=r)
+        sent.inc(max(0.0, self.bytes_sent - sent.value))
+        recv.inc(max(0.0, self.bytes_received - recv.value))
+
+    def close(self) -> None:
+        for s in self._peers.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._peers.clear()
+        for s in (self._sock, self._listener):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._sock = self._listener = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# topology-independent block sampling
+# ---------------------------------------------------------------------------
+
+class BlockCohortSampler:
+    """Per-block cohort sampling over fixed population ranges — the
+    sampling half of the bitwise anchor.
+
+    The population [0, C) splits into `n_blocks` contiguous ranges (the
+    PR-10 registry/shardstore id-range partition, applied to the
+    cohort); block b draws `k_per_block` clients without replacement
+    from ITS range on a private `default_rng([seed, round, block])`
+    stream.  Every quantity is a pure function of (seed, round, block)
+    — NOT of which process computes it — so any topology tiling the
+    same blocks samples the same cohort (and the draw is
+    background-thread-safe: no global-RNG reseed, the PR-10 lesson)."""
+
+    def __init__(self, population: int, n_blocks: int, k_per_block: int,
+                 seed: int):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if population % n_blocks:
+            raise ValueError(
+                f"population ({population}) must divide evenly into "
+                f"{n_blocks} blocks (the id-range partition must be "
+                f"topology-independent)")
+        self.population = int(population)
+        self.n_blocks = int(n_blocks)
+        self.range_size = population // n_blocks
+        if not 1 <= k_per_block <= self.range_size:
+            raise ValueError(
+                f"k_per_block ({k_per_block}) must be in [1, "
+                f"{self.range_size}] (each block samples within its "
+                f"{self.range_size}-client range)")
+        self.k_per_block = int(k_per_block)
+        self.seed = int(seed)
+
+    def sample_block(self, round_idx: int, block: int) -> np.ndarray:
+        """Global client ids of block `block`'s round-`round_idx`
+        cohort, sorted ascending (a canonical order so every topology
+        builds the identical cohort stack)."""
+        if not 0 <= block < self.n_blocks:
+            raise ValueError(f"block {block} outside [0, "
+                             f"{self.n_blocks})")
+        lo = block * self.range_size
+        if self.k_per_block == self.range_size:
+            return np.arange(lo, lo + self.range_size, dtype=np.int64)
+        rng = np.random.default_rng(
+            [self.seed, int(round_idx), int(block)])
+        ids = rng.choice(self.range_size, size=self.k_per_block,
+                         replace=False)
+        return np.sort(ids).astype(np.int64) + lo
+
+
+def fold_block_partials(parts: dict[int, np.ndarray],
+                        n_blocks: int) -> np.ndarray:
+    """THE deterministic inter-host reduction: left-fold the per-block
+    f32 partials in GLOBAL BLOCK ORDER.  Identical on every host and
+    for every topology that produced the same blocks — float addition
+    is not associative, so the fold order is the contract (never
+    tree-reduce here without changing the bitwise anchor)."""
+    missing = [b for b in range(n_blocks) if b not in parts]
+    if missing:
+        raise DeadRankError(
+            f"two-level fold: block partial(s) {missing} missing from "
+            f"the allgather (owning rank dead mid-round?)")
+    total = np.array(parts[0], dtype=np.float32, copy=True)
+    for b in range(1, n_blocks):
+        total += np.asarray(parts[b], dtype=np.float32)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the two-level round loop
+# ---------------------------------------------------------------------------
+
+# per-PROCESS metrics-rollup baseline: (registry identity, prev state).
+# Keyed on the registry object so obs.reset() (tests) naturally resets
+# the baseline with it.
+_rollup_state: Optional[tuple] = None
+
+
+def _delta_since_last_rollup() -> dict:
+    global _rollup_state
+    reg = obs.registry()
+    prev = (_rollup_state[1]
+            if _rollup_state is not None and _rollup_state[0] is reg
+            else None)
+    delta, state = reg.delta_snapshot(prev)
+    _rollup_state = (reg, state)
+    return delta
+
+
+class MultihostRunner:
+    """Two-level multihost round loop over a FedAvg-family mesh engine.
+
+    Per round, on every process:
+
+      1. sample: `BlockCohortSampler` draws each block's cohort from its
+         population range — pure function of (seed, round, block);
+      2. partial (ICI tier): for each OWNED block (contiguous tiling:
+         rank r owns blocks [r·B/W, (r+1)·B/W)), gather+upload the
+         block cohort (host-sharded data: only this process's blocks
+         cross H2D; double-buffered per-host prefetch on the streaming
+         path) and run the engine's `{family}_twolevel` partial program
+         — chunk-scanned local training + intra-host psum on the LOCAL
+         mesh, returning the flat f32 carry;
+      3. allreduce (DCN tier): `HostChannel.allgather` of the owned
+         partials, then EVERY process folds all B partials in global
+         block order (`fold_block_partials`);
+      4. commit: the replicated `twolevel_commit` program divides and
+         applies the server update identically on every process.
+
+    Bitwise anchor: with a fixed `n_blocks`, same-seed runs at ANY
+    process count that tiles the blocks commit identical bits (the
+    2-vs-1-process pin in tests/test_multihost_spmd.py).  Resident
+    mode uploads only this process's population range to device;
+    streaming mode uploads only its blocks' cohorts per round —
+    nothing population-sized crosses process boundaries either way."""
+
+    def __init__(self, engine, ctx: Optional[MultihostContext] = None,
+                 *, n_blocks: Optional[int] = None,
+                 channel: Optional[HostChannel] = None,
+                 timeout_s: float = 120.0,
+                 on_round_end: Optional[Callable[[int], None]] = None):
+        from fedml_tpu.parallel.engine import MeshFedAvgEngine
+        from fedml_tpu.parallel.hierarchical import MeshHierarchicalEngine
+        if (not isinstance(engine, MeshFedAvgEngine)
+                or isinstance(engine, MeshHierarchicalEngine)):
+            # hierarchical subclasses the FedAvg engine but its rounds
+            # are group_comm_round-structured — folding its sums flat
+            # here would SILENTLY compute plain FedAvg instead (its
+            # multihost story is the silo-per-host mesh above)
+            raise ValueError(
+                f"MultihostRunner drives the flat FedAvg-family mesh "
+                f"engines, not {type(engine).__name__}")
+        if engine.stream_block is not None:
+            raise ValueError(
+                "MultihostRunner does not drive block-streamed rounds "
+                "yet: stream WITHIN a host via smaller blocks, or use "
+                "streaming mode (per-block cohorts already bound device "
+                "memory by O(block))")
+        if getattr(engine, "defense", "norm_clip") not in ("norm_clip",):
+            raise ValueError(
+                f"two-level aggregation is linear: order-statistic "
+                f"defense {engine.defense!r} cannot fold across hosts "
+                f"(its [K, P] matrix needs every client row)")
+        # the engine's mesh must be process-local: the cross-host tier
+        # is the HostChannel, never an in-program collective
+        for d in engine.mesh.devices.flat:
+            if d.process_index != jax.process_index():
+                raise ValueError(
+                    "MultihostRunner needs a LOCAL mesh (build the "
+                    "engine with make_local_mesh()): device "
+                    f"{d} belongs to process {d.process_index}")
+        self.engine = engine
+        self.ctx = ctx if ctx is not None else (
+            MultihostContext.from_env() or MultihostContext.single())
+        self.timeout_s = float(timeout_s)
+        self.on_round_end = on_round_end
+        world = self.ctx.world
+        self.n_blocks = int(n_blocks) if n_blocks else world
+        if self.n_blocks % world:
+            raise ValueError(
+                f"n_blocks ({self.n_blocks}) must be a multiple of the "
+                f"process count ({world}) — contiguous tiling is the "
+                f"bitwise contract")
+        cfg = engine.cfg
+        if cfg.client_num_per_round % self.n_blocks:
+            raise ValueError(
+                f"client_num_per_round ({cfg.client_num_per_round}) "
+                f"must divide evenly into {self.n_blocks} blocks")
+        self.sampler = BlockCohortSampler(
+            engine.data.client_num, self.n_blocks,
+            cfg.client_num_per_round // self.n_blocks, cfg.seed)
+        bpp = self.n_blocks // world
+        self.owned_blocks = tuple(range(self.ctx.rank * bpp,
+                                        (self.ctx.rank + 1) * bpp))
+        # this process's population id range (contiguous because its
+        # blocks are) — the resident device stack holds ONLY this slice
+        self.range_lo = self.owned_blocks[0] * self.sampler.range_size
+        self.range_hi = ((self.owned_blocks[-1] + 1)
+                         * self.sampler.range_size)
+        self._channel = channel
+        self._owns_channel = channel is None
+        self._range_stack = None
+        self._range_stack_w = None
+        self._prefetched = None
+        self.round_walls: list[float] = []
+        self.carry_bytes: list[int] = []
+        engine._ensure_twolevel()
+
+    # -- setup ---------------------------------------------------------------
+    @property
+    def channel(self) -> HostChannel:
+        if self._channel is None:
+            self._channel = HostChannel(self.ctx,
+                                        timeout_s=self.timeout_s)
+        return self._channel
+
+    def _handshake(self) -> None:
+        """Cross-rank config agreement: the bitwise contract only holds
+        when every process runs the identical partition and programs —
+        a mismatch names the ranks instead of silently diverging."""
+        eng = self.engine
+        doc = json.dumps({
+            "n_blocks": self.n_blocks,
+            "k_per_block": self.sampler.k_per_block,
+            "population": self.sampler.population,
+            "n_shards": eng.n_shards,
+            "chunk": eng.chunk,
+            "seed": eng.cfg.seed,
+            "family": eng.program_family,
+            "streaming": bool(eng.streaming),
+        }, sort_keys=True).encode()
+        docs = self.channel.allgather(doc, timeout_s=self.timeout_s)
+        for r, d in enumerate(docs):
+            if d != docs[0]:
+                raise RuntimeError(
+                    f"multihost config mismatch: rank {r} runs "
+                    f"{d.decode()!r} vs rank 0's {docs[0].decode()!r} — "
+                    f"the two-level reduction would not be bitwise")
+
+    # -- per-round pieces ----------------------------------------------------
+    def _block_inputs(self, round_idx: int, block: int, train_rng):
+        """(global ids, wmask, crngs) for one block — all pure functions
+        of (seed, round, block)."""
+        from fedml_tpu.parallel.engine import pad_ids
+        ids, wmask = pad_ids(self.sampler.sample_block(round_idx, block),
+                             self.engine.n_shards)
+        block_rng = jax.random.fold_in(train_rng, block)
+        crngs = np.asarray(jax.random.split(block_rng, len(ids)))
+        return ids, wmask, crngs
+
+    def _upload_range_stack(self):
+        """Resident mode: upload THIS process's population id range
+        once, sharded over the local mesh (device residency is
+        id-range-partitioned across hosts — the registry/shardstore
+        partition, applied to HBM)."""
+        if self._range_stack is not None:
+            return self._range_stack, self._range_stack_w
+        from fedml_tpu.parallel.mesh import (client_sharding, pad_cohort,
+                                             shard_stack)
+        eng = self.engine
+        lo, hi = self.range_lo, self.range_hi
+        shards = {k: np.asarray(v)[lo:hi]
+                  for k, v in eng._host_shards().items()}
+        weights = np.asarray(eng.data.client_num_samples,
+                             np.float32)[lo:hi]
+        shards, weights = pad_cohort(eng._cast_stack_x(shards), weights,
+                                     eng.n_shards)
+        eng.transfer_stats.add_h2d_bytes(
+            sum(np.asarray(v).nbytes for v in shards.values())
+            + weights.nbytes)
+        self._range_stack = shard_stack(eng.mesh, shards)
+        self._range_stack_w = jax.device_put(
+            weights.astype(np.float32), client_sharding(eng.mesh))
+        return self._range_stack, self._range_stack_w
+
+    def _gather_streaming(self, round_idx: int, train_rng):
+        """Host-gather + upload every OWNED block's cohort (the per-host
+        input pipeline; runs on the prefetch thread when pipelined)."""
+        out = []
+        for b in self.owned_blocks:
+            ids, wmask, crngs = self._block_inputs(round_idx, b,
+                                                   train_rng)
+            cohort, weights = self.engine._stream_gather(ids, wmask)
+            out.append((b, cohort, weights, crngs))
+        return out
+
+    def _partials_resident(self, variables, round_idx: int, train_rng):
+        eng = self.engine
+        stack, stack_w = self._upload_range_stack()
+        parts = {}
+        for b in self.owned_blocks:
+            ids, wmask, crngs = self._block_inputs(round_idx, b,
+                                                   train_rng)
+            local_ids = ids - self.range_lo
+            flat = eng._twolevel_partial_resident(
+                variables, stack, stack_w, jax.numpy.asarray(local_ids),
+                jax.numpy.asarray(wmask), jax.numpy.asarray(crngs))
+            parts[b] = np.asarray(flat, dtype=np.float32)
+        return parts
+
+    def _partials_streaming(self, variables, round_idx: int, train_rng,
+                            rng_base, rounds: int):
+        """Streaming partials with the per-host double-buffered
+        prefetch: round r+1's gather+upload runs on a background thread
+        while round r computes (parallel/prefetch.py AsyncValue — the
+        engines' own pipeline, reused per host)."""
+        from fedml_tpu.parallel.prefetch import AsyncValue
+        eng = self.engine
+        pre = self._prefetched
+        if pre is not None and pre[0] == round_idx:
+            blocks = pre[1].result()
+        else:
+            if pre is not None:
+                try:
+                    pre[1].result()
+                except Exception:
+                    log.warning("discarding failed stale multihost "
+                                "prefetch for round %d", pre[0],
+                                exc_info=True)
+            blocks = self._gather_streaming(round_idx, train_rng)
+        self._prefetched = None
+        if eng.prefetch and round_idx + 1 < rounds:
+            nxt_rng = jax.random.split(
+                jax.random.fold_in(rng_base, round_idx + 1))[0]
+            self._prefetched = (
+                round_idx + 1,
+                AsyncValue(self._gather_streaming, round_idx + 1,
+                           nxt_rng, stats=eng.transfer_stats))
+        parts = {}
+        for b, cohort, weights, crngs in blocks:
+            flat = eng._twolevel_partial(variables, cohort, weights,
+                                         jax.numpy.asarray(crngs))
+            parts[b] = np.asarray(flat, dtype=np.float32)
+        return parts
+
+    def _allreduce(self, parts: dict[int, np.ndarray]) -> np.ndarray:
+        """Inter-host carry allreduce: ship owned block partials (block
+        order, f32 LE), receive everyone's, fold in global block
+        order."""
+        payload = b"".join(parts[b].tobytes()
+                           for b in sorted(parts))
+        rx0 = self.channel.bytes_received
+        docs = self.channel.allgather(payload, timeout_s=self.timeout_s)
+        self.carry_bytes.append(self.channel.bytes_received - rx0)
+        world = self.ctx.world
+        bpp = self.n_blocks // world
+        dim = next(iter(parts.values())).size
+        all_parts: dict[int, np.ndarray] = {}
+        for r, doc in enumerate(docs):
+            if len(doc) != bpp * dim * 4:
+                raise DeadRankError(
+                    f"two-level allreduce: rank {r} shipped "
+                    f"{len(doc)} bytes, expected {bpp * dim * 4} "
+                    f"({bpp} blocks x {dim} f32) — config skew or a "
+                    f"truncated frame")
+            vecs = np.frombuffer(doc, dtype="<f4").reshape(bpp, dim)
+            for j in range(bpp):
+                all_parts[r * bpp + j] = vecs[j]
+        return fold_block_partials(all_parts, self.n_blocks)
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, variables=None, rounds: Optional[int] = None,
+            logger=None):
+        """Drive `rounds` two-level rounds; returns the trained
+        variables (identical bits on every process).  Only rank 0
+        appends metrics_history/logs — peers compute the same values
+        anyway."""
+        eng = self.engine
+        cfg = eng.cfg
+        rounds = rounds if rounds is not None else cfg.comm_round
+        if variables is None:
+            variables = eng.init_variables()
+        variables = eng._prepare_variables(variables)
+        server_state = eng._prepare_server_state(
+            eng.server_init(variables))
+        rng_base = jax.random.PRNGKey(cfg.seed + 1)
+        self._handshake()
+        try:
+            for round_idx in range(rounds):
+                t0 = time.perf_counter()
+                round_rng = jax.random.fold_in(rng_base, round_idx)
+                train_rng, agg_rng = jax.random.split(round_rng)
+                with obs.span("round.twolevel", round=round_idx,
+                              rank=self.ctx.rank,
+                              blocks=len(self.owned_blocks)):
+                    if eng.streaming:
+                        parts = self._partials_streaming(
+                            variables, round_idx, train_rng, rng_base,
+                            rounds)
+                    else:
+                        parts = self._partials_resident(
+                            variables, round_idx, train_rng)
+                    with obs.span("multihost.allreduce",
+                                  round=round_idx):
+                        total = self._allreduce(parts)
+                    variables, server_state, m = eng._twolevel_commit(
+                        variables, server_state,
+                        jax.numpy.asarray(total), agg_rng)
+                jax.block_until_ready(variables)
+                self.round_walls.append(time.perf_counter() - t0)
+                self.channel.export_byte_counters()
+                if self.ctx.rank == 0 and (
+                        round_idx % cfg.frequency_of_the_test == 0
+                        or round_idx == rounds - 1):
+                    stats = eng.evaluate(variables)
+                    stats.update(round=round_idx,
+                                 train_loss=float(m["train_loss"]),
+                                 round_time=self.round_walls[-1])
+                    eng.metrics_history.append(stats)
+                    if logger is not None:
+                        logger.log(stats, step=round_idx)
+                    log.info("round %d: %s", round_idx, stats)
+                if self.on_round_end is not None:
+                    self.on_round_end(round_idx)
+        except Exception as e:
+            obs.dump_flight(f"multihost_error:rank{self.ctx.rank}: "
+                            f"{e!r}")
+            raise
+        finally:
+            pre, self._prefetched = self._prefetched, None
+            if pre is not None:
+                try:
+                    pre[1].result()
+                except Exception:
+                    pass
+        self._rollup_metrics()
+        return variables
+
+    def _rollup_metrics(self) -> None:
+        """Ship every rank's metric deltas to rank 0 and fold them under
+        origin="host<i>" (the PR-7 remote-fold shape): an N-process run
+        keeps per-process series instead of last-writer-wins gauges,
+        and programs.report() gains its per-process breakdown rows from
+        exactly these merged series.  The shipped delta is SINCE THE
+        LAST ROLLUP in this process (baseline threaded like the PR-7
+        uplink piggyback), so back-to-back runners — mh_worker's
+        streaming-then-resident pair — don't re-ship and double-count
+        the earlier run's counters."""
+        if self.ctx.world <= 1:
+            return
+        try:
+            delta = _delta_since_last_rollup()
+            docs = self.channel.allgather(
+                json.dumps(delta).encode(), timeout_s=self.timeout_s)
+            if self.ctx.rank == 0:
+                for r, doc in enumerate(docs):
+                    if r == 0 or not doc:
+                        continue
+                    obs.registry().merge_delta(json.loads(doc.decode()),
+                                               origin=f"host{r}")
+        except DeadRankError:
+            raise
+        except Exception:
+            log.warning("multihost metrics rollup failed", exc_info=True)
+
+    def report(self, warmup_rounds: int = 0) -> dict:
+        """Timing/byte rollup over the rounds run so far (warmup rounds
+        excluded from the rate)."""
+        walls = self.round_walls[warmup_rounds:]
+        carry = self.carry_bytes[warmup_rounds:] or [0]
+        return {
+            "rank": self.ctx.rank,
+            "world": self.ctx.world,
+            "n_blocks": self.n_blocks,
+            "rounds": len(self.round_walls),
+            "rounds_per_sec": (len(walls) / sum(walls)
+                               if walls and sum(walls) > 0 else 0.0),
+            "round_wall_p50_s": (float(np.median(walls))
+                                 if walls else 0.0),
+            "carry_allreduce_bytes_per_round": float(np.mean(carry)),
+            # sum of the per-round deltas, NOT channel.bytes_received:
+            # the channel also carries handshake/rollup frames and (in
+            # mh_worker) a sibling runner's traffic
+            "carry_allreduce_bytes_total": int(sum(self.carry_bytes)),
+        }
+
+    def close(self) -> None:
+        if self._channel is not None and self._owns_channel:
+            self._channel.close()
+            self._channel = None
+
+
+def variables_digest(variables) -> str:
+    """md5 over the raw bytes of every leaf (deterministic leaf order)
+    — THE bitwise-equality digest of the multihost pins."""
+    h = hashlib.md5()
+    for leaf in jax.tree.leaves(variables):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()

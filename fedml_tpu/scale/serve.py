@@ -64,10 +64,25 @@ def run_serve_sim(population: int, *, commits: int = 30,
                   sampler_mode: str = "stratified",
                   arrival: Optional[ArrivalConfig] = None,
                   dropout_prob: float = 0.0, banned_frac: float = 0.0,
-                  seed: int = 0) -> dict:
+                  seed: int = 0, partition: tuple = (0, 1),
+                  channel=None) -> dict:
     """Drive `commits` streaming commits at `population` simulated
     clients; returns the serve report (committed-updates/sec, registry
-    memory, RSS, virtual-time stats)."""
+    memory, RSS, virtual-time stats).
+
+    Host-sharded mode (ISSUE 13): `partition=(rank, world)` makes this
+    process own ONLY its client-id range of the population — its
+    registry shards, sampler and in-flight ring cover population/world
+    clients (the PR-10 id-range partition, executed across processes).
+    Each commit folds the partial streaming aggregates upward: the
+    local (acc, wsum) allgathers over `channel`
+    (parallel/multihost.py HostChannel), every rank sums the P-sized
+    partials in RANK ORDER (deterministic — the two-level fold
+    contract), and the identical commit applies everywhere — the
+    report's `committed_digest` must agree across ranks.  Commit
+    cadence is the synchronization point: every rank performs exactly
+    `commits` commits, so the allgathers pair up; a dead rank raises
+    the channel's DeadRankError naming it."""
     import jax.numpy as jnp
     from fedml_tpu.async_.staleness import (AsyncBuffer,
                                             make_stream_commit_fn)
@@ -75,19 +90,34 @@ def run_serve_sim(population: int, *, commits: int = 30,
     if commits <= warmup_commits:
         raise ValueError(f"commits ({commits}) must exceed "
                          f"warmup_commits ({warmup_commits})")
+    rank, world = int(partition[0]), int(partition[1])
+    if not 0 <= rank < world:
+        raise ValueError(f"partition rank {rank} outside world {world}")
+    if world > 1 and channel is None:
+        raise ValueError("world > 1 needs a HostChannel to fold the "
+                         "partial aggregates upward")
+    # this process's client-id range [lo, hi): registry/sampler/ring
+    # are all range-local — nothing population-sized is shared
+    lo = rank * population // world
+    hi = (rank + 1) * population // world
+    local_population = hi - lo
     concurrency = (concurrency if concurrency is not None
                    else 4 * buffer_k)
     arrival = arrival if arrival is not None else ArrivalConfig(
         mode="constant", rate=1000.0, seed=seed)
     proc: Optional[ArrivalProcess] = make_arrivals(arrival)
 
-    registry = ClientRegistry(population)
-    rng = np.random.default_rng([seed, 2])
+    registry = ClientRegistry(local_population)
+    # per-rank streams when sharded (each range's bans/dropouts/rows
+    # are its own); the world==1 streams stay EXACTLY the pre-partition
+    # ones so every existing seeded trace/pin is unchanged
+    rng = np.random.default_rng(
+        [seed, 2] if world == 1 else [seed, 2, rank])
     if banned_frac > 0.0:
         # seeded ineligibility (defense bans / opted-out devices): the
         # sampler must route around these forever
-        n_ban = max(1, int(banned_frac * population))
-        registry.ban(np.unique(rng.integers(0, population,
+        n_ban = max(1, int(banned_frac * local_population))
+        registry.ban(np.unique(rng.integers(0, local_population,
                                             size=2 * n_ban))[:n_ban])
     sampler = StreamingCohortSampler(registry, buffer_k, seed=seed,
                                      mode=sampler_mode)
@@ -100,7 +130,8 @@ def run_serve_sim(population: int, *, commits: int = 30,
     # rotating pre-generated row pool: the fold reads realistic floats
     # without paying a per-arrival P-sized RNG draw
     pool = rng.standard_normal((64, row_dim)).astype(np.float32)
-    drop_rng = np.random.default_rng([seed, 3])
+    drop_rng = np.random.default_rng(
+        [seed, 3] if world == 1 else [seed, 3, rank])
 
     # in-flight FIFO as a numpy ring — ids only; the registry's
     # `outstanding` field carries the dispatched version
@@ -119,7 +150,9 @@ def run_serve_sim(population: int, *, commits: int = 30,
     #                  top-ups
     rejoin_at_commit: list[np.ndarray] = []
     arr_iter = (proc.arrivals(0.0, np.random.default_rng(
-        [arrival.seed, seed, 1])) if proc is not None else None)
+        [arrival.seed, seed, 1] if world == 1
+        else [arrival.seed, seed, 1, rank]))
+        if proc is not None else None)
     now = 0.0
     t_wall0 = time.perf_counter()
     t_timed = None
@@ -169,9 +202,29 @@ def run_serve_sim(population: int, *, commits: int = 30,
                 admitted += 1
                 if full:
                     with obs.span("serve.commit", version=version,
-                                  t_virtual=round(now, 3)):
+                                  t_virtual=round(now, 3),
+                                  rank=rank):
                         acc, wsum, _w, _s, n_commit, _raw = \
                             buffer.take_stream()
+                        if world > 1:
+                            # fold the partial aggregates upward: every
+                            # rank ships its local (acc, wsum), sums in
+                            # RANK ORDER (deterministic), commits the
+                            # identical global mix
+                            payload = (np.float32(wsum).tobytes()
+                                       + np.asarray(acc, np.float32)
+                                       .tobytes())
+                            docs = channel.allgather(payload)
+                            t_wsum = np.float32(0.0)
+                            t_acc = np.zeros(row_dim, np.float32)
+                            for d in docs:
+                                t_wsum = np.float32(
+                                    t_wsum + np.frombuffer(
+                                        d, "<f4", count=1)[0])
+                                t_acc += np.frombuffer(d, "<f4",
+                                                       offset=4)
+                            acc = jnp.asarray(t_acc)
+                            wsum = jnp.float32(t_wsum)
                         variables, _stats = commit_fn(
                             variables, acc, wsum, jnp.float32(1.0))
                     # ISSUE 12: the SLO pack's committed-updates floor
@@ -200,8 +253,18 @@ def run_serve_sim(population: int, *, commits: int = 30,
         part = sh["participation"]
         distinct += int(np.count_nonzero(part))
         max_part = max(max_part, int(part.max()) if part.size else 0)
+    from fedml_tpu.parallel.multihost import variables_digest
     return {
         "population": int(population),
+        "local_population": int(local_population),
+        "partition": [rank, world],
+        # the cross-rank agreement pin: host-sharded serve commits the
+        # same global mix on every rank (THE one bitwise digest,
+        # shared with the multihost pins)
+        "committed_digest": variables_digest(variables),
+        "carry_allreduce_bytes": int(getattr(channel, "bytes_received",
+                                             0) if channel is not None
+                                     else 0),
         "commits": int(version),
         "committed_updates": int(admitted),
         "distinct_contributors": distinct,

@@ -304,6 +304,49 @@ def test_programs_census_from_audit_artifact(clean_obs):
     assert fam.census_source == "hlo_copy_audit"
 
 
+def test_programs_report_per_process_breakdown(clean_obs):
+    """ISSUE 13: a multihost run folds each rank's metric deltas into
+    rank 0's registry under origin="host<i>" (the PR-7 remote-fold
+    shape); programs.report() surfaces those merged series as
+    per-process breakdown rows — so an N-process run's per-rank
+    dispatch counts/walls are visible instead of last-writer-wins."""
+    from fedml_tpu.obs.metrics import CANONICAL_BUCKETS
+    reg = obs.registry()
+    # a local dispatch too, so local rows and process rows coexist
+    import jax
+    prog = programs.instrument("fedavg_twolevel",
+                               jax.jit(lambda x: x + 1))
+    prog(1.0)
+    ladder = list(CANONICAL_BUCKETS["program_dispatch_seconds"])
+    counts = [0] * (len(ladder) + 1)
+    counts[6] = 3                      # three sub-ms dispatches
+    delta = {"schema": 1, "metrics": [
+        {"name": "program_dispatches_total",
+         "labels": {"family": "fedavg_twolevel"}, "kind": "counter",
+         "value": 3},
+        {"name": "program_dispatch_seconds",
+         "labels": {"family": "fedavg_twolevel"}, "kind": "histogram",
+         "buckets": ladder, "counts": counts, "sum": 0.0015,
+         "count": 3},
+    ]}
+    reg.merge_delta(delta, origin="host1")
+    rep = programs.report()
+    assert any(r["family"] == "fedavg_twolevel"
+               for r in rep["families"]), "local row lost"
+    procs = rep["processes"]
+    assert len(procs) == 1
+    row = procs[0]
+    assert row["family"] == "fedavg_twolevel"
+    assert row["process"] == "host1"
+    assert row["dispatches"] == 3
+    assert row["dispatch_wall_s"] == pytest.approx(0.0015)
+    assert row["dispatch_p95_s"] > 0
+    # the merged series must NOT double into the local family rows
+    local = [r for r in rep["families"]
+             if r["family"] == "fedavg_twolevel"]
+    assert local[0]["dispatches"] == 1
+
+
 def test_engine_round_dispatches_profiled(clean_obs):
     """The sync engine's round program books its dispatches under the
     engine's program family (the ISSUE-12 acceptance table's sync-engine

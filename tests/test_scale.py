@@ -621,6 +621,61 @@ def test_serve_exhausted_trace_names_the_problem(tmp_path):
                                             trace_path=str(p)))
 
 
+def test_serve_host_sharded_partition_commits_identically():
+    """ISSUE 13: the serve loop sharded across two ranks by client-id
+    range — each rank owns HALF the population's registry shards,
+    samples/folds its own range, and the commit folds the partial
+    aggregates upward over the HostChannel (rank-ordered sum).  Both
+    ranks must commit the IDENTICAL global mix (committed_digest), and
+    each rank's registry holds only its range."""
+    import threading
+
+    from fedml_tpu.parallel.multihost import (HostChannel,
+                                              MultihostContext,
+                                              free_port)
+    port = free_port()
+    pop = 4096
+    reports: dict = {}
+    errs: list = []
+
+    def rank(r):
+        try:
+            ctx = MultihostContext(rank=r, world=2,
+                                   coordinator=f"localhost:{port}")
+            ch = HostChannel(ctx, timeout_s=60, connect_timeout_s=30)
+            try:
+                reports[r] = run_serve_sim(
+                    pop, commits=4, warmup_commits=1, buffer_k=8,
+                    row_dim=64,
+                    arrival=ArrivalConfig(mode="constant", rate=500.0,
+                                          seed=0),
+                    seed=0, partition=(r, 2), channel=ch)
+            finally:
+                ch.close()
+        except Exception as e:          # surfaced below, never hangs
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=rank, args=(r,)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=180)
+    assert not errs, errs
+    assert set(reports) == {0, 1}
+    a, b = reports[0], reports[1]
+    assert a["committed_digest"] == b["committed_digest"], (
+        "host-sharded serve ranks committed different global mixes")
+    assert a["local_population"] == b["local_population"] == pop // 2
+    assert a["partition"] == [0, 2] and b["partition"] == [1, 2]
+    # the partial aggregates really crossed ranks
+    assert a["carry_allreduce_bytes"] > 0
+    assert b["carry_allreduce_bytes"] > 0
+    # world > 1 without a channel is a loud error
+    with pytest.raises(ValueError, match="HostChannel"):
+        run_serve_sim(100, commits=2, warmup_commits=1,
+                      partition=(0, 2))
+
+
 def test_serve_uniform_sampler_not_low_id_biased():
     """The legacy uniform draw is prefix-stable in k at a fixed round;
     the serve loop must advance the sampler round per DRAW, or every

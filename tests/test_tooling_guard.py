@@ -440,9 +440,9 @@ def test_chip_queue_carries_conn_step():
     assert "profile_bench.py CONN" in src, (
         "run_chip_queue.sh lost the CONN live-connection reactor step "
         "(ISSUE 11 queues it for the next chip window)")
-    assert "13/14" in src, (
-        "run_chip_queue.sh lost the CONN step numbering (13/14 since "
-        "ISSUE 12 appended the bench_diff step)")
+    assert "13/15" in src, (
+        "run_chip_queue.sh lost the CONN step numbering (13/15 since "
+        "ISSUE 12 appended bench_diff and ISSUE 13 exp_POD)")
     assert "exp_CONN" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
@@ -491,6 +491,69 @@ def test_bench_json_schema_v11_carries_slo_and_programs_blocks():
             "block reads it")
 
 
+def test_bench_json_schema_v12_carries_multihost_block():
+    """ISSUE 13: schema v12 adds the multihost weak-scaling block — the
+    two-level-aggregation sweep fields (rows per process count with
+    rounds/sec + carry-allreduce bytes, weak_efficiency_2p and the
+    bitwise_2proc_ok pin) — and the machinery it runs on (the
+    spawn_cluster launcher, the mh_worker entry, the HostChannel).
+    Static source check like the v3-v11 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 12, (
+        "bench schema must stay >= v12 (multihost weak-scaling block)")
+    for field in ('"multihost"', "_bench_multihost",
+                  "weak_efficiency_2p", "bitwise_2proc_ok",
+                  "carry_allreduce_bytes_per_round", "spawn_cluster"):
+        assert field in src, (
+            f"bench.py lost the v12 multihost field {field} "
+            "(see fedml_tpu/parallel/multihost.py)")
+    base = os.path.join(os.path.dirname(__file__), "..")
+    # the runtime pieces the mode drives must exist
+    for path in (os.path.join("fedml_tpu", "parallel", "mh_worker.py"),
+                 os.path.join("tools", "launch_multihost.py")):
+        assert os.path.exists(os.path.join(base, path)), (
+            f"{path} (the ISSUE-13 multihost runtime) is gone")
+    mh = open(os.path.join(base, "fedml_tpu", "parallel",
+                           "multihost.py")).read()
+    for sym in ("class HostChannel", "class MultihostRunner",
+                "class DeadRankError", "def fold_block_partials",
+                "def spawn_cluster"):
+        assert sym in mh, (
+            f"fedml_tpu/parallel/multihost.py lost {sym!r} — the "
+            "two-level runtime the v12 bench mode drives")
+    # bench_diff must judge the new block
+    bd = open(os.path.join(base, "tools", "bench_diff.py")).read()
+    for field in ("weak_efficiency_2p", '"multihost"'):
+        assert field in bd, (
+            f"tools/bench_diff.py lost the multihost rule field "
+            f"{field} (the v12 acceptance gate)")
+
+
+def test_chip_queue_carries_pod_step():
+    """ISSUE 13: the next chip window must price the multi-host
+    weak-scaling sweep on a real pod slice —
+    scripts/run_chip_queue.sh carries the POD step (15/15) and
+    profile_bench.py defines the exp_POD experiment it runs."""
+    queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "run_chip_queue.sh")
+    src = open(queue).read()
+    assert "profile_bench.py POD" in src, (
+        "run_chip_queue.sh lost the POD multi-host weak-scaling sweep "
+        "(ISSUE 13 queues it for the next chip window)")
+    assert "15/15" in src, (
+        "run_chip_queue.sh lost the 15/15 step numbering (exp_POD is "
+        "queue step 15)")
+    assert "exp_POD" in open(os.path.join(
+        os.path.dirname(__file__), "..", "tools",
+        "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_POD experiment the queue runs")
+    import subprocess
+    r = subprocess.run(["bash", "-n", queue], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+
+
 def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
     """ISSUE 12: tools/bench_diff.py must exist, exit 0 on a
     self-compare of the committed baseline, and exit nonzero NAMING the
@@ -528,9 +591,9 @@ def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
 
 
 def test_chip_queue_carries_bench_diff_step():
-    """ISSUE 12: the chip queue's last step judges the fresh bench
-    record against the committed trajectory (14/14), and the script
-    stays shell-valid."""
+    """ISSUE 12: the chip queue's judgment pass diffs the fresh bench
+    record against the committed trajectory (step 14/15 since ISSUE 13
+    appended exp_POD as 15), and the script stays shell-valid."""
     import subprocess
     queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
                          "run_chip_queue.sh")
@@ -538,9 +601,10 @@ def test_chip_queue_carries_bench_diff_step():
     assert "bench_diff.py" in src, (
         "run_chip_queue.sh lost the bench_diff regression step "
         "(ISSUE 12 appends it as the queue's judgment pass)")
-    assert "14/14" in src, (
-        "run_chip_queue.sh lost the 14/14 step numbering — bench_diff "
-        "must be the queue's last step")
+    assert "14/15" in src, (
+        "run_chip_queue.sh lost the 14/15 bench_diff step numbering "
+        "(the judgment pass rides right after the bench artifacts; "
+        "exp_POD is 15)")
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr

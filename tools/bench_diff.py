@@ -52,7 +52,7 @@ import os
 import sys
 from typing import Optional
 
-SCHEMA_MIN, SCHEMA_MAX = 2, 11
+SCHEMA_MIN, SCHEMA_MAX = 2, 12
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +165,20 @@ def prune(doc: dict) -> dict:
             f["registry_bytes_per_client"] = max(
                 p.get("registry_bytes_per_client", 0.0) for p in pops)
         f["sublinear_ok"] = s.get("sublinear_ok")
+    elif mode == "multihost":
+        m = doc.get("multihost") or {}
+        f["headline_rounds_per_sec"] = doc.get("value")
+        f["weak_efficiency_2p"] = m.get("weak_efficiency_2p")
+        f["weak_efficiency_4p"] = m.get("weak_efficiency_4p")
+        f["bitwise_2proc_ok"] = m.get("bitwise_2proc_ok")
+        f["process_deaths"] = m.get("process_deaths")
+        for row in m.get("rows") or []:
+            n = row.get("procs")
+            if row.get("rounds_per_sec") is not None:
+                f[f"rounds_per_sec[procs={n}]"] = row["rounds_per_sec"]
+            if row.get("carry_allreduce_bytes_per_round") is not None:
+                f[f"carry_bytes_per_round[procs={n}]"] = \
+                    row["carry_allreduce_bytes_per_round"]
     elif mode == "connections":
         c = doc.get("connections") or {}
         deaths, leaks = 0.0, 0.0
@@ -269,6 +283,24 @@ RULES: dict[tuple, Rule] = {
     # encoded once.
     ("connections", "recv_thread_deaths"): Rule(-1, 0.0, gate_max=0.0),
     ("connections", "fd_leaked"): Rule(-1, 0.0, gate_max=0.0),
+    # -- multihost (ISSUE 13): weak scaling on the 2-core box pays the
+    # GIL (every process's jit fights for two cores) + loopback-TCP
+    # carry — the same 65% noise class as the other process-contended
+    # rates.  The 0.5x-at-2-processes gate is the documented floor; the
+    # honest ICI/DCN ratio rides exp_POD on a real pod slice.
+    ("multihost", "headline_rounds_per_sec"): Rule(+1, 0.65,
+                                                   note="GIL/loopback "
+                                                        "noise band"),
+    ("multihost", "weak_efficiency_2p"): Rule(+1, 0.65, gate_min=0.5,
+                                              note="ISSUE-13 >=0.5x "
+                                                   "2-core floor; chip "
+                                                   "gate via exp_POD"),
+    ("multihost", "weak_efficiency_4p"): Rule(0,
+                                              note="2-core box: 4 procs "
+                                                   "oversubscribe — "
+                                                   "informational"),
+    ("multihost", "process_deaths"): Rule(-1, 0.0, gate_max=0.0,
+                                          note="zero-deaths gate"),
 }
 # pattern rules for the per-count connection fields
 PATTERN_RULES: list[tuple] = [
@@ -277,6 +309,10 @@ PATTERN_RULES: list[tuple] = [
           note="ISSUE-11 >=0.5x gate; 0.75-2.7x repeat spread")),
     ("connections", "clean_updates_per_sec[",
      Rule(+1, 0.65, note="GIL-noise band")),
+    ("multihost", "rounds_per_sec[",
+     Rule(+1, 0.65, note="GIL/loopback noise band")),
+    ("multihost", "carry_bytes_per_round[",
+     Rule(0, note="deterministic per topology; informational")),
 ]
 # v11 slo block: clean arms must stay breach-free in EVERY mode
 SLO_RULE = Rule(-1, 0.0, gate_max=0.0,

@@ -195,9 +195,13 @@ def build_family_programs(donate: bool = True,
         # the per-client eval program rides the resident stack (the
         # eval-stack path: _upload_eval_stack placement + vmapped
         # trainer.evaluate) — audited so eval regressions land here too
+        # bind the engine at definition (default arg): `eng` is rebound
+        # by every later family block, and the jit only traces at AUDIT
+        # time — a late-bound closure would evaluate against whichever
+        # engine happened to be last (its _x_image_shape state included)
         local_eval = jax.jit(jax.vmap(
-            lambda vv, s: eng.trainer.evaluate(
-                vv, eng._local_eval_transform(s)), in_axes=(None, 0)))
+            lambda vv, s, _eng=eng: _eng.trainer.evaluate(
+                vv, _eng._local_eval_transform(s)), in_axes=(None, 0)))
         out["fedavg_resident"] = [
             ("round", eng.round_fn,
              (v, ss, stack, stack_w, ids, wmask, rng)),
@@ -289,6 +293,41 @@ def build_family_programs(donate: bool = True,
         out["gossip"] = [
             ("round", eng.round_fn, (wv, stack, stack_w, rng))]
 
+    if want("twolevel_commit"):
+        # the ISSUE-13 two-level multihost aggregation commit: the
+        # globally-folded flat f32 carry (the vector that crossed
+        # hosts) unflattens, divides, and applies the server update —
+        # replicated, O(P), pinned at 0 copy ops with variables +
+        # server_state donated (the per-block PARTIAL bodies reuse the
+        # streaming round's chunk-scan structure and are covered by the
+        # fedavg_* ceilings)
+        from fedml_tpu.parallel import MeshFedOptEngine
+        from fedml_tpu.parallel.engine import flatten_carry_f32
+        eng = MeshFedAvgEngine(trainer, data, cfg, mesh=mesh,
+                               donate=donate)
+        v, ss = _vars(eng)
+        eng._ensure_twolevel()
+        flat0, _ = flatten_carry_f32(eng._zero_sums(v))
+        flat = jax.device_put(np.zeros(flat0.shape, np.float32),
+                              replicated_sharding(mesh))
+        # FedAvg's commit REPLACES the global model, so its donated
+        # variables are dead (nothing to alias); FedOpt's commit reads
+        # them (pseudo-gradient) and carries adam moments — the alias
+        # floor of the family comes from this program
+        cfg_opt = type(cfg)(**{**cfg.__dict__,
+                               "server_optimizer": "adam",
+                               "server_lr": 0.05})
+        engo = MeshFedOptEngine(trainer, data, cfg_opt, mesh=mesh,
+                                donate=donate)
+        vo, sso = _vars(engo)
+        engo._ensure_twolevel()
+        flato = jax.device_put(np.zeros(flat0.shape, np.float32),
+                               replicated_sharding(mesh))
+        out["twolevel_commit"] = [
+            ("commit", eng._twolevel_commit, (v, ss, flat, rng)),
+            ("commit_fedopt", engo._twolevel_commit,
+             (vo, sso, flato, rng))]
+
     if want("async_commit"):
         # the async federation's staleness-discounted commit program
         # (fedml_tpu/async_/staleness.py): donated variables + a flat
@@ -351,7 +390,8 @@ def build_family_programs(donate: bool = True,
 ALL_FAMILIES = ("fedavg_resident", "fedavg_streaming", "fedavg_blockstream",
                 "fednova_resident", "robust_orderstat", "robust_blockstream",
                 "hierarchical", "gossip", "async_commit",
-                "async_stream_commit", "async_bucket_commit")
+                "async_stream_commit", "async_bucket_commit",
+                "twolevel_commit")
 
 
 def audit_families(families: list[str] | None = None,

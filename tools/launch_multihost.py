@@ -1,0 +1,83 @@
+"""Multi-process multihost launcher (ISSUE 13).
+
+Forks N copies of a worker command wired as one multihost cluster: each
+rank gets FEDML_MH_RANK / FEDML_MH_WORLD / FEDML_MH_COORD (the
+HostChannel coordinator rank 0 binds) and — with --jax-distributed —
+FEDML_MH_JAX_COORD so the workers join one jax runtime via
+init_multihost (on TPU pods that is what makes each host's chips
+visible; on the CPU dev box the HostChannel alone carries the
+cross-host tier, so it is optional).  Replaces the reference's
+`mpirun -np N -hostfile ...` bootstrap for the single-box dev case —
+a real pod launches one process per host through its own runner and
+sets the same env.
+
+    python tools/launch_multihost.py --procs 2 -- \
+        python -m fedml_tpu.parallel.mh_worker cfg.json
+
+    python tools/launch_multihost.py --procs 4 --timeout 900 -- \
+        python -m fedml_tpu.cli --mesh --algorithm fedavg ...
+
+Failure policy (spawn_cluster): the first rank to exit nonzero kills
+the rest and the launcher exits nonzero NAMING that rank with its
+stderr tail; a --timeout overrun names the ranks still running.
+Child stderr streams through line-prefixed (`[rank i]`); child stdout
+is echoed after completion in rank order (machine-readable lines stay
+contiguous per rank).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--procs", type=int, required=True,
+                    help="process count (one per simulated host)")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="whole-cluster wall deadline in seconds")
+    ap.add_argument("--jax-distributed", action="store_true",
+                    help="also wire jax.distributed (FEDML_MH_JAX_COORD; "
+                         "required on real pods, optional on CPU where "
+                         "the HostChannel carries the cross-host tier)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="worker command (prefix with --)")
+    args = ap.parse_args(argv)
+    # validation BEFORE the jax-heavy spawn import: bad args must fail
+    # in milliseconds (tests/test_multihost_spmd.py pins this)
+    if args.procs < 1:
+        ap.error(f"--procs must be >= 1, got {args.procs}")
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("missing worker command (append it after --, e.g. "
+                 "`-- python -m fedml_tpu.parallel.mh_worker cfg.json`)")
+    if args.timeout <= 0:
+        ap.error(f"--timeout must be > 0, got {args.timeout}")
+    args.cmd = cmd
+    return args
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from fedml_tpu.parallel.multihost import (MultihostLaunchError,
+                                              spawn_cluster)
+    try:
+        outs = spawn_cluster(args.cmd, args.procs,
+                             timeout_s=args.timeout,
+                             jax_distributed=args.jax_distributed,
+                             echo=True)
+    except MultihostLaunchError as e:
+        print(f"launch_multihost: {e}", file=sys.stderr)
+        return 1
+    for r, out in enumerate(outs):
+        for line in out.splitlines():
+            print(f"[rank {r}] {line}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1180,6 +1180,33 @@ def exp_CONN():
                   f"{r['recv_thread_deaths']:.0f}", flush=True)
 
 
+def exp_POD():
+    """Multi-host weak-scaling sweep (ISSUE 13): the chip-side rerun of
+    `bench.py --mode multihost` — N processes (one per host/slice on a
+    real pod; FEDML_POD_PROCS overrides the 1,2,4 default), each
+    training its client block on its LOCAL chips with the intra-slice
+    psum on ICI, the P-sized flat f32 carry allreduced across
+    processes over the HostChannel (DCN).  Gates: the 1-vs-2-process
+    same-block-partition commit digests bitwise equal, zero process
+    deaths, and weak-scaling efficiency at 2 processes — the 2-core
+    CPU floor is 0.5x; on a pod slice each process owns real chips, so
+    the measured point prices the DCN carry tier for the v4-128
+    projection."""
+    import subprocess
+    procs = os.environ.get("FEDML_POD_PROCS", "1,2,4")
+    bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "bench.py")
+    r = subprocess.run(
+        [sys.executable, bench, "--mode", "multihost",
+         "--mh_procs", procs],
+        text=True, capture_output=True, timeout=3600)
+    sys.stderr.write(r.stderr)
+    print(r.stdout, flush=True)
+    if r.returncode != 0:
+        raise SystemExit(f"exp_POD: bench.py --mode multihost failed "
+                         f"(rc={r.returncode})")
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
