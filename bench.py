@@ -27,6 +27,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -116,6 +117,21 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     box — exp_POD prices it on a real pod slice) and
 #     bitwise_2proc_ok (the 1-vs-2-process same-block-partition digest
 #     pin); null in other modes, so v11 readers keep working
+# v13: the "multihost" block gains the elastic "chaos" arm (ISSUE 14 —
+#     ElasticChannel/ElasticRunner in fedml_tpu/parallel/multihost.py):
+#     a 3-process ELASTIC cluster with a seeded kill of rank 1 mid-run
+#     vs the clean elastic same-partition run — survivor_goodput_ratio
+#     (killed/clean rounds/sec, >= 0.5x gate), view_changes +
+#     view_change_latency_s (death detection -> survivors re-tasked),
+#     survivor_deaths (must be 0 — only the killed rank dies),
+#     epoch_final, and bitwise_after_death_ok (the killed run's commit
+#     digests byte-identical to the clean run's, FedAvg resident AND
+#     streaming — the re-adopted blocks are pure functions of [seed,
+#     round, block], so the fold is topology-independent); plus
+#     elastic_fail_fast_default_ok (fail-fast stays the default policy:
+#     the weak-scaling arms above still run non-elastic).  --mh_arms
+#     selects weak/bitwise/chaos subsets; v12 readers that ignore
+#     unknown keys keep working
 # v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
 #     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
 #     attack x defense arms on the async MNIST-LR workload (each row:
@@ -128,7 +144,7 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 
 
 # the programs block's window opens when main() configures obs (set
@@ -414,6 +430,17 @@ def main() -> None:
     ap.add_argument("--mh_seed", type=int, default=0,
                     help="multihost mode: workload seed (same seed = "
                          "same cohorts = the bitwise pin's premise)")
+    ap.add_argument("--mh_arms", default="weak,bitwise,chaos",
+                    help="multihost mode: comma-subset of "
+                         "{weak,bitwise,chaos} — weak = the v12 "
+                         "weak-scaling sweep, bitwise = the "
+                         "1p-vs-2p digest pin, chaos = the v13 elastic "
+                         "kill-a-rank arm (survivor goodput + "
+                         "bitwise_after_death_ok)")
+    ap.add_argument("--mh_chaos_procs", type=int, default=3,
+                    help="multihost chaos arm: elastic cluster size "
+                         "(rank 1 is killed mid-run; the survivors "
+                         "must finish)")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -1390,7 +1417,7 @@ def _bench_multihost(args) -> None:
 
     from fedml_tpu import obs
     from fedml_tpu.parallel.multihost import (MultihostLaunchError,
-                                              spawn_cluster)
+                                              spawn_cluster_report)
 
     procs_list = sorted({int(p) for p in str(args.mh_procs).split(",")
                          if p.strip()})
@@ -1400,10 +1427,21 @@ def _bench_multihost(args) -> None:
     if args.mh_rounds <= args.mh_warmup:
         raise SystemExit(f"--mh_rounds ({args.mh_rounds}) must exceed "
                          f"--mh_warmup ({args.mh_warmup})")
+    arms = {a.strip() for a in str(args.mh_arms).split(",") if a.strip()}
+    bad_arms = arms - {"weak", "bitwise", "chaos"}
+    if bad_arms or not arms:
+        raise SystemExit(f"--mh_arms must be a non-empty subset of "
+                         f"weak,bitwise,chaos; got {args.mh_arms!r}")
+    if args.mh_chaos_procs < 2:
+        raise SystemExit(f"--mh_chaos_procs must be >= 2 (someone has "
+                         f"to die AND someone has to survive), got "
+                         f"{args.mh_chaos_procs}")
 
-    def run_arm(procs: int, n_blocks: int, rounds: int,
-                modes: list) -> dict:
-        """Spawn one cluster; returns {rank: worker JSON doc}."""
+    def run_arm(procs: int, n_blocks: int, rounds: int, modes: list,
+                extra_cfg: Optional[dict] = None, elastic: bool = False,
+                expect_ranks: Optional[set] = None) -> tuple:
+        """Spawn one cluster; returns ({rank: worker JSON doc},
+        per-rank outcome report from spawn_cluster_report)."""
         cfg = {
             "clients": args.mh_clients_per_block * n_blocks,
             "spc": 24, "dim": args.mh_dim, "classes": 10,
@@ -1411,15 +1449,16 @@ def _bench_multihost(args) -> None:
             "n_blocks": n_blocks, "rounds": rounds,
             "warmup": args.mh_warmup, "seed": args.mh_seed,
             "modes": modes, "local_devices": args.mh_local_devices,
+            **(extra_cfg or {}),
         }
         with tempfile.NamedTemporaryFile("w", suffix=".json",
                                          delete=False) as f:
             json.dump(cfg, f)
             path = f.name
         try:
-            outs = spawn_cluster(
+            outs, report = spawn_cluster_report(
                 [sys.executable, "-m", "fedml_tpu.parallel.mh_worker",
-                 path], procs, timeout_s=900.0)
+                 path], procs, timeout_s=900.0, elastic=elastic)
         finally:
             os.unlink(path)
         docs = {}
@@ -1428,17 +1467,21 @@ def _bench_multihost(args) -> None:
                 if line.startswith("{"):
                     d = json.loads(line)
                     docs[d["rank"]] = d
-        if len(docs) != procs:
+        expect = (set(range(procs)) if expect_ranks is None
+                  else expect_ranks)
+        if not expect <= set(docs):
             raise MultihostLaunchError(
-                f"{len(docs)}/{procs} ranks reported")
-        return docs
+                f"rank(s) {sorted(expect - set(docs))} never reported "
+                f"({len(docs)}/{procs} docs); per-rank: "
+                f"{report['ranks']}")
+        return docs, report
 
     slo_eng = _slo_window()
     rows = []
     deaths_total = 0
-    for n in procs_list:
+    for n in (procs_list if "weak" in arms else []):
         try:
-            docs = run_arm(n, n, args.mh_rounds, ["streaming"])
+            docs, _rep = run_arm(n, n, args.mh_rounds, ["streaming"])
         except MultihostLaunchError as e:
             print(f"multihost arm procs={n} FAILED: {e}",
                   file=sys.stderr)
@@ -1484,23 +1527,105 @@ def _bench_multihost(args) -> None:
     # byte-identical (the anchor that lets the weak-scaling numbers be
     # trusted as the same computation)
     bitwise_ok = None
-    try:
-        one = run_arm(1, 2, MH_BITWISE_ROUNDS,
-                      ["streaming", "resident"])
-        two = run_arm(2, 2, MH_BITWISE_ROUNDS,
-                      ["streaming", "resident"])
-        bitwise_ok = bool(
-            one[0]["digests"] == two[0]["digests"] == two[1]["digests"])
-        print(f"multihost bitwise 1p-vs-2p pin: "
-              f"{'OK' if bitwise_ok else 'MISMATCH'} "
-              f"({one[0]['digests']})", file=sys.stderr)
-    except MultihostLaunchError as e:
-        print(f"multihost bitwise arm FAILED: {e}", file=sys.stderr)
-        deaths_total += 1
-        bitwise_ok = False
+    if "bitwise" in arms:
+        try:
+            one, _ = run_arm(1, 2, MH_BITWISE_ROUNDS,
+                             ["streaming", "resident"])
+            two, _ = run_arm(2, 2, MH_BITWISE_ROUNDS,
+                             ["streaming", "resident"])
+            bitwise_ok = bool(
+                one[0]["digests"] == two[0]["digests"]
+                == two[1]["digests"])
+            print(f"multihost bitwise 1p-vs-2p pin: "
+                  f"{'OK' if bitwise_ok else 'MISMATCH'} "
+                  f"({one[0]['digests']})", file=sys.stderr)
+        except MultihostLaunchError as e:
+            print(f"multihost bitwise arm FAILED: {e}", file=sys.stderr)
+            deaths_total += 1
+            bitwise_ok = False
 
-    head = rows[-1] if "error" not in rows[-1] else (
-        base or rows[-1])
+    # v13 elastic chaos arm (ISSUE 14): a clean ELASTIC N-process run
+    # vs the same run with rank 1 seeded-killed mid-run.  The killed
+    # run must (a) COMPLETE on the survivors (elastic launch policy +
+    # view change + block re-adoption), (b) commit byte-identical
+    # models to the clean elastic run (the [seed, round, block] purity
+    # argument, measured not assumed), (c) keep survivor goodput
+    # >= 0.5x clean, with zero survivor deaths.  Fail-fast stays the
+    # default everywhere else in this mode — the weak/bitwise arms
+    # above run the non-elastic runtime unchanged.
+    chaos = None
+    if "chaos" in arms:
+        cp = args.mh_chaos_procs
+        # the killed arm pays ONE detection stall (~hb_timeout) at the
+        # view change — a real deployment amortizes it over hours, so
+        # the arm runs 2x the weak-scaling rounds (>= 20) to price the
+        # steady survivor state, not the transient; the transient
+        # itself is reported separately as view_change_latency_s
+        chaos_rounds = max(20, 2 * args.mh_rounds)
+        base_cfg = {"elastic": True, "hb_timeout_s": 1.0,
+                    "channel_timeout_s": 120.0}
+        try:
+            clean_docs, _ = run_arm(
+                cp, cp, chaos_rounds, ["streaming", "resident"],
+                extra_cfg=base_cfg, elastic=True)
+            survivors = set(range(cp)) - {1}
+            killed_docs, killed_rep = run_arm(
+                cp, cp, chaos_rounds, ["streaming", "resident"],
+                extra_cfg={**base_cfg, "die_rank": 1,
+                           "die_at_round": 1},
+                elastic=True, expect_ranks=survivors)
+            d0 = killed_docs[0]
+            srep = d0["per_mode"]["streaming"]
+            clean_rps = clean_docs[0]["rounds_per_sec"]
+            killed_rps = d0["rounds_per_sec"]
+            survivor_deaths = sum(
+                1 for r, info in killed_rep["ranks"].items()
+                if int(r) != 1 and info["rc"] != 0)
+            bitwise_after_death = all(
+                killed_docs[r]["digests"]
+                == clean_docs[0]["digests"]
+                for r in survivors)
+            chaos = {
+                "procs": cp,
+                "rounds": chaos_rounds,
+                "clean_rounds_per_sec": round(clean_rps, 4),
+                "killed_rounds_per_sec": round(killed_rps, 4),
+                "survivor_goodput_ratio": (
+                    round(killed_rps / clean_rps, 4)
+                    if clean_rps > 0 else None),
+                "view_changes": srep.get("view_changes", 0),
+                "view_change_latency_s": round(
+                    srep.get("view_change_latency_s", 0.0), 5),
+                "epoch_final": srep.get("epoch", 0),
+                "survivor_deaths": survivor_deaths,
+                "killed_rank_outcome":
+                    killed_rep["ranks"][1]["outcome"],
+                "bitwise_after_death_ok": bool(bitwise_after_death),
+                # asserted only when a non-elastic arm actually ran
+                # this invocation (the weak/bitwise arms use the
+                # fail-fast launch policy); --mh_arms chaos alone
+                # exercises nothing about the default -> null
+                "elastic_fail_fast_default_ok": (
+                    True if arms & {"weak", "bitwise"} else None),
+            }
+            print(f"multihost elastic chaos: clean "
+                  f"{clean_rps:.3f} -> killed {killed_rps:.3f} "
+                  f"rounds/s (ratio "
+                  f"{chaos['survivor_goodput_ratio']}), "
+                  f"{chaos['view_changes']} view change(s) @ "
+                  f"{chaos['view_change_latency_s']*1e3:.1f} ms, "
+                  f"bitwise_after_death_ok="
+                  f"{chaos['bitwise_after_death_ok']}",
+                  file=sys.stderr)
+        except MultihostLaunchError as e:
+            print(f"multihost elastic chaos arm FAILED: {e}",
+                  file=sys.stderr)
+            deaths_total += 1
+            chaos = {"error": str(e), "survivor_deaths": None,
+                     "bitwise_after_death_ok": False}
+
+    head = (rows[-1] if rows and "error" not in rows[-1] else
+            (base or (rows[-1] if rows else {})))
     doc = _stamp({
         "metric": "multihost_weak_scaling_rounds_per_sec",
         "value": round(head.get("rounds_per_sec", 0.0), 4),
@@ -1521,6 +1646,7 @@ def _bench_multihost(args) -> None:
             "weak_efficiency_2p": _eff(2),
             "weak_efficiency_4p": _eff(4),
             "bitwise_2proc_ok": bitwise_ok,
+            "chaos": chaos,
             "process_deaths": deaths_total,
             "k_per_block": args.mh_k_per_block,
             "clients_per_block": args.mh_clients_per_block,

@@ -405,6 +405,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "not the topology — pin it across runs to keep "
                         "commits bitwise comparable at different "
                         "process counts")
+    p.add_argument("--elastic", action="store_true",
+                   help="multihost: elastic membership (ISSUE 14) — a "
+                        "dead or hung rank triggers an epoch-numbered "
+                        "view change and the survivors re-adopt its "
+                        "blocks mid-round (bitwise-identical commits by "
+                        "the block-partition contract); a restarted "
+                        "rank (FEDML_MH_REJOIN=1, set by the launcher's "
+                        "--respawn) rejoins via config-digest handshake "
+                        "+ a rank-0 model snapshot.  Default is "
+                        "FAIL-FAST: one dead rank kills the cluster, "
+                        "named")
+    p.add_argument("--hb_timeout_s", type=float, default=2.0,
+                   help="with --elastic: heartbeat silence after which "
+                        "a rank is suspected hung (the SIGSTOP "
+                        "detector; detection runs between allgathers, "
+                        "not only inside one)")
     p.add_argument("--group_num", type=int, default=2,
                    help="hierarchical: silo count")
     p.add_argument("--group_comm_round", type=int, default=2)
@@ -1101,7 +1117,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         try:
             for rank, out in enumerate(spawn_cluster(
                     child, args.multihost_procs,
-                    jax_distributed=args.multihost, echo=True)):
+                    jax_distributed=args.multihost,
+                    elastic=args.elastic, echo=True)):
                 for line in out.splitlines():
                     print(f"[rank {rank}] {line}")
         except MultihostLaunchError as e:
@@ -1122,8 +1139,15 @@ def main(argv: Optional[list[str]] = None) -> int:
             # one obs dir per RANK: co-launched processes handed the
             # same --obs_dir race each other's export tmp files (and
             # silently interleave traces); per-rank subdirs are also
-            # what tools/trace_timeline.py wants as inputs
-            obs_dir = os.path.join(obs_dir, f"rank{mh_ctx.rank}")
+            # what tools/trace_timeline.py wants as inputs.  A
+            # REJOINING incarnation (elastic respawn) reuses its rank
+            # id within the SAME run, so rank alone would clobber the
+            # dead incarnation's traces — namespace the rejoin by pid
+            # too (ISSUE 14)
+            sub = f"rank{mh_ctx.rank}"
+            if os.environ.get("FEDML_MH_REJOIN") == "1":
+                sub = f"rank{mh_ctx.rank}-pid{os.getpid()}"
+            obs_dir = os.path.join(obs_dir, sub)
         obs.configure(obs_dir)
     else:
         obs.configure_from_env()     # FEDML_OBS_DIR (tools/isolate_hang)
@@ -1197,8 +1221,9 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     import inspect
     mh_runner = None
-    if mh_ctx is not None or args.agg_blocks is not None:
-        from fedml_tpu.parallel.multihost import MultihostRunner
+    if mh_ctx is not None or args.agg_blocks is not None or args.elastic:
+        from fedml_tpu.parallel.multihost import (ElasticRunner,
+                                                  MultihostRunner)
         if not args.mesh:
             raise SystemExit(
                 "multihost execution drives the mesh engines: add --mesh")
@@ -1206,8 +1231,16 @@ def main(argv: Optional[list[str]] = None) -> int:
             logging.getLogger(__name__).warning(
                 "--ckpt_dir is ignored under multihost execution (the "
                 "two-level runner does not checkpoint yet)")
-        mh_runner = MultihostRunner(eng, mh_ctx,
-                                    n_blocks=args.agg_blocks)
+        if args.elastic:
+            # elastic membership: view changes + block re-adoption on
+            # rank death, rejoin on respawn; fail-fast stays the
+            # default below
+            mh_runner = ElasticRunner(eng, mh_ctx,
+                                      n_blocks=args.agg_blocks,
+                                      hb_timeout_s=args.hb_timeout_s)
+        else:
+            mh_runner = MultihostRunner(eng, mh_ctx,
+                                        n_blocks=args.agg_blocks)
 
     run_params = inspect.signature(eng.run).parameters
     engine_logs = "logger" in run_params

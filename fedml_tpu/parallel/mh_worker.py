@@ -20,6 +20,16 @@ Config keys (all optional; defaults in DEFAULTS):
     channel_timeout_s, die_rank/die_at_round (crash injection: that
     rank hard-exits rc=3 at the end of that round), jax_distributed,
     eval (bool: report final test_acc from rank 0)
+
+ISSUE 14 (elastic) keys:
+    elastic (bool: ElasticRunner/ElasticChannel — rank death triggers a
+    view change + block re-adoption instead of cluster teardown; a
+    respawned rank with FEDML_MH_REJOIN=1 in its env rejoins the run),
+    hang_rank/hang_at_round/hang_s (hang injection: that rank pauses
+    its heartbeats and sleeps hang_s at the end of that round — the
+    SIGSTOP shape; the coordinator must evict it via heartbeat timeout
+    and the evicted rank exits rc=4 when it wakes into a closed
+    channel), hb_timeout_s/hb_interval_s (elastic failure detector).
 """
 import json
 import os
@@ -32,6 +42,9 @@ DEFAULTS = {
     "seed": 0, "modes": ["streaming", "resident"], "local_devices": 1,
     "lr": 0.1, "channel_timeout_s": 60.0, "die_rank": None,
     "die_at_round": None, "jax_distributed": False, "eval": False,
+    "elastic": False, "hang_rank": None, "hang_at_round": None,
+    "hang_s": 20.0, "hb_timeout_s": 2.0, "hb_interval_s": 0.25,
+    "round_sleep_s": 0.0, "round_sleep_mode": None,
 }
 
 
@@ -111,9 +124,14 @@ def main(argv=None) -> int:
     with open(argv[0]) as f:
         cfg = {**DEFAULTS, **json.load(f)}
     _setup_jax(cfg)
+    import hashlib
+
     import jax
 
-    from fedml_tpu.parallel.multihost import (HostChannel,
+    from fedml_tpu.parallel.multihost import (DeadRankError,
+                                              ElasticChannel,
+                                              ElasticRunner,
+                                              HostChannel,
                                               MultihostContext,
                                               MultihostRunner,
                                               init_multihost,
@@ -125,30 +143,119 @@ def main(argv=None) -> int:
                        required=True)
     make_engine = build_case(cfg)
     n_blocks = cfg["n_blocks"] or ctx.world
+    rejoining = (os.environ.get("FEDML_MH_REJOIN") == "1"
+                 and ctx.rank != 0)
+
+    current_mode = {"mode": None}
 
     def on_round_end(round_idx: int) -> None:
+        if cfg["round_sleep_s"] > 0 and (
+                cfg["round_sleep_mode"] is None
+                or cfg["round_sleep_mode"] == current_mode["mode"]):
+            # pacing for the rejoin pins: synthetic rounds finish in
+            # milliseconds, far faster than a respawned process can
+            # boot jax — a per-round sleep holds the run open so the
+            # rejoin handshake lands mid-run, deterministically
+            # (round_sleep_mode scopes it to the run being rejoined)
+            time.sleep(float(cfg["round_sleep_s"]))
         if (cfg["die_rank"] == ctx.rank
-                and cfg["die_at_round"] == round_idx):
+                and cfg["die_at_round"] == round_idx
+                and not rejoining):
             print(f"rank {ctx.rank}: injected crash at round "
                   f"{round_idx}", file=sys.stderr, flush=True)
             os._exit(3)
+        if (cfg["hang_rank"] == ctx.rank
+                and cfg["hang_at_round"] == round_idx
+                and not rejoining):
+            # the SIGSTOP shape without stopping the OS process (a
+            # truly stopped child never exits, which would wedge the
+            # launcher): heartbeats pause, the rank goes silent for
+            # hang_s, and the coordinator must evict it via heartbeat
+            # timeout — waking into the closed channel exits rc=4
+            print(f"rank {ctx.rank}: injected hang at round "
+                  f"{round_idx} for {cfg['hang_s']:.0f}s",
+                  file=sys.stderr, flush=True)
+            channel.hb_paused = True
+            time.sleep(float(cfg["hang_s"]))
+            channel.hb_paused = False
 
     # ONE channel for the whole worker (both residency modes ride it;
-    # re-binding the coordinator port between modes would race peers)
-    channel = HostChannel(ctx, timeout_s=cfg["channel_timeout_s"])
+    # re-binding the coordinator port between modes would race peers).
+    # The elastic config digest covers the WHOLE worker config — any
+    # skewed rank (or stale rejoiner) is rejected by name at hello.
+    if cfg["elastic"]:
+        digest = hashlib.md5(json.dumps(
+            cfg, sort_keys=True).encode()).hexdigest()
+        channel = ElasticChannel(
+            ctx, n_items=n_blocks, config_digest=digest,
+            timeout_s=cfg["channel_timeout_s"],
+            hb_interval_s=cfg["hb_interval_s"],
+            hb_timeout_s=cfg["hb_timeout_s"],
+            rejoin=rejoining)
+    else:
+        channel = HostChannel(ctx, timeout_s=cfg["channel_timeout_s"])
     out = {"rank": ctx.rank, "world": ctx.world, "n_blocks": n_blocks,
+           "elastic": bool(cfg["elastic"]),
+           "rejoined": bool(rejoining),
            "digests": {}, "per_mode": {}}
+    modes = list(cfg["modes"])
+    for mode in modes:
+        if mode not in ("streaming", "resident"):
+            raise SystemExit(f"unknown residency mode {mode!r}")
+    rejoin_state = None
+    if cfg["elastic"] and rejoining:
+        # handshake BEFORE building any engine: the SNAPSHOT's run tag
+        # names which residency-mode run the coordinator is in — a
+        # respawned process must resume THAT run, not replay the mode
+        # list from the top (the sequential runs share one channel, so
+        # rejoining the wrong one would cross-wire the exchanges)
+        blob, resume_round, tag = channel.rejoin_handshake()
+        if tag in modes:
+            skipped, modes = modes[:modes.index(tag)], \
+                modes[modes.index(tag):]
+            if skipped:
+                print(f"rank {ctx.rank}: rejoined into {tag!r}; "
+                      f"skipping completed mode(s) {skipped}",
+                      file=sys.stderr, flush=True)
+        rejoin_state = (blob, resume_round)
     try:
-        for mode in cfg["modes"]:
-            if mode not in ("streaming", "resident"):
-                raise SystemExit(f"unknown residency mode {mode!r}")
+        for mi, mode in enumerate(modes):
+            current_mode["mode"] = mode
             engine = make_engine(streaming=(mode == "streaming"))
-            runner = MultihostRunner(
-                engine, ctx, n_blocks=n_blocks, channel=channel,
-                timeout_s=cfg["channel_timeout_s"],
-                on_round_end=on_round_end)
+            if cfg["elastic"]:
+                runner = ElasticRunner(
+                    engine, ctx, n_blocks=n_blocks, channel=channel,
+                    timeout_s=cfg["channel_timeout_s"],
+                    hb_interval_s=cfg["hb_interval_s"],
+                    hb_timeout_s=cfg["hb_timeout_s"],
+                    run_tag=mode,
+                    on_round_end=on_round_end)
+            else:
+                runner = MultihostRunner(
+                    engine, ctx, n_blocks=n_blocks, channel=channel,
+                    timeout_s=cfg["channel_timeout_s"],
+                    on_round_end=on_round_end)
             t0 = time.perf_counter()
-            variables = runner.run(rounds=cfg["rounds"])
+            try:
+                if cfg["elastic"]:
+                    # only the FIRST runner of a respawned process
+                    # resumes mid-run; later modes start as a member
+                    variables = runner.run(
+                        rounds=cfg["rounds"], rejoin=False,
+                        rejoin_state=(rejoin_state if mi == 0
+                                      else None))
+                else:
+                    variables = runner.run(rounds=cfg["rounds"])
+            except DeadRankError as e:
+                if (cfg["hang_rank"] == ctx.rank
+                        and not rejoining):
+                    # the injected hang got this rank evicted — the
+                    # intended outcome; exit distinctly so the
+                    # launcher's blame report shows rc=4, not a crash
+                    print(f"rank {ctx.rank}: evicted after injected "
+                          f"hang: {e}", file=sys.stderr, flush=True)
+                    return 4
+                raise
             wall = time.perf_counter() - t0
             rep = runner.report(warmup_rounds=cfg["warmup"])
             rep["total_wall_s"] = wall

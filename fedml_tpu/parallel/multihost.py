@@ -77,6 +77,9 @@ import hashlib
 import json
 import logging
 import os
+import pickle
+import select
+import signal
 import socket
 import struct
 import subprocess
@@ -250,42 +253,104 @@ class MultihostLaunchError(RuntimeError):
     """A launched rank failed/hung; the message names it."""
 
 
+def _rank_outcome(rc: Optional[int], policy_killed: bool = False) -> str:
+    """One rank's exit, human-named: clean/nonzero exit codes and the
+    SIGNAL name for signal deaths — SIGKILL (the chaos injection / OOM
+    shape) reads differently from SIGSEGV (a real crash) and from a
+    plain nonzero exit (a named Python error)."""
+    if rc is None:
+        return "still running"
+    if rc == 0:
+        return "ok"
+    if rc < 0:
+        try:
+            name = signal.Signals(-rc).name
+        except ValueError:
+            name = f"signal {-rc}"
+        suffix = (" by launcher cleanup after the first failure"
+                  if policy_killed else "")
+        return f"killed by {name}{suffix}"
+    return f"exit rc={rc}"
+
+
 def spawn_cluster(cmd: list[str], procs: int, *,
                   env: Optional[dict] = None,
                   timeout_s: float = 600.0,
                   jax_distributed: bool = False,
                   echo: bool = False,
-                  coordinator_host: str = "localhost") -> list[str]:
+                  coordinator_host: str = "localhost",
+                  elastic: bool = False,
+                  respawn: bool = False,
+                  kill_grace_s: float = 5.0) -> list[str]:
     """Fork `procs` copies of `cmd` wired as one multihost cluster (env
     FEDML_MH_RANK/WORLD/COORD [+ FEDML_MH_JAX_COORD with
     jax_distributed]); returns each rank's stdout, rank-ordered.
 
-    Failure policy: the first rank to exit nonzero kills the rest and
-    raises MultihostLaunchError NAMING that rank (with its stderr
-    tail); a deadline overrun kills everything and names the ranks
-    still running.  `echo` streams child stderr line-prefixed
-    (`[rank i]`) for interactive launches."""
+    Failure policy (fail-fast, the default): the first rank to exit
+    nonzero kills the rest and raises MultihostLaunchError NAMING that
+    rank (with its stderr tail) plus a per-rank outcome summary — exit
+    code or signal name for EVERY rank, so a chaos-killed rank
+    (SIGKILL) is distinguishable from the collateral channel-EOF deaths
+    it causes.  A deadline overrun kills everything and names the ranks
+    still running.
+
+    Elastic policy (`elastic=True`, ISSUE 14): a dead rank does NOT
+    take the survivors down — the cluster runs to completion and only a
+    rank-0 (coordinator) failure or the deadline raises.  With
+    `respawn=True` a dead nonzero rank > 0 is relaunched ONCE with
+    FEDML_MH_REJOIN=1 in its env, so the worker re-enters the cluster
+    through the elastic rejoin handshake (ElasticChannel) — the
+    process-level chaos/recovery loop, launcher-driven.
+
+    `echo` streams child stderr line-prefixed (`[rank i]`)."""
+    outs, _report = spawn_cluster_report(
+        cmd, procs, env=env, timeout_s=timeout_s,
+        jax_distributed=jax_distributed, echo=echo,
+        coordinator_host=coordinator_host, elastic=elastic,
+        respawn=respawn, kill_grace_s=kill_grace_s)
+    return outs
+
+
+def spawn_cluster_report(cmd: list[str], procs: int, *,
+                         env: Optional[dict] = None,
+                         timeout_s: float = 600.0,
+                         jax_distributed: bool = False,
+                         echo: bool = False,
+                         coordinator_host: str = "localhost",
+                         elastic: bool = False,
+                         respawn: bool = False,
+                         kill_grace_s: float = 5.0
+                         ) -> tuple[list[str], dict]:
+    """spawn_cluster plus a per-rank outcome report: ({rank stdouts},
+    {"ranks": {r: {"rc", "outcome", "respawned", "incarnations"}},
+    "first_failed": r|None}) — the bench's chaos arm reads survivor
+    deaths and the respawn count from here instead of re-parsing
+    stderr."""
     if procs < 1:
         raise ValueError(f"procs must be >= 1, got {procs}")
     if not cmd:
         raise ValueError("empty worker command")
+    if respawn and not elastic:
+        raise ValueError("respawn=True needs elastic=True (a fail-fast "
+                         "cluster kills the survivors the rejoiner "
+                         "would rejoin)")
     coord = f"{coordinator_host}:{free_port()}"
     base_env = {**os.environ, **(env or {}),
                 ENV_WORLD: str(procs), ENV_COORD: coord}
+    base_env.pop("FEDML_MH_REJOIN", None)
     if jax_distributed:
         base_env[ENV_JAX_COORD] = f"{coordinator_host}:{free_port()}"
-    ps = []
-    for r in range(procs):
-        e = dict(base_env)
-        e[ENV_RANK] = str(r)
-        ps.append(subprocess.Popen(cmd, env=e, text=True,
-                                   stdout=subprocess.PIPE,
-                                   stderr=subprocess.PIPE))
-    outs: list = [None] * procs
-    errs: list = [None] * procs
 
-    def _drain(i):
-        buf_out, buf_err = [], []
+    # per-rank incarnation tables (respawn appends a second incarnation)
+    incarnations: list[list[subprocess.Popen]] = [[] for _ in range(procs)]
+    bufs: dict[tuple[int, int], tuple[list, list]] = {}
+    drains: list[threading.Thread] = []
+    policy_killed: set[int] = set()
+
+    def _drain(rank: int, gen: int, p: subprocess.Popen):
+        buf_out: list = []
+        buf_err: list = []
+        bufs[(rank, gen)] = (buf_out, buf_err)
 
         def _pump(stream, buf, is_err):
             for line in stream:
@@ -294,63 +359,151 @@ def spawn_cluster(cmd: list[str], procs: int, *,
                     # stderr streams live (progress/tracebacks); stdout
                     # is returned buffered so machine-readable lines
                     # stay contiguous per rank
-                    print(f"[rank {i}] {line}", end="", file=sys.stderr,
-                          flush=True)
+                    print(f"[rank {rank}] {line}", end="",
+                          file=sys.stderr, flush=True)
         t_err = threading.Thread(target=_pump,
-                                 args=(ps[i].stderr, buf_err, True))
+                                 args=(p.stderr, buf_err, True))
         t_err.start()
-        _pump(ps[i].stdout, buf_out, False)
+        _pump(p.stdout, buf_out, False)
         t_err.join()
-        outs[i], errs[i] = "".join(buf_out), "".join(buf_err)
 
-    drains = [threading.Thread(target=_drain, args=(i,))
-              for i in range(procs)]
-    for t in drains:
+    def _launch(rank: int, rejoin: bool = False):
+        e = dict(base_env)
+        e[ENV_RANK] = str(rank)
+        if rejoin:
+            e["FEDML_MH_REJOIN"] = "1"
+        p = subprocess.Popen(cmd, env=e, text=True,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE)
+        gen = len(incarnations[rank])
+        incarnations[rank].append(p)
+        t = threading.Thread(target=_drain, args=(rank, gen, p))
         t.start()
+        drains.append(t)
+        return p
+
+    for r in range(procs):
+        _launch(r)
+
+    def _cur(rank: int) -> subprocess.Popen:
+        return incarnations[rank][-1]
+
+    def _summary() -> str:
+        rows = []
+        for r in range(procs):
+            tags = [_rank_outcome(p.poll(), r in policy_killed)
+                    for p in incarnations[r]]
+            rows.append(f"rank {r}: " + " -> respawned: ".join(tags))
+        return "; ".join(rows)
+
+    def _err_tail(rank: int) -> str:
+        chunks = [("".join(bufs.get((rank, g), ([], []))[1]))
+                  for g in range(len(incarnations[rank]))]
+        return "".join(chunks)[-3000:]
+
     deadline = time.monotonic() + timeout_s
     first_failed: Optional[int] = None
+    handled_deaths: set[tuple[int, int]] = set()
+    respawned: set[int] = set()
     try:
         while True:
-            live = [i for i, p in enumerate(ps) if p.poll() is None]
-            failed = [i for i, p in enumerate(ps)
-                      if p.poll() is not None and p.returncode != 0]
-            if failed and first_failed is None:
-                first_failed = failed[0]
-            if failed or not live:
-                break
+            live = [r for r in range(procs) if _cur(r).poll() is None]
+            for r in range(procs):
+                for g, p in enumerate(incarnations[r]):
+                    if (p.poll() is not None and p.returncode != 0
+                            and (r, g) not in handled_deaths):
+                        handled_deaths.add((r, g))
+                        if first_failed is None:
+                            first_failed = r
+                        if (elastic and respawn and r != 0
+                                and r not in respawned):
+                            respawned.add(r)
+                            log.warning(
+                                "elastic launch: rank %d died (%s); "
+                                "respawning once with FEDML_MH_REJOIN=1",
+                                r, _rank_outcome(p.returncode))
+                            _launch(r, rejoin=True)
+            if elastic:
+                # survivors outlive a dead peer; only the coordinator's
+                # death (or the deadline) is cluster-fatal
+                if (_cur(0).poll() is not None
+                        and _cur(0).returncode != 0):
+                    break
+                if all(_cur(r).poll() is not None
+                       for r in range(procs)):
+                    break
+            else:
+                failed = [r for r in range(procs)
+                          if _cur(r).poll() is not None
+                          and _cur(r).returncode != 0]
+                if failed or not live:
+                    break
             if time.monotonic() > deadline:
-                for p in ps:
-                    if p.poll() is None:
-                        p.kill()
+                for r in live:
+                    policy_killed.add(r)
+                    _cur(r).kill()
+                for r in live:   # reap: the summary must show the
+                    try:         # kill outcome, not "still running"
+                        _cur(r).wait(timeout=10)
+                    except Exception:
+                        pass
                 raise MultihostLaunchError(
                     f"multihost launch timed out after {timeout_s:.0f}s: "
-                    f"rank(s) {live} still running (of {procs})")
+                    f"rank(s) {live} still running (of {procs})\n"
+                    f"per-rank: {_summary()}")
             time.sleep(0.05)
-        if failed:
+        if any(p.returncode not in (0, None)
+               for ps in incarnations for p in ps):
             # give survivors a short grace (a dead peer's channel EOF
-            # usually fails them promptly with their OWN named error),
-            # then kill
-            grace = time.monotonic() + 5.0
+            # usually fails them promptly with their OWN named error;
+            # elastic survivors already ran to completion), then kill
+            grace = time.monotonic() + kill_grace_s
             while (time.monotonic() < grace
-                   and any(p.poll() is None for p in ps)):
+                   and any(_cur(r).poll() is None
+                           for r in range(procs))):
                 time.sleep(0.05)
-            for p in ps:
-                if p.poll() is None:
-                    p.kill()
+            killed_now = []
+            for r in range(procs):
+                if _cur(r).poll() is None:
+                    policy_killed.add(r)
+                    _cur(r).kill()
+                    killed_now.append(r)
+            for r in killed_now:
+                # reap before the report/summary reads returncode —
+                # an unreaped kill would show rc=None "still running"
+                try:
+                    _cur(r).wait(timeout=10)
+                except Exception:
+                    pass
     finally:
         for t in drains:
             t.join()
-    bad = [i for i, p in enumerate(ps) if p.returncode != 0]
-    if bad:
+    report = {
+        "first_failed": first_failed,
+        "ranks": {
+            r: {"rc": _cur(r).returncode,
+                "outcome": _rank_outcome(_cur(r).returncode,
+                                         r in policy_killed),
+                "respawned": r in respawned,
+                "incarnations": len(incarnations[r]),
+                "all_rcs": [p.returncode for p in incarnations[r]]}
+            for r in range(procs)},
+    }
+    bad = [r for r in range(procs) if _cur(r).returncode != 0]
+    fatal = bad and (not elastic or 0 in bad)
+    if fatal:
         # blame the FIRST rank observed failing (the injected/original
-        # fault), not a survivor that died of the resulting channel EOF
+        # fault), not a survivor that died of the resulting channel
+        # EOF; the per-rank summary names EVERY rank's exit/signal
         i = first_failed if first_failed in bad else bad[0]
-        tail = (errs[i] or "")[-3000:]
         raise MultihostLaunchError(
             f"multihost rank {i}/{procs} failed first "
-            f"(rc={ps[i].returncode}; {len(bad)}/{procs} ranks "
-            f"failed):\n{tail}")
-    return [o or "" for o in outs]
+            f"(rc={_cur(i).returncode}; {len(bad)}/{procs} ranks "
+            f"failed):\nper-rank: {_summary()}\n{_err_tail(i)}")
+    outs = ["".join("".join(bufs.get((r, g), ([], []))[0])
+                    for g in range(len(incarnations[r])))
+            for r in range(procs)]
+    return outs, report
 
 
 # ---------------------------------------------------------------------------
@@ -379,6 +532,49 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_frame(sock: socket.socket) -> bytes:
     (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
     return _recv_exact(sock, n)
+
+
+def _dial_with_backoff(host: str, port: int, deadline: float, what: str,
+                       *, initial_s: float = 0.05,
+                       cap_s: float = 1.0) -> socket.socket:
+    """Deadline-bounded TCP dial with exponential backoff — THE connect
+    path for every transient dial in this module (worker->coordinator
+    data/heartbeat/rejoin links).  A coordinator mid-accept-setup, or
+    restarting in elastic mode, refuses connects transiently; retrying
+    with growing sleeps (initial_s doubling to cap_s) inside the
+    caller's deadline turns that window into latency instead of a
+    launch failure.  Final failure raises DeadRankError NAMING `what`
+    and the last OS error."""
+    delay = initial_s
+    last: Optional[Exception] = None
+    while True:
+        budget = deadline - time.monotonic()
+        if budget <= 0:
+            raise DeadRankError(
+                f"{what}: could not connect to {host}:{port} before its "
+                f"deadline (last error: "
+                f"{type(last).__name__ if last is not None else 'none'}:"
+                f" {last})") from last
+        try:
+            return socket.create_connection(
+                (host, port), timeout=min(5.0, max(0.1, budget)))
+        except OSError as e:
+            last = e
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2.0, cap_s)
+
+
+def _export_channel_byte_counters(rank: int, bytes_sent: int,
+                                  bytes_received: int) -> None:
+    """Publish a channel's cumulative byte counters as obs metrics
+    (called at round boundaries — the counters themselves stay cheap
+    plain ints on the hot path).  Shared by HostChannel and
+    ElasticChannel so the delta-inc accounting can never diverge."""
+    r = str(rank)
+    sent = obs.counter("multihost_bytes_sent_total", rank=r)
+    recv = obs.counter("multihost_bytes_received_total", rank=r)
+    sent.inc(max(0.0, bytes_sent - sent.value))
+    recv.inc(max(0.0, bytes_received - recv.value))
 
 
 class HostChannel:
@@ -447,23 +643,13 @@ class HostChannel:
                                 "handshake")
                 self._peers[r] = conn
         else:
-            deadline = time.monotonic() + connect_timeout_s
-            last_err: Optional[Exception] = None
-            while True:
-                try:
-                    self._sock = socket.create_connection(
-                        (host, port), timeout=5.0)
-                    break
-                except OSError as e:
-                    last_err = e
-                    if time.monotonic() > deadline:
-                        raise DeadRankError(
-                            f"multihost channel setup: rank {ctx.rank} "
-                            f"could not reach the rank-0 coordinator at "
-                            f"{ctx.coordinator} within "
-                            f"{connect_timeout_s:.0f}s: {e}") from e
-                    time.sleep(0.1)
-            del last_err
+            # deadline-bounded exponential-backoff dial: the accept
+            # window on rank 0 opens asynchronously with this process's
+            # start, so first-connect refusals are expected, not fatal
+            self._sock = _dial_with_backoff(
+                host, port, time.monotonic() + connect_timeout_s,
+                f"multihost channel setup: rank {ctx.rank} dialing the "
+                f"rank-0 coordinator at {ctx.coordinator}")
             self._sock.setsockopt(socket.IPPROTO_TCP,
                                   socket.TCP_NODELAY, 1)
             self._sock.sendall(struct.pack("<I", ctx.rank))
@@ -554,14 +740,8 @@ class HostChannel:
         self.allgather(b"", timeout_s=timeout_s)
 
     def export_byte_counters(self) -> None:
-        """Publish the cumulative byte counters as obs metrics (called
-        at round boundaries — the counters themselves stay cheap plain
-        ints on the hot path)."""
-        r = str(self.ctx.rank)
-        sent = obs.counter("multihost_bytes_sent_total", rank=r)
-        recv = obs.counter("multihost_bytes_received_total", rank=r)
-        sent.inc(max(0.0, self.bytes_sent - sent.value))
-        recv.inc(max(0.0, self.bytes_received - recv.value))
+        _export_channel_byte_counters(self.ctx.rank, self.bytes_sent,
+                                      self.bytes_received)
 
     def close(self) -> None:
         for s in self._peers.values():
@@ -655,6 +835,778 @@ def fold_block_partials(parts: dict[int, np.ndarray],
     for b in range(1, n_blocks):
         total += np.asarray(parts[b], dtype=np.float32)
     return total
+
+
+# ---------------------------------------------------------------------------
+# elastic membership (ISSUE 14) — epoch-numbered views, heartbeats,
+# deterministic block re-adoption, rejoin
+# ---------------------------------------------------------------------------
+
+def _send_msg(sock: socket.socket, mtype: str, header: dict,
+              payload: bytes = b"") -> int:
+    """One elastic-protocol message: length-framed [u32 hdr-len][JSON
+    header incl. "t" type][payload].  Returns bytes on the wire."""
+    hdr = json.dumps({"t": mtype, **header}, sort_keys=True).encode()
+    frame = struct.pack("<I", len(hdr)) + hdr + payload
+    _send_frame(sock, frame)
+    return len(frame) + 8
+
+
+def _recv_msg(sock: socket.socket) -> tuple[str, dict, bytes, int]:
+    frame = _recv_frame(sock)
+    (n,) = struct.unpack_from("<I", frame, 0)
+    hdr = json.loads(frame[4:4 + n].decode())
+    return hdr.pop("t"), hdr, frame[4 + n:], len(frame) + 8
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterView:
+    """One epoch of elastic membership: the sorted live ranks and THE
+    deterministic item→owner map.  `n_items` is the fixed block space
+    (the reduction tree's shape — NEVER repartitioned); only ownership
+    moves.  owner_of is a pure function of (members, n_items), so every
+    rank that knows the member list derives the identical partition —
+    no assignment table crosses the wire beyond the member list.  With
+    the full initial membership it reduces to the PR-13 contiguous
+    tiling (rank r owns blocks [r·B/W, (r+1)·B/W))."""
+    epoch: int
+    members: tuple
+    n_items: int
+
+    def owner_of(self, item: int) -> int:
+        if not 0 <= item < self.n_items:
+            raise ValueError(f"item {item} outside [0, {self.n_items})")
+        return self.members[item * len(self.members) // self.n_items]
+
+    def assigned(self, rank: int) -> tuple:
+        return tuple(i for i in range(self.n_items)
+                     if self.owner_of(i) == rank)
+
+
+class ElasticChannel:
+    """Epoch-numbered elastic cluster membership over the HostChannel's
+    star topology (ISSUE 14).  Rank 0 coordinates: it owns the member
+    list, detects death (data-link EOF, bounded waits, AND heartbeats —
+    a SIGSTOP'd rank stops heartbeating and is suspected within
+    `hb_timeout_s`, between allgathers, not only inside one), drives
+    view changes, and admits rejoiners at commit barriers.
+
+    The collective is `exchange(round, parts, compute)`: a block-keyed
+    allgather.  Every item (block) is a pure function of (seed, round,
+    block) — NOT of who computes it — so when a rank dies mid-round the
+    coordinator re-asks the survivors for exactly the missing items
+    (`need` lists in VIEW messages, ownership from ClusterView.owner_of
+    over the shrunk membership) and the round completes with the SAME
+    folded bytes as a clean run: bitwise survival by construction.
+
+    Wire roles (every connection's first frame is a typed hello):
+    "data" (CONTRIB/VIEW/RESULT), "hb" (periodic heartbeats), "rejoin"
+    (config-digest-checked admission: REJECTed by name on mismatch,
+    SNAPSHOT {epoch, resume_round, members} + model blob at the next
+    commit barrier otherwise).  Rank-0 death stays fatal by design —
+    the coordinator is the single failure observer, exactly the
+    HostChannel contract; workers name it in DeadRankError.
+
+    Fail-fast (`HostChannel`) remains the default transport; this class
+    is opt-in via `--elastic` / MultihostRunner's elastic twin."""
+
+    def __init__(self, ctx: MultihostContext, *, n_items: int,
+                 config_digest: str = "",
+                 timeout_s: float = 120.0,
+                 connect_timeout_s: float = 60.0,
+                 hb_interval_s: float = 0.25,
+                 hb_timeout_s: float = 2.0,
+                 rejoin: bool = False):
+        if n_items < 1:
+            raise ValueError(f"n_items must be >= 1, got {n_items}")
+        self.ctx = ctx
+        self.n_items = int(n_items)
+        self.config_digest = str(config_digest)
+        self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.hb_interval_s = float(hb_interval_s)
+        self.hb_timeout_s = float(hb_timeout_s)
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.view = ClusterView(0, tuple(range(ctx.world)), self.n_items)
+        self.view_events: list[dict] = []
+        self.hb_paused = False          # fault-injection hook: a paused
+        #                                 sender emulates a hung (SIGSTOP)
+        #                                 rank without stopping the process
+        self._item_nbytes: Optional[int] = None
+        self._lock = threading.Lock()
+        # byte counters are bumped from the exchange thread AND the
+        # accept/heartbeat handler threads — a bare += would lose
+        # updates; a dedicated lock (never held across I/O waits)
+        # keeps the accounting exact without deadlock exposure
+        self._io_lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._sock: Optional[socket.socket] = None        # worker data
+        self._hb_sock: Optional[socket.socket] = None     # worker hb
+        self._listener: Optional[socket.socket] = None
+        self._data: dict[int, socket.socket] = {}         # coord tables
+        self._hb: dict[int, socket.socket] = {}
+        self._hb_last: dict[int, float] = {}
+        self._suspect: dict[int, str] = {}
+        self._pending_rejoin: list[tuple[int, socket.socket]] = []
+        host, port = ctx.coordinator.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        if ctx.world <= 1:
+            return
+        if ctx.rank == 0:
+            grace = time.monotonic() + self.connect_timeout_s
+            for m in self.view.members:
+                if m != 0:
+                    self._hb_last[m] = grace   # future-dated connect grace
+            self._listener = socket.create_server((host, self._port))
+            self._listener.settimeout(0.25)
+            threading.Thread(target=self._accept_loop, daemon=True,
+                             name="elastic-accept").start()
+        elif not rejoin:
+            self._connect_worker()
+        # rejoin=True defers ALL dialing to rejoin_handshake()
+
+    # -- byte-counted message wrappers ---------------------------------------
+    def _send(self, sock, mtype, header, payload=b"") -> None:
+        n = _send_msg(sock, mtype, header, payload)
+        with self._io_lock:
+            self.bytes_sent += n
+
+    def _recv(self, sock):
+        mtype, hdr, payload, n = _recv_msg(sock)
+        with self._io_lock:
+            self.bytes_received += n
+        return mtype, hdr, payload
+
+    # -- worker side ---------------------------------------------------------
+    def _connect_worker(self) -> None:
+        ctx = self.ctx
+        deadline = time.monotonic() + self.connect_timeout_s
+        self._sock = _dial_with_backoff(
+            self._host, self._port, deadline,
+            f"elastic channel: rank {ctx.rank} data link to the "
+            f"coordinator at {ctx.coordinator}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send(self._sock, "hello",
+                   {"rank": ctx.rank, "role": "data",
+                    "digest": self.config_digest})
+        self._sock.settimeout(max(0.1, deadline - time.monotonic()))
+        try:
+            mtype, hdr, _ = self._recv(self._sock)
+        except (socket.timeout, ConnectionError, OSError) as e:
+            raise DeadRankError(
+                f"elastic channel: rank {ctx.rank} got no hello reply "
+                f"from the coordinator within "
+                f"{self.connect_timeout_s:.0f}s "
+                f"({type(e).__name__})") from e
+        if mtype == "reject":
+            raise DeadRankError(hdr.get("error", "rejected"))
+        self._install_view(hdr)
+        self._hb_sock = _dial_with_backoff(
+            self._host, self._port, deadline,
+            f"elastic channel: rank {ctx.rank} heartbeat link to the "
+            f"coordinator at {ctx.coordinator}")
+        self._send(self._hb_sock, "hello",
+                   {"rank": ctx.rank, "role": "hb"})
+        threading.Thread(target=self._hb_loop, daemon=True,
+                         name=f"elastic-hb-{ctx.rank}").start()
+
+    def _hb_loop(self) -> None:
+        while not self._closed:
+            if not self.hb_paused:
+                try:
+                    self._send(self._hb_sock, "hb", {})
+                except OSError:
+                    return      # coordinator gone: the data path names it
+            time.sleep(self.hb_interval_s)
+
+    def _install_view(self, hdr: dict) -> None:
+        v = ClusterView(int(hdr["epoch"]),
+                        tuple(int(m) for m in hdr["members"]),
+                        self.n_items)
+        if v.epoch < self.view.epoch:
+            return                       # stale (reordered) view
+        if v.epoch > self.view.epoch:
+            self.view_events.append({"epoch": v.epoch,
+                                     "members": list(v.members)})
+            obs.counter("multihost_view_changes_total").inc()
+        self.view = v
+        obs.gauge("multihost_epoch", rank=str(self.ctx.rank)).set(
+            float(v.epoch))
+
+    # -- coordinator side ----------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle_hello, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_hello(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10.0)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            mtype, hdr, _ = self._recv(conn)
+        except (socket.timeout, ConnectionError, OSError, ValueError):
+            conn.close()
+            return
+        rank = int(hdr.get("rank", -1))
+        role = hdr.get("role", mtype)
+        if mtype == "rejoin" or role == "rejoin":
+            self._handle_rejoin_hello(rank, hdr, conn)
+            return
+        if mtype != "hello" or rank < 0:
+            conn.close()
+            return
+        if role == "data":
+            if hdr.get("digest", "") != self.config_digest:
+                try:
+                    self._send(conn, "reject", {"error": (
+                        f"elastic channel: rank {rank} config digest "
+                        f"{hdr.get('digest', '')!r} does not match the "
+                        f"cluster's {self.config_digest!r} — the "
+                        f"two-level reduction would not be bitwise")})
+                except OSError:
+                    pass
+                conn.close()
+                return
+            with self._cond:
+                old = self._data.pop(rank, None)
+                self._data[rank] = conn
+                self._hb_last[rank] = max(
+                    self._hb_last.get(rank, 0.0), time.monotonic())
+                view = self.view
+                self._cond.notify_all()
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            try:
+                self._send(conn, "hello_ok",
+                           {"epoch": view.epoch,
+                            "members": list(view.members),
+                            "n_items": self.n_items})
+            except OSError:
+                pass
+        elif role == "hb":
+            with self._lock:
+                old = self._hb.pop(rank, None)
+                self._hb[rank] = conn
+                self._hb_last[rank] = max(
+                    self._hb_last.get(rank, 0.0), time.monotonic())
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            self._hb_reader(rank, conn)
+        else:
+            conn.close()
+
+    def _handle_rejoin_hello(self, rank: int, hdr: dict,
+                             conn: socket.socket) -> None:
+        """Digest-check NOW (a stale build must be named immediately),
+        queue for admission at the next commit barrier otherwise."""
+        digest = hdr.get("digest", "")
+        if digest != self.config_digest:
+            try:
+                self._send(conn, "reject", {"error": (
+                    f"elastic rejoin: rank {rank} config digest "
+                    f"{digest!r} does not match the cluster's "
+                    f"{self.config_digest!r} — stale config/code; "
+                    f"admission refused")})
+            except OSError:
+                pass
+            conn.close()
+            obs.counter("multihost_rejoins_rejected_total").inc()
+            return
+        with self._lock:
+            self._pending_rejoin.append((rank, conn))
+        obs.instant("multihost.rejoin_request", rank=rank)
+        log.info("elastic: rank %d requested rejoin (pending admission "
+                 "at the next commit barrier)", rank)
+
+    def _hb_reader(self, rank: int, conn: socket.socket) -> None:
+        conn.settimeout(self.hb_timeout_s)
+        while not self._closed:
+            try:
+                self._recv(conn)   # byte-counted like every frame
+                with self._lock:
+                    self._hb_last[rank] = time.monotonic()
+                    self._suspect.pop(rank, None)
+            except socket.timeout:
+                with self._lock:
+                    fresh = (rank in self.view.members
+                             and rank not in self._suspect)
+                    if fresh:
+                        self._suspect[rank] = (
+                            f"no heartbeat for {self.hb_timeout_s:.1f}s "
+                            f"(process hung or stopped)")
+                if fresh:
+                    obs.instant("multihost.rank_suspect", rank=rank)
+                    obs.counter("multihost_rank_suspects_total",
+                                rank=str(rank)).inc()
+                    log.warning("elastic: rank %d heartbeat silent — "
+                                "suspected hung", rank)
+            except (ConnectionError, OSError, ValueError):
+                with self._lock:
+                    if rank in self.view.members:
+                        self._suspect.setdefault(
+                            rank, "heartbeat link closed")
+                return
+
+    def wait_members(self) -> None:
+        """Rank 0, setup barrier: wait for every initial member's data
+        link within connect_timeout_s; ranks that never connect are
+        EVICTED (epoch bump, loudly) instead of failing the launch —
+        the elastic contract from the very first round."""
+        if self.ctx.rank != 0 or self.ctx.world <= 1:
+            return
+        deadline = time.monotonic() + self.connect_timeout_s
+        with self._cond:
+            while time.monotonic() < deadline:
+                missing = [m for m in self.view.members
+                           if m != 0 and m not in self._data]
+                if not missing:
+                    return
+                self._cond.wait(0.1)
+            missing = [m for m in self.view.members
+                       if m != 0 and m not in self._data]
+        if missing:
+            log.warning("elastic setup: rank(s) %s never connected "
+                        "within %.0fs — evicting and starting without "
+                        "them", missing, self.connect_timeout_s)
+            self._coord_view_change(missing, -1, None, None,
+                                    reason="never connected at setup")
+
+    def _coord_view_change(self, dead: list, round_idx: int,
+                           have: Optional[dict], compute,
+                           reason: str = "dead or hung") -> None:
+        """THE view change: evict `dead`, bump the epoch, notify every
+        surviving member (VIEW message carrying the member list + the
+        missing items that member now owns), then adopt rank 0's own
+        newly-owned missing items.  Latency is measured to the point
+        every survivor has been re-tasked — the recompute itself is
+        goodput, not membership latency."""
+        t0 = time.perf_counter()
+        dead = sorted(set(int(r) for r in dead))
+        with obs.span("multihost.view_change",
+                      epoch=self.view.epoch + 1, round=round_idx):
+            with self._lock:
+                for r in dead:
+                    self._suspect.pop(r, None)
+                    for tbl in (self._data, self._hb):
+                        s = tbl.pop(r, None)
+                        if s is not None:
+                            try:
+                                s.close()
+                            except OSError:
+                                pass
+                members = tuple(m for m in self.view.members
+                                if m not in dead)
+                self.view = ClusterView(self.view.epoch + 1, members,
+                                        self.n_items)
+                view = self.view
+                socks = dict(self._data)
+            for r in dead:
+                obs.counter("multihost_rank_deaths_total",
+                            rank=str(r)).inc()
+            obs.counter("multihost_view_changes_total").inc()
+            obs.gauge("multihost_epoch", rank="0").set(float(view.epoch))
+            missing = ([] if have is None else
+                       [b for b in range(self.n_items) if b not in have])
+            for m in view.members:
+                if m == 0 or m not in socks:
+                    continue
+                need = [b for b in missing if view.owner_of(b) == m]
+                try:
+                    socks[m].settimeout(self.timeout_s)
+                    self._send(socks[m], "view",
+                               {"epoch": view.epoch, "round": round_idx,
+                                "members": list(view.members),
+                                "need": need})
+                except (socket.timeout, OSError):
+                    with self._lock:
+                        self._suspect.setdefault(
+                            m, "view notification failed")
+        latency = time.perf_counter() - t0
+        obs.histogram("multihost_view_change_seconds").observe(latency)
+        self.view_events.append({
+            "epoch": view.epoch, "round": round_idx, "dead": dead,
+            "members": list(view.members), "latency_s": latency,
+            "reason": reason})
+        log.warning("elastic view change: epoch %d, rank(s) %s evicted "
+                    "(%s), members now %s (%.1f ms)", view.epoch, dead,
+                    reason, list(view.members), latency * 1e3)
+        # rank 0's own re-adoption (outside the latency window: this is
+        # recompute goodput, the survivors are already re-tasked)
+        if have is not None and compute is not None:
+            mine = [b for b in missing if view.owner_of(b) == 0]
+            if mine:
+                have.update({int(b): bytes(v)
+                             for b, v in compute(mine).items()})
+
+    # -- the elastic collective ----------------------------------------------
+    def _note_items(self, values) -> None:
+        for v in values:
+            n = len(v)
+            if self._item_nbytes is None:
+                self._item_nbytes = n
+            elif n != self._item_nbytes:
+                raise ValueError(
+                    f"elastic exchange: item payload of {n} bytes, "
+                    f"expected {self._item_nbytes} (config skew or a "
+                    f"truncated frame)")
+
+    def exchange(self, round_idx: int, parts: dict,
+                 compute: Optional[Callable] = None
+                 ) -> tuple[dict, ClusterView]:
+        """The block-keyed elastic allgather: contribute `parts`
+        ({item: f32 bytes/ndarray}), receive ALL n_items item payloads
+        plus the view that completed the round.  `compute(items)` is
+        the re-adoption callback — invoked when a view change
+        re-assigns a dead rank's missing items to this rank mid-round.
+        Every rank receives the identical payload set, so any
+        deterministic fold over it (fold_block_partials) commits the
+        same bits on every survivor."""
+        t0 = time.perf_counter()
+        parts = {int(b): (v.tobytes() if hasattr(v, "tobytes")
+                          else bytes(v))
+                 for b, v in parts.items()}
+        self._note_items(parts.values())
+        try:
+            if self.ctx.rank == 0:
+                return self._exchange_coord(round_idx, parts, compute)
+            return self._exchange_worker(round_idx, parts, compute)
+        finally:
+            obs.histogram("multihost_allgather_seconds").observe(
+                time.perf_counter() - t0)
+
+    def _exchange_coord(self, round_idx, parts, compute):
+        have: dict[int, bytes] = dict(parts)
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            missing = [b for b in range(self.n_items) if b not in have]
+            if not missing:
+                break
+            # rank 0's own outstanding items first (covers world==1 and
+            # re-adoption immediately after a view change)
+            mine = [b for b in missing if self.view.owner_of(b) == 0]
+            if mine:
+                if compute is None:
+                    raise DeadRankError(
+                        f"elastic exchange #{round_idx}: items {mine} "
+                        f"fell to rank 0 but no compute callback was "
+                        f"given")
+                got = {int(b): bytes(v)
+                       for b, v in compute(mine).items()}
+                self._note_items(got.values())
+                have.update(got)
+                continue
+            now = time.monotonic()
+            with self._lock:
+                dead = set(self._suspect)
+                hb_stale = [m for m in self.view.members
+                            if m != 0
+                            and now - self._hb_last.get(m, now)
+                            > self.hb_timeout_s]
+                socks = dict(self._data)
+            dead |= set(hb_stale)
+            dead &= set(self.view.members) - {0}
+            if now > deadline:
+                # whoever still owes an item at the deadline is hung
+                dead |= {self.view.owner_of(b) for b in missing} - {0}
+            if dead:
+                self._coord_view_change(sorted(dead), round_idx, have,
+                                        compute)
+                # the re-tasked survivors legitimately need fresh time
+                # to recompute the dead rank's blocks — without this, a
+                # view change late in the window would cascade into
+                # false evictions of healthy, still-computing ranks
+                deadline = max(deadline,
+                               time.monotonic() + self.timeout_s)
+                continue
+            rl: list = []
+            waitable = [s for m, s in socks.items()
+                        if m in self.view.members]
+            if waitable:
+                try:
+                    rl, _, _ = select.select(waitable, [], [], 0.1)
+                except (OSError, ValueError):
+                    rl = []     # a sock closed under us: re-snapshot
+            else:
+                time.sleep(0.05)
+            for s in rl:
+                m = next((r for r, c in socks.items() if c is s), None)
+                if m is None:
+                    continue
+                try:
+                    s.settimeout(max(0.05, min(5.0,
+                                               deadline - now)))
+                    mtype, hdr, payload = self._recv(s)
+                except (socket.timeout, ConnectionError, OSError,
+                        ValueError):
+                    with self._lock:
+                        self._suspect.setdefault(m, "data link failed")
+                    continue
+                if mtype != "contrib":
+                    continue
+                if int(hdr.get("round", -1)) != round_idx:
+                    log.warning("elastic: dropping stale contrib for "
+                                "round %s from rank %d (at round %d)",
+                                hdr.get("round"), m, round_idx)
+                    continue
+                blocks = [int(b) for b in hdr.get("blocks", [])]
+                if self._item_nbytes is None and blocks:
+                    self._item_nbytes = len(payload) // len(blocks)
+                sz = self._item_nbytes or 0
+                if sz * len(blocks) != len(payload):
+                    with self._lock:
+                        self._suspect.setdefault(
+                            m, f"contrib size mismatch "
+                               f"({len(payload)} bytes for "
+                               f"{len(blocks)} items of {sz})")
+                    continue
+                for j, b in enumerate(blocks):
+                    if 0 <= b < self.n_items and b not in have:
+                        have[b] = payload[j * sz:(j + 1) * sz]
+        # broadcast the complete, identically-ordered payload set
+        blob = b"".join(have[b] for b in range(self.n_items))
+        view = self.view
+        with self._lock:
+            socks = dict(self._data)
+        for m in view.members:
+            if m == 0 or m not in socks:
+                continue
+            try:
+                socks[m].settimeout(self.timeout_s)
+                self._send(socks[m], "result",
+                           {"epoch": view.epoch, "round": round_idx,
+                            "members": list(view.members)},
+                           blob)
+            except (socket.timeout, OSError):
+                with self._lock:
+                    self._suspect.setdefault(m, "result send failed")
+        return have, view
+
+    def _exchange_worker(self, round_idx, parts, compute):
+        sent = set(parts)
+        self._send_contrib(round_idx, parts)
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            self._sock.settimeout(
+                max(0.05, deadline - time.monotonic()))
+            try:
+                mtype, hdr, payload = self._recv(self._sock)
+            except (socket.timeout, ConnectionError, OSError,
+                    ValueError) as e:
+                raise DeadRankError(
+                    f"elastic exchange round {round_idx}: rank "
+                    f"{self.ctx.rank} lost the rank-0 coordinator "
+                    f"({type(e).__name__}: coordinator dead, or this "
+                    f"rank was evicted from the view)") from e
+            if mtype == "view":
+                self._install_view(hdr)
+                # a view change re-tasks the survivors: the round
+                # legitimately runs longer than one clean window
+                deadline = max(deadline,
+                               time.monotonic() + self.timeout_s)
+                need = [int(b) for b in hdr.get("need", [])
+                        if int(b) not in sent]
+                if need and compute is not None:
+                    out = {int(b): bytes(v)
+                           for b, v in compute(need).items()}
+                    self._send_contrib(round_idx, out)
+                    sent |= set(out)
+            elif mtype == "result":
+                if int(hdr.get("round", -1)) != round_idx:
+                    continue             # stale (already-consumed) round
+                self._install_view(hdr)
+                sz = len(payload) // self.n_items
+                if sz * self.n_items != len(payload):
+                    raise DeadRankError(
+                        f"elastic exchange round {round_idx}: result "
+                        f"payload of {len(payload)} bytes does not "
+                        f"tile {self.n_items} items")
+                return ({b: payload[b * sz:(b + 1) * sz]
+                         for b in range(self.n_items)}, self.view)
+            # other message types: ignore
+
+    def _send_contrib(self, round_idx: int,
+                      parts: dict[int, bytes]) -> None:
+        blocks = sorted(parts)
+        try:
+            self._sock.settimeout(self.timeout_s)
+            self._send(self._sock, "contrib",
+                       {"epoch": self.view.epoch, "round": round_idx,
+                        "blocks": blocks},
+                       b"".join(parts[b] for b in blocks))
+        except (socket.timeout, ConnectionError, OSError) as e:
+            raise DeadRankError(
+                f"elastic exchange round {round_idx}: rank "
+                f"{self.ctx.rank} could not ship its contribution to "
+                f"the coordinator ({type(e).__name__})") from e
+
+    # -- rejoin --------------------------------------------------------------
+    def rejoin_handshake(self) -> tuple[bytes, int, str]:
+        """Restarted-worker entry: dial the coordinator's rejoin role,
+        present the config digest, await admission (granted at the next
+        commit barrier) — returns (snapshot payload, resume_round,
+        run_tag) and leaves the channel fully connected (data +
+        heartbeat links) under the new membership.  `run_tag` names
+        WHICH run the snapshot belongs to (a worker driving several
+        sequential runs over one channel — mh_worker's residency modes
+        — must resume the run the coordinator is actually in, not
+        whichever it would have started first)."""
+        ctx = self.ctx
+        deadline = time.monotonic() + self.connect_timeout_s
+        sock = _dial_with_backoff(
+            self._host, self._port, deadline,
+            f"elastic rejoin: rank {ctx.rank} dialing the coordinator "
+            f"at {ctx.coordinator}")
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._send(sock, "rejoin",
+                       {"rank": ctx.rank,
+                        "digest": self.config_digest})
+            # admission lands at a commit barrier: budget a full round
+            # on top of the connect window
+            sock.settimeout(self.timeout_s + self.connect_timeout_s)
+            try:
+                mtype, hdr, payload = self._recv(sock)
+            except (socket.timeout, ConnectionError, OSError) as e:
+                raise DeadRankError(
+                    f"elastic rejoin: rank {ctx.rank} got no admission "
+                    f"from the coordinator within "
+                    f"{self.timeout_s + self.connect_timeout_s:.0f}s "
+                    f"({type(e).__name__}: run finished or coordinator "
+                    f"dead)") from e
+            if mtype == "reject":
+                raise DeadRankError(hdr.get("error", "rejoin rejected"))
+            if mtype != "snapshot":
+                raise DeadRankError(
+                    f"elastic rejoin: unexpected {mtype!r} reply")
+        finally:
+            sock.close()
+        self._install_view(hdr)
+        self._connect_worker()
+        log.info("elastic: rank %d readmitted at epoch %d, resuming "
+                 "run %r at round %d", ctx.rank, self.view.epoch,
+                 hdr.get("tag", ""), int(hdr["resume_round"]))
+        return payload, int(hdr["resume_round"]), hdr.get("tag", "")
+
+    def admit_rejoins(self, resume_round: int,
+                      snapshot_fn: Callable[[], bytes],
+                      tag: str = "") -> list:
+        """Rank 0, at a commit barrier: admit every pending rejoiner —
+        epoch bump, SNAPSHOT reply (view + resume round + the model
+        blob snapshot_fn builds), VIEW notification to the incumbents.
+        Returns the admitted ranks."""
+        if self.ctx.rank != 0:
+            return []
+        with self._lock:
+            pending, self._pending_rejoin = self._pending_rejoin, []
+        if not pending:
+            return []
+        blob = snapshot_fn()
+        admitted = []
+        for rank, conn in pending:
+            if rank in self.view.members:
+                try:
+                    self._send(conn, "reject", {"error": (
+                        f"elastic rejoin: rank {rank} is still a live "
+                        f"member of epoch {self.view.epoch} — a rank id "
+                        f"cannot be claimed twice")})
+                except OSError:
+                    pass
+                conn.close()
+                continue
+            members = tuple(sorted(set(self.view.members) | {rank}))
+            view = ClusterView(self.view.epoch + 1, members,
+                               self.n_items)
+            try:
+                conn.settimeout(self.timeout_s)
+                self._send(conn, "snapshot",
+                           {"epoch": view.epoch,
+                            "resume_round": int(resume_round),
+                            "members": list(members),
+                            "n_items": self.n_items,
+                            "tag": tag},
+                           blob)
+            except (socket.timeout, OSError):
+                conn.close()
+                log.warning("elastic: rejoiner rank %d vanished before "
+                            "its snapshot was delivered", rank)
+                continue
+            conn.close()
+            with self._lock:
+                self.view = view
+                # connect grace for the fresh data/hb links
+                self._hb_last[rank] = (time.monotonic()
+                                       + self.connect_timeout_s)
+                self._suspect.pop(rank, None)
+            admitted.append(rank)
+            obs.counter("multihost_rejoins_admitted_total").inc()
+            obs.gauge("multihost_epoch", rank="0").set(float(view.epoch))
+            obs.counter("multihost_view_changes_total").inc()
+            self.view_events.append({
+                "epoch": view.epoch, "round": int(resume_round),
+                "rejoined": [rank], "members": list(members),
+                "latency_s": 0.0, "reason": "rejoin admitted"})
+            log.warning("elastic: rank %d readmitted at epoch %d "
+                        "(resume round %d)", rank, view.epoch,
+                        resume_round)
+        if admitted:
+            with self._lock:
+                socks = dict(self._data)
+            for m in self.view.members:
+                if m == 0 or m in admitted or m not in socks:
+                    continue
+                try:
+                    socks[m].settimeout(self.timeout_s)
+                    self._send(socks[m], "view",
+                               {"epoch": self.view.epoch,
+                                "round": int(resume_round),
+                                "members": list(self.view.members),
+                                "need": []})
+                except (socket.timeout, OSError):
+                    with self._lock:
+                        self._suspect.setdefault(
+                            m, "view notification failed")
+        return admitted
+
+    # -- plumbing shared with HostChannel ------------------------------------
+    def export_byte_counters(self) -> None:
+        _export_channel_byte_counters(self.ctx.rank, self.bytes_sent,
+                                      self.bytes_received)
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            socks = (list(self._data.values()) + list(self._hb.values())
+                     + [c for _, c in self._pending_rejoin])
+            self._data.clear()
+            self._hb.clear()
+            self._pending_rejoin.clear()
+        for s in socks + [self._sock, self._hb_sock, self._listener]:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._sock = self._hb_sock = self._listener = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 # ---------------------------------------------------------------------------
@@ -785,12 +1737,13 @@ class MultihostRunner:
                                         timeout_s=self.timeout_s)
         return self._channel
 
-    def _handshake(self) -> None:
-        """Cross-rank config agreement: the bitwise contract only holds
-        when every process runs the identical partition and programs —
-        a mismatch names the ranks instead of silently diverging."""
+    def _config_doc(self) -> bytes:
+        """The canonical cross-rank config document: the quantities the
+        bitwise contract depends on.  Fail-fast mode allgathers it
+        (_handshake); elastic mode hellos/rejoins carry its md5 as the
+        cluster config digest."""
         eng = self.engine
-        doc = json.dumps({
+        return json.dumps({
             "n_blocks": self.n_blocks,
             "k_per_block": self.sampler.k_per_block,
             "population": self.sampler.population,
@@ -800,6 +1753,12 @@ class MultihostRunner:
             "family": eng.program_family,
             "streaming": bool(eng.streaming),
         }, sort_keys=True).encode()
+
+    def _handshake(self) -> None:
+        """Cross-rank config agreement: the bitwise contract only holds
+        when every process runs the identical partition and programs —
+        a mismatch names the ranks instead of silently diverging."""
+        doc = self._config_doc()
         docs = self.channel.allgather(doc, timeout_s=self.timeout_s)
         for r, d in enumerate(docs):
             if d != docs[0]:
@@ -819,17 +1778,15 @@ class MultihostRunner:
         crngs = np.asarray(jax.random.split(block_rng, len(ids)))
         return ids, wmask, crngs
 
-    def _upload_range_stack(self):
-        """Resident mode: upload THIS process's population id range
-        once, sharded over the local mesh (device residency is
-        id-range-partitioned across hosts — the registry/shardstore
-        partition, applied to HBM)."""
-        if self._range_stack is not None:
-            return self._range_stack, self._range_stack_w
+    def _upload_id_range(self, lo: int, hi: int) -> tuple:
+        """Slice the host client stack to [lo, hi), cast/pad, and
+        upload it sharded over the local mesh — THE one resident
+        upload body (the contiguous whole-range stack and the elastic
+        per-block stacks both go through here, so cast/pad/byte
+        accounting can never diverge)."""
         from fedml_tpu.parallel.mesh import (client_sharding, pad_cohort,
                                              shard_stack)
         eng = self.engine
-        lo, hi = self.range_lo, self.range_hi
         shards = {k: np.asarray(v)[lo:hi]
                   for k, v in eng._host_shards().items()}
         weights = np.asarray(eng.data.client_num_samples,
@@ -839,9 +1796,20 @@ class MultihostRunner:
         eng.transfer_stats.add_h2d_bytes(
             sum(np.asarray(v).nbytes for v in shards.values())
             + weights.nbytes)
-        self._range_stack = shard_stack(eng.mesh, shards)
-        self._range_stack_w = jax.device_put(
-            weights.astype(np.float32), client_sharding(eng.mesh))
+        stack = shard_stack(eng.mesh, shards)
+        stack_w = jax.device_put(weights.astype(np.float32),
+                                 client_sharding(eng.mesh))
+        return stack, stack_w
+
+    def _upload_range_stack(self):
+        """Resident mode: upload THIS process's population id range
+        once, sharded over the local mesh (device residency is
+        id-range-partitioned across hosts — the registry/shardstore
+        partition, applied to HBM)."""
+        if self._range_stack is not None:
+            return self._range_stack, self._range_stack_w
+        self._range_stack, self._range_stack_w = self._upload_id_range(
+            self.range_lo, self.range_hi)
         return self._range_stack, self._range_stack_w
 
     def _gather_streaming(self, round_idx: int, train_rng):
@@ -1049,6 +2017,270 @@ class MultihostRunner:
         if self._channel is not None and self._owns_channel:
             self._channel.close()
             self._channel = None
+
+
+class ElasticRunner(MultihostRunner):
+    """Elastic twin of the two-level round loop (ISSUE 14): the same
+    sample→partial→allreduce→commit structure, but the inter-host tier
+    rides an ElasticChannel — a dead or hung rank triggers a view
+    change, its blocks are re-adopted by the survivors mid-round, and a
+    restarted process re-enters through the rejoin handshake (config
+    digest + a rank-0 model snapshot at the commit barrier).
+
+    Bitwise anchor under death, by construction: `BlockCohortSampler`
+    draws on [seed, round, block] streams and every partial is a pure
+    function of (variables, seed, round, block), so a re-adopted
+    block's partial is byte-identical to the one the dead rank would
+    have shipped; `fold_block_partials` folds ALL blocks in global
+    block order regardless of who computed them — a run that loses a
+    rank commits the same bits as the clean same-partition run
+    (tests/test_multihost_spmd.py's elastic kill pin).
+
+    Differences from the fail-fast runner, deliberate: resident mode
+    caches PER-BLOCK device stacks (ownership is dynamic, so the
+    contiguous whole-range stack no longer exists — every block's
+    stack/gather compiles one shape, identical on every survivor set);
+    the streaming path gathers synchronously (cross-round prefetch
+    assumes static ownership); and the end-of-run metrics rollup is
+    skipped (membership may change under it).  Fail-fast stays the
+    default — this runner is opt-in via cli --elastic."""
+
+    def __init__(self, engine, ctx: Optional[MultihostContext] = None,
+                 *, n_blocks: Optional[int] = None,
+                 channel: Optional[ElasticChannel] = None,
+                 timeout_s: float = 120.0,
+                 connect_timeout_s: float = 60.0,
+                 hb_interval_s: float = 0.25,
+                 hb_timeout_s: float = 2.0,
+                 run_tag: str = "run",
+                 on_round_end: Optional[Callable[[int], None]] = None):
+        if channel is not None and not isinstance(channel,
+                                                  ElasticChannel):
+            raise ValueError(
+                f"ElasticRunner needs an ElasticChannel (got "
+                f"{type(channel).__name__}); use MultihostRunner for "
+                f"the fail-fast HostChannel")
+        super().__init__(engine, ctx, n_blocks=n_blocks,
+                         channel=channel, timeout_s=timeout_s,
+                         on_round_end=on_round_end)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.hb_interval_s = float(hb_interval_s)
+        self.hb_timeout_s = float(hb_timeout_s)
+        self.run_tag = str(run_tag)
+        if channel is not None and channel.n_items != self.n_blocks:
+            raise ValueError(
+                f"channel n_items ({channel.n_items}) != n_blocks "
+                f"({self.n_blocks}) — the block space is the reduction "
+                f"tree and must agree")
+        self._block_stacks: dict[int, tuple] = {}
+        self._round_ctx: Optional[tuple] = None
+
+    @property
+    def channel(self) -> ElasticChannel:
+        if self._channel is None:
+            self._channel = ElasticChannel(
+                self.ctx, n_items=self.n_blocks,
+                config_digest=self.config_digest(),
+                timeout_s=self.timeout_s,
+                connect_timeout_s=self.connect_timeout_s,
+                hb_interval_s=self.hb_interval_s,
+                hb_timeout_s=self.hb_timeout_s,
+                rejoin=os.environ.get("FEDML_MH_REJOIN") == "1")
+        return self._channel
+
+    def config_digest(self) -> str:
+        return hashlib.md5(self._config_doc()).hexdigest()
+
+    # -- per-block partials (ownership-agnostic) -----------------------------
+    def _block_stack(self, b: int) -> tuple:
+        """Resident mode, one block's device stack (cached): uniform
+        [range_size→pad(n_shards)] shape for EVERY block, so any
+        survivor adopting any block dispatches the same compiled
+        program — and re-adoption costs one H2D upload, not a
+        recompile."""
+        hit = self._block_stacks.get(b)
+        if hit is not None:
+            return hit
+        rs = self.sampler.range_size
+        self._block_stacks[b] = self._upload_id_range(b * rs,
+                                                      (b + 1) * rs)
+        return self._block_stacks[b]
+
+    def _compute_partials(self, variables, round_idx: int, train_rng,
+                          blocks) -> dict[int, np.ndarray]:
+        eng = self.engine
+        parts: dict[int, np.ndarray] = {}
+        for b in blocks:
+            ids, wmask, crngs = self._block_inputs(round_idx, b,
+                                                   train_rng)
+            if eng.streaming:
+                cohort, weights = eng._stream_gather(ids, wmask)
+                flat = eng._twolevel_partial(variables, cohort, weights,
+                                             jax.numpy.asarray(crngs))
+            else:
+                stack, stack_w = self._block_stack(b)
+                local_ids = ids - b * self.sampler.range_size
+                flat = eng._twolevel_partial_resident(
+                    variables, stack, stack_w,
+                    jax.numpy.asarray(local_ids),
+                    jax.numpy.asarray(wmask), jax.numpy.asarray(crngs))
+            parts[int(b)] = np.asarray(flat, dtype=np.float32)
+        return parts
+
+    def _readopt_compute(self, blocks) -> dict[int, bytes]:
+        """The mid-round re-adoption callback the channel invokes on a
+        view change: recompute the named blocks against THIS round's
+        frozen (variables, train_rng) — pure functions, so the bytes
+        match what the dead rank would have shipped."""
+        if self._round_ctx is None:
+            raise RuntimeError("re-adoption requested outside a round")
+        variables, train_rng, round_idx = self._round_ctx
+        with obs.span("multihost.readopt", round=round_idx,
+                      blocks=len(tuple(blocks))):
+            parts = self._compute_partials(variables, round_idx,
+                                           train_rng, blocks)
+        return {b: v.tobytes() for b, v in parts.items()}
+
+    def _snapshot_blob(self, resume_round: int, variables,
+                       server_state) -> bytes:
+        """The rejoin catch-up snapshot: the committed model + server
+        state as host numpy trees (byte-exact — the rejoiner must
+        re-enter the bitwise contract, not an approximation of it).
+        Cluster-internal trust boundary: this rides the same
+        coordinator sockets as every carry frame."""
+        tree = jax.tree.map(np.asarray, (variables, server_state))
+        return pickle.dumps({"round": int(resume_round), "state": tree},
+                            protocol=4)
+
+    # -- the elastic loop ----------------------------------------------------
+    def run(self, variables=None, rounds: Optional[int] = None,
+            logger=None, rejoin: Optional[bool] = None,
+            rejoin_state: Optional[tuple] = None):
+        """Drive the elastic two-level loop.  `rejoin=True` (defaulted
+        from FEDML_MH_REJOIN — the launcher's respawn sets it) makes
+        this process re-enter a running cluster: config-digest
+        handshake, model snapshot install, resume at the coordinator's
+        commit barrier.  `rejoin_state=(snapshot_blob, resume_round)`
+        injects a handshake the caller already performed (mh_worker
+        does its own so the SNAPSHOT's run tag can pick which runner to
+        resume)."""
+        eng = self.engine
+        cfg = eng.cfg
+        rounds = rounds if rounds is not None else cfg.comm_round
+        if rejoin is None:
+            rejoin = (os.environ.get("FEDML_MH_REJOIN") == "1"
+                      and self.ctx.rank != 0)
+        ch = self.channel
+        if self.ctx.rank == 0:
+            ch.wait_members()
+        if rejoin or rejoin_state is not None:
+            if rejoin_state is not None:
+                blob, resume_round = rejoin_state
+            else:
+                blob, resume_round, tag = ch.rejoin_handshake()
+                if tag and tag != self.run_tag:
+                    log.warning(
+                        "elastic rejoin: admitted into run %r but this "
+                        "runner drives %r — resuming anyway (the "
+                        "caller should route on the tag, see "
+                        "mh_worker)", tag, self.run_tag)
+            payload = pickle.loads(blob)
+            variables, server_state = payload["state"]
+            variables = eng._prepare_variables(variables)
+            server_state = eng._prepare_server_state(server_state)
+            start_round = int(payload["round"])
+        else:
+            if variables is None:
+                variables = eng.init_variables()
+            variables = eng._prepare_variables(variables)
+            server_state = eng._prepare_server_state(
+                eng.server_init(variables))
+            start_round = 0
+        rng_base = jax.random.PRNGKey(cfg.seed + 1)
+        try:
+            for round_idx in range(start_round, rounds):
+                t0 = time.perf_counter()
+                round_rng = jax.random.fold_in(rng_base, round_idx)
+                train_rng, agg_rng = jax.random.split(round_rng)
+                self._round_ctx = (variables, train_rng, round_idx)
+                with obs.span("round.twolevel", round=round_idx,
+                              rank=self.ctx.rank,
+                              epoch=ch.view.epoch, elastic=True):
+                    mine = ch.view.assigned(self.ctx.rank)
+                    # drop resident stacks for blocks the view no
+                    # longer assigns here (e.g. a rejoin returned them
+                    # to their original owner) — without eviction,
+                    # repeated death/rejoin cycles would converge on
+                    # every host holding the WHOLE population in HBM,
+                    # defeating the id-range partition
+                    for b in list(self._block_stacks):
+                        if b not in mine:
+                            del self._block_stacks[b]
+                    parts = self._compute_partials(variables, round_idx,
+                                                   train_rng, mine)
+                    rx0 = ch.bytes_received
+                    with obs.span("multihost.allreduce",
+                                  round=round_idx):
+                        all_parts, _view = ch.exchange(
+                            round_idx,
+                            {b: v.tobytes() for b, v in parts.items()},
+                            self._readopt_compute)
+                    self.carry_bytes.append(ch.bytes_received - rx0)
+                    total = fold_block_partials(
+                        {b: np.frombuffer(v, dtype="<f4")
+                         for b, v in all_parts.items()},
+                        self.n_blocks)
+                    variables, server_state, m = eng._twolevel_commit(
+                        variables, server_state,
+                        jax.numpy.asarray(total), agg_rng)
+                jax.block_until_ready(variables)
+                self._round_ctx = None
+                self.round_walls.append(time.perf_counter() - t0)
+                ch.export_byte_counters()
+                if self.ctx.rank == 0:
+                    # the commit barrier IS the admission point: the
+                    # snapshot ships the just-committed bits
+                    ch.admit_rejoins(
+                        round_idx + 1,
+                        lambda: self._snapshot_blob(
+                            round_idx + 1, variables, server_state),
+                        tag=self.run_tag)
+                if self.ctx.rank == 0 and (
+                        round_idx % cfg.frequency_of_the_test == 0
+                        or round_idx == rounds - 1):
+                    stats = eng.evaluate(variables)
+                    stats.update(round=round_idx,
+                                 train_loss=float(m["train_loss"]),
+                                 round_time=self.round_walls[-1])
+                    eng.metrics_history.append(stats)
+                    if logger is not None:
+                        logger.log(stats, step=round_idx)
+                    log.info("round %d: %s", round_idx, stats)
+                if self.on_round_end is not None:
+                    self.on_round_end(round_idx)
+        except Exception as e:
+            obs.dump_flight(f"multihost_elastic_error:"
+                            f"rank{self.ctx.rank}: {e!r}")
+            raise
+        finally:
+            self._round_ctx = None
+        return variables
+
+    def report(self, warmup_rounds: int = 0) -> dict:
+        rep = super().report(warmup_rounds)
+        ch = self._channel
+        events = list(ch.view_events) if ch is not None else []
+        lat = [e["latency_s"] for e in events if e.get("latency_s")]
+        rep.update({
+            "elastic": True,
+            "epoch": ch.view.epoch if ch is not None else 0,
+            "members": list(ch.view.members) if ch is not None else [],
+            "view_changes": len(events),
+            "view_change_latency_s": (float(np.mean(lat)) if lat
+                                      else 0.0),
+            "view_events": events,
+        })
+        return rep
 
 
 def variables_digest(variables) -> str:

@@ -237,13 +237,14 @@ MH_CASE = {
 }
 
 
-def _run_launcher(procs: int, cfg: dict, tmp_path, timeout: int = 300):
+def _run_launcher(procs: int, cfg: dict, tmp_path, timeout: int = 300,
+                  flags: tuple = ()):
     """Launch `procs` mh_worker ranks through the REAL launcher tool;
     returns ({rank: worker JSON doc}, completed_process)."""
-    path = tmp_path / f"mh_{procs}p.json"
+    path = tmp_path / f"mh_{procs}p_{abs(hash(flags))}.json"
     path.write_text(json.dumps(cfg))
     r = subprocess.run(
-        [sys.executable, LAUNCHER, "--procs", str(procs), "--",
+        [sys.executable, LAUNCHER, "--procs", str(procs), *flags, "--",
          sys.executable, "-m", "fedml_tpu.parallel.mh_worker",
          str(path)],
         env=MH_ENV, cwd=REPO, text=True, capture_output=True,
@@ -425,6 +426,472 @@ def test_hierarchical_host_mesh_virtual_silo_warns(caplog):
         make_hierarchical_host_mesh(silos=1)
     assert not any("VIRTUAL silos" in rec.message
                    for rec in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: elastic membership — epoch-numbered views, heartbeats,
+# deterministic block re-adoption, rejoin.  The channel-level tests run
+# fake byte-payload workers in threads (no jax compute): membership is
+# a socket protocol, and these pin its edges fast.  The launcher test
+# at the bottom is THE acceptance pin — a real 3-process elastic
+# cluster, a seeded kill, a respawned rejoiner, byte-identical commits.
+# ---------------------------------------------------------------------------
+
+def _evec(item: int, rnd: int) -> bytes:
+    return np.full(3, 100 * item + rnd, np.float32).tobytes()
+
+
+def _elastic_channel(rank, world, port, *, n_items, digest="cfg",
+                     timeout_s=30.0, connect_timeout_s=10.0,
+                     hb_timeout_s=1.0, rejoin=False):
+    from fedml_tpu.parallel.multihost import (ElasticChannel,
+                                              MultihostContext)
+    ctx = MultihostContext(rank=rank, world=world,
+                           coordinator=f"localhost:{port}")
+    return ElasticChannel(ctx, n_items=n_items, config_digest=digest,
+                          timeout_s=timeout_s,
+                          connect_timeout_s=connect_timeout_s,
+                          hb_interval_s=0.1, hb_timeout_s=hb_timeout_s,
+                          rejoin=rejoin)
+
+
+def test_cluster_view_deterministic_repartition():
+    """The item→owner map is a pure function of (members, n_items):
+    full membership reduces to the PR-13 contiguous tiling, any
+    survivor subset still covers every item exactly once, and every
+    rank derives the identical partition from the member list alone."""
+    from fedml_tpu.parallel.multihost import ClusterView
+    v = ClusterView(0, (0, 1, 2, 3), 8)
+    assert [v.assigned(r) for r in range(4)] == [
+        (0, 1), (2, 3), (4, 5), (6, 7)]       # the PR-13 tiling
+    for members in [(0,), (0, 2), (1, 3), (0, 1, 3), (2,)]:
+        vw = ClusterView(1, members, 8)
+        owners = [vw.owner_of(i) for i in range(8)]
+        assert set(owners) <= set(members)
+        covered = [i for m in members for i in vw.assigned(m)]
+        assert sorted(covered) == list(range(8)), (members, covered)
+        # pure function: a second view with the same members agrees
+        assert owners == [ClusterView(9, members, 8).owner_of(i)
+                          for i in range(8)]
+    with pytest.raises(ValueError, match="outside"):
+        ClusterView(0, (0,), 4).owner_of(4)
+
+
+def test_elastic_death_and_double_death_epochs_monotone():
+    """One rank dying mid-round triggers a view change and the
+    survivors re-adopt its items (the round still completes with ALL
+    items, byte-identical); BOTH peers dying in one round leaves the
+    coordinator to adopt everything.  Epochs only ever increase, the
+    obs epoch gauge/view-change counter move, and every completed
+    round's payload set is the full deterministic one."""
+    from fedml_tpu import obs
+    from fedml_tpu.parallel.multihost import free_port
+    port = free_port()
+    n_items, world, rounds = 6, 3, 4
+    vc0 = obs.counter("multihost_view_changes_total").value
+    results, errs = {}, []
+
+    def run_rank(r, die_after=None):
+        try:
+            ch = _elastic_channel(r, world, port, n_items=n_items)
+            if r == 0:
+                ch.wait_members()
+            try:
+                for rnd in range(rounds):
+                    if die_after is not None and rnd == die_after:
+                        ch.close()
+                        return
+                    parts = {b: _evec(b, rnd)
+                             for b in ch.view.assigned(r)}
+                    allp, view = ch.exchange(
+                        rnd, parts,
+                        lambda need, rnd=rnd: {b: _evec(b, rnd)
+                                               for b in need})
+                    assert set(allp) == set(range(n_items))
+                    assert all(allp[b] == _evec(b, rnd)
+                               for b in range(n_items))
+                    results.setdefault(r, []).append(
+                        (view.epoch, view.members))
+            finally:
+                if r == 0:
+                    results["events"] = list(ch.view_events)
+                ch.close()
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run_rank, args=(r,),
+                           kwargs={"die_after": {1: 2, 2: 3}.get(r)})
+          for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs
+    assert len(results[0]) == rounds     # the coordinator survives all
+    # round 2 lost rank 1 (epoch 1), round 3 lost rank 2 too (epoch 2,
+    # coordinator adopts every item)
+    assert results[0][-1] == (2, (0,))
+    epochs = [e["epoch"] for e in results["events"]]
+    assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs), (
+        f"epochs must be strictly monotone: {epochs}")
+    assert obs.counter("multihost_view_changes_total").value >= vc0 + 2
+    assert obs.gauge("multihost_epoch", rank="0").value == 2.0
+
+
+def test_elastic_death_during_view_change():
+    """A survivor dying WHILE a view change re-tasks it: rank 1 dies
+    mid-round, the VIEW re-asks rank 2, and rank 2 dies instead of
+    re-contributing — the coordinator must chain a second view change
+    and finish alone (every item still present)."""
+    from fedml_tpu.parallel.multihost import (_recv_msg, _send_msg,
+                                              free_port)
+    port = free_port()
+    n_items = 4
+    out, errs = {}, []
+
+    def coord():
+        try:
+            ch = _elastic_channel(0, 3, port, n_items=n_items,
+                                  timeout_s=15)
+            ch.wait_members()
+            try:
+                for rnd in range(2):
+                    parts = {b: _evec(b, rnd)
+                             for b in ch.view.assigned(0)}
+                    allp, view = ch.exchange(
+                        rnd, parts,
+                        lambda need, rnd=rnd: {b: _evec(b, rnd)
+                                               for b in need})
+                    assert set(allp) == set(range(n_items))
+                    out[rnd] = (view.epoch, view.members)
+                out["events"] = list(ch.view_events)
+            finally:
+                ch.close()
+        except Exception as e:
+            errs.append(("coord", e))
+
+    def rank1():
+        ch = _elastic_channel(1, 3, port, n_items=n_items)
+        allp, _ = ch.exchange(0, {b: _evec(b, 0)
+                                  for b in ch.view.assigned(1)}, None)
+        ch.close()                      # dead before round 1
+
+    def rank2_raw():
+        # hand-rolled worker: behaves normally until a VIEW arrives,
+        # then dies instead of computing its re-adopted items
+        import socket as sk
+        try:
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    data = sk.create_connection(("localhost", port),
+                                                timeout=1.0)
+                    break
+                except OSError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+            _send_msg(data, "hello", {"rank": 2, "role": "data",
+                                      "digest": "cfg"})
+            mtype, hdr, _, _ = _recv_msg(data)
+            assert mtype == "hello_ok", (mtype, hdr)
+            hb = sk.create_connection(("localhost", port), timeout=5.0)
+            _send_msg(hb, "hello", {"rank": 2, "role": "hb"})
+            stop = threading.Event()
+
+            def beat():
+                while not stop.is_set():
+                    try:
+                        _send_msg(hb, "hb", {})
+                    except OSError:
+                        return
+                    time.sleep(0.1)
+            threading.Thread(target=beat, daemon=True).start()
+            for rnd in range(2):
+                mine = [b for b in range(n_items)
+                        if b * 3 // n_items == 2]
+                _send_msg(data, "contrib",
+                          {"epoch": 0, "round": rnd,
+                           "blocks": mine},
+                          b"".join(_evec(b, rnd) for b in mine))
+                while True:
+                    mtype, hdr, payload, _ = _recv_msg(data)
+                    if mtype == "view":
+                        # the death-during-view-change moment
+                        stop.set()
+                        data.close()
+                        hb.close()
+                        return
+                    if mtype == "result":
+                        break
+        except Exception as e:
+            errs.append(("rank2", e))
+
+    ts = [threading.Thread(target=f) for f in (coord, rank1, rank2_raw)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(40)
+    assert not errs, errs
+    assert out[1][1] == (0,), f"coordinator did not finish alone: {out}"
+    epochs = [e["epoch"] for e in out["events"]]
+    assert epochs == [1, 2], epochs
+
+
+def test_elastic_heartbeat_detects_hung_rank_within_timeout():
+    """The SIGSTOP shape: a rank that connects, then goes silent
+    (paused heartbeats, no contribution) must be evicted within the
+    heartbeat timeout — NOT the full round timeout — and the suspicion
+    reason must say so.  Detection rides the heartbeat monitor, so a
+    hang is caught between allgathers, not only inside one."""
+    from fedml_tpu.parallel.multihost import free_port
+    port = free_port()
+    out, errs = {}, []
+    TIMEOUT_S = 30.0                     # the round budget a hung rank
+    #                                      must NOT consume
+
+    def coord():
+        try:
+            ch = _elastic_channel(0, 2, port, n_items=2,
+                                  timeout_s=TIMEOUT_S, hb_timeout_s=1.0)
+            ch.wait_members()
+            t0 = time.monotonic()
+            allp, view = ch.exchange(
+                0, {0: _evec(0, 0)},
+                lambda need: {b: _evec(b, 0) for b in need})
+            out["elapsed"] = time.monotonic() - t0
+            out["view"] = (view.epoch, view.members)
+            out["events"] = list(ch.view_events)
+            ch.close()
+        except Exception as e:
+            errs.append(e)
+
+    def hung_worker():
+        ch = _elastic_channel(1, 2, port, n_items=2)
+        ch.hb_paused = True              # the process "stops"
+        time.sleep(4.0)                  # hung, not dead: socket open
+        ch.close()
+
+    tw = threading.Thread(target=hung_worker, daemon=True)
+    tc = threading.Thread(target=coord)
+    tw.start()
+    tc.start()
+    tc.join(25)
+    assert not errs, errs
+    assert out["view"] == (1, (0,))
+    assert out["elapsed"] < TIMEOUT_S / 2, (
+        f"hung rank took {out['elapsed']:.1f}s to evict — the "
+        f"heartbeat detector should fire in ~1s, not the round "
+        f"timeout")
+    assert any("heartbeat" in e.get("reason", "")
+               or "hung" in e.get("reason", "")
+               for e in out["events"]), out["events"]
+    tw.join(15)
+
+
+def test_elastic_rejoin_snapshot_and_stale_digest_rejected():
+    """The rejoin handshake: a restarted rank presents the config
+    digest — a STALE digest is rejected BY NAME (both digests in the
+    error), a matching one is admitted at the next commit barrier with
+    the coordinator's snapshot + resume round + run tag, and the
+    rejoined rank finishes the remaining rounds as a member."""
+    from fedml_tpu.parallel.multihost import DeadRankError, free_port
+    port = free_port()
+    n_items, rounds = 2, 8
+    out, errs = {}, []
+
+    def coord():
+        try:
+            ch = _elastic_channel(0, 2, port, n_items=n_items,
+                                  timeout_s=20)
+            ch.wait_members()
+            for rnd in range(rounds):
+                parts = {b: _evec(b, rnd)
+                         for b in ch.view.assigned(0)}
+                allp, view = ch.exchange(
+                    rnd, parts,
+                    lambda need, rnd=rnd: {b: _evec(b, rnd)
+                                           for b in need})
+                admitted = ch.admit_rejoins(
+                    rnd + 1, lambda: b"snapshot@%d" % (rnd + 1),
+                    tag="streaming")
+                if admitted:
+                    out["admitted_at"] = rnd + 1
+                time.sleep(0.4)
+            out["events"] = list(ch.view_events)
+            ch.close()
+        except Exception as e:
+            errs.append(("coord", e))
+
+    def mortal():
+        ch = _elastic_channel(1, 2, port, n_items=n_items)
+        ch.exchange(0, {b: _evec(b, 0)
+                        for b in ch.view.assigned(1)}, None)
+        ch.close()
+
+    def stale_rejoiner():
+        time.sleep(0.8)
+        ch = _elastic_channel(1, 2, port, n_items=n_items,
+                              digest="STALE-DIGEST", rejoin=True)
+        with pytest.raises(DeadRankError) as ei:
+            ch.rejoin_handshake()
+        ch.close()
+        msg = str(ei.value)
+        assert "STALE-DIGEST" in msg and "cfg" in msg and "rank 1" in msg, (
+            f"stale rejoin must be rejected naming both digests: {msg}")
+        out["stale_named"] = True
+
+    def rejoiner():
+        try:
+            time.sleep(1.4)
+            ch = _elastic_channel(1, 2, port, n_items=n_items,
+                                  rejoin=True)
+            blob, resume, tag = ch.rejoin_handshake()
+            out["snapshot"] = blob
+            out["resume"] = resume
+            out["tag"] = tag
+            for rnd in range(resume, rounds):
+                allp, view = ch.exchange(
+                    rnd, {b: _evec(b, rnd)
+                          for b in ch.view.assigned(1)},
+                    lambda need, rnd=rnd: {b: _evec(b, rnd)
+                                           for b in need})
+                assert all(allp[b] == _evec(b, rnd)
+                           for b in range(n_items))
+            out["rejoined_rounds"] = rounds - resume
+            ch.close()
+        except Exception as e:
+            errs.append(("rejoiner", e))
+
+    ts = [threading.Thread(target=f)
+          for f in (coord, mortal, stale_rejoiner, rejoiner)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs
+    assert out.get("stale_named")
+    assert out["snapshot"] == b"snapshot@%d" % out["resume"]
+    assert out["tag"] == "streaming"
+    assert out["rejoined_rounds"] >= 1
+    # the admission is its own epoch bump, after the death's
+    epochs = [e["epoch"] for e in out["events"]]
+    assert epochs == sorted(epochs) and len(epochs) >= 2
+    assert any("rejoined" in e for e in out["events"])
+
+
+def test_dial_backoff_late_listener_and_named_failure():
+    """ISSUE-14 satellite: every transient connect path retries with
+    bounded exponential backoff inside its deadline — a listener that
+    appears late is reached, and a dead endpoint fails with a
+    DeadRankError NAMING the dial."""
+    import socket as sk
+
+    from fedml_tpu.parallel.multihost import (DeadRankError,
+                                              _dial_with_backoff,
+                                              free_port)
+    port = free_port()
+
+    def late_listener():
+        time.sleep(0.7)                 # refuse first, accept later
+        srv = sk.create_server(("localhost", port))
+        conn, _ = srv.accept()
+        conn.close()
+        srv.close()
+    t = threading.Thread(target=late_listener)
+    t.start()
+    s = _dial_with_backoff("localhost", port,
+                           time.monotonic() + 10.0, "late-dial test")
+    s.close()
+    t.join(10)
+    dead_port = free_port()
+    t0 = time.monotonic()
+    with pytest.raises(DeadRankError) as ei:
+        _dial_with_backoff("localhost", dead_port,
+                           time.monotonic() + 1.0,
+                           "worker 7 dialing the coordinator")
+    assert time.monotonic() - t0 < 5.0
+    assert "worker 7 dialing the coordinator" in str(ei.value)
+    assert "ConnectionRefusedError" in str(ei.value)
+
+
+def test_spawn_cluster_blame_names_every_rank():
+    """ISSUE-14 satellite: MultihostLaunchError carries a per-rank
+    outcome summary — exit codes for plain failures and SIGNAL NAMES
+    for signal deaths — so the chaos-killed rank reads differently
+    from the launcher-cleanup kills it causes."""
+    from fedml_tpu.parallel.multihost import (MultihostLaunchError,
+                                              spawn_cluster)
+    prog = ("import os, sys, time\n"
+            "r = int(os.environ['FEDML_MH_RANK'])\n"
+            "if r == 1:\n"
+            "    time.sleep(0.3); sys.exit(7)\n"
+            "time.sleep(30)\n")
+    with pytest.raises(MultihostLaunchError) as ei:
+        spawn_cluster([sys.executable, "-c", prog], 3, timeout_s=25,
+                      kill_grace_s=1.0)
+    msg = str(ei.value)
+    assert "rank 1/3 failed first" in msg
+    assert "rc=7" in msg
+    assert "per-rank:" in msg
+    assert "exit rc=7" in msg
+    assert "SIGKILL" in msg, (
+        f"launcher-cleanup kills must be signal-named: {msg}")
+    # respawn without elastic is a config error, named
+    with pytest.raises(ValueError, match="elastic"):
+        spawn_cluster([sys.executable, "-c", "pass"], 1, respawn=True)
+
+
+MH_ELASTIC_CLEAN = {
+    # tiny LR case, 3 blocks; local_devices=1 — the elastic pin is
+    # about MEMBERSHIP, the intra-host psum tier is pinned above
+    "clients": 12, "spc": 24, "dim": 8, "classes": 4, "k_per_round": 6,
+    "n_blocks": 3, "rounds": 7, "warmup": 0, "seed": 0,
+    "modes": ["streaming", "resident"], "local_devices": 1,
+    "elastic": True,
+}
+
+
+def test_elastic_kill_respawn_bitwise_pin(tmp_path):
+    """THE ISSUE-14 acceptance pin, launcher-spawned: a 3-process
+    ELASTIC run with a seeded kill of rank 1 mid-run (a) completes on
+    the survivors, (b) readmits the respawned rank 1 through the
+    rejoin handshake, and (c) commits models BYTE-IDENTICAL
+    (md5-over-leaf-bytes) to the clean same-partition run — FedAvg
+    resident AND streaming, on every rank including the rejoiner.
+    round_sleep_s paces the run so the respawn (a fresh jax boot)
+    rejoins deterministically inside the first (streaming) run."""
+    cfg = {**MH_ELASTIC_CLEAN, "die_rank": 1,
+           "die_at_round": 0, "round_sleep_s": 1.0,
+           "round_sleep_mode": "streaming",
+           "hb_timeout_s": 1.5, "channel_timeout_s": 60}
+    cleanb, r0b = _run_launcher(1, MH_ELASTIC_CLEAN, tmp_path)
+    assert r0b.returncode == 0, r0b.stderr[-3000:]
+    killed, r1 = _run_launcher(3, cfg, tmp_path, timeout=280,
+                               flags=("--elastic", "--respawn"))
+    assert r1.returncode == 0, (r1.stdout[-2000:], r1.stderr[-3000:])
+    assert set(killed) == {0, 1, 2}, (set(killed), r1.stderr[-3000:])
+    assert killed[1]["rejoined"] is True
+    # survivors: byte-identical to the clean same-partition run, BOTH
+    # residency modes
+    for mode in ("streaming", "resident"):
+        want = cleanb[0]["digests"][mode]
+        for r in (0, 2):
+            assert killed[r]["digests"][mode] == want, (
+                f"{mode}: rank {r} diverged after the kill — the "
+                f"elastic re-adoption broke the bitwise anchor")
+    # the rejoiner: resumes whichever run the coordinator was in when
+    # it booted (run-tag routed) — every mode it DID run must match,
+    # and it must have run at least one
+    assert killed[1]["digests"], "rejoiner reported no digests"
+    for mode, digest in killed[1]["digests"].items():
+        assert digest == cleanb[0]["digests"][mode], (
+            f"{mode}: the REJOINED rank diverged — the snapshot "
+            f"catch-up broke the bitwise anchor")
+    # the death AND the readmission each bumped the epoch
+    rep = killed[0]["per_mode"]["streaming"]
+    assert rep["view_changes"] >= 2, rep
+    assert rep["epoch"] >= 2, rep
+    assert "respawning once" in r1.stderr, r1.stderr[-2000:]
 
 
 def test_multihost_context_env_roundtrip(monkeypatch):

@@ -676,6 +676,75 @@ def test_serve_host_sharded_partition_commits_identically():
                       partition=(0, 2))
 
 
+def test_serve_elastic_readopts_dead_ranks_range():
+    """ISSUE 14: the host-sharded serve loop survives a rank death —
+    rank 2 of 3 vanishes mid-run (crash_at_commit closes its channel),
+    the survivors' next exchange evicts it (one view change), the
+    window where the death lands folds deterministic ZEROS for the
+    dead range, and at the next commit barrier the view's new owner
+    re-adopts the range as a fresh lane.  The survivors must finish
+    every commit with IDENTICAL committed_digest (they fold the same
+    allgathered bytes every window), host every range exactly once
+    between them, and report the adoption."""
+    import threading
+
+    from fedml_tpu.parallel.multihost import (ElasticChannel,
+                                              MultihostContext,
+                                              free_port)
+    port = free_port()
+    pop, world = 3072, 3
+    reports: dict = {}
+    errs: list = []
+
+    def rank(r):
+        try:
+            ctx = MultihostContext(rank=r, world=world,
+                                   coordinator=f"localhost:{port}")
+            ch = ElasticChannel(ctx, n_items=world,
+                                config_digest="serve-elastic",
+                                timeout_s=60, connect_timeout_s=30,
+                                hb_interval_s=0.1, hb_timeout_s=1.0)
+            try:
+                reports[r] = run_serve_sim(
+                    pop, commits=8, warmup_commits=1, buffer_k=8,
+                    row_dim=64,
+                    arrival=ArrivalConfig(mode="constant", rate=500.0,
+                                          seed=0),
+                    seed=0, partition=(r, world), channel=ch,
+                    elastic=True,
+                    crash_at_commit=3 if r == 2 else None)
+            finally:
+                ch.close()
+        except Exception as e:          # surfaced below, never hangs
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=rank, args=(r,))
+          for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=180)
+    assert not errs, errs
+    assert set(reports) == {0, 1, 2}
+    a, b, c = reports[0], reports[1], reports[2]
+    assert a["committed_digest"] == b["committed_digest"], (
+        "survivors committed different global mixes after the death")
+    assert a["commits"] == b["commits"] == 8
+    assert c["commits"] == 3 and c["elastic"]["crashed_at_commit"] == 3
+    # the dead range was re-adopted, and every range has EXACTLY one
+    # host among the survivors (no double-hosting)
+    hosted = sorted(a["elastic"]["lanes"] + b["elastic"]["lanes"])
+    assert hosted == [0, 1, 2], hosted
+    adopted = a["elastic"]["adopted_items"] + b["elastic"]["adopted_items"]
+    assert 2 in adopted, f"range 2 never re-adopted: {adopted}"
+    assert a["elastic"]["view_changes"] >= 1
+    assert a["elastic"]["epoch"] >= 1
+    # elastic=True without an ElasticChannel is a loud error
+    with pytest.raises(ValueError, match="ElasticChannel"):
+        run_serve_sim(100, commits=2, warmup_commits=1,
+                      partition=(0, 2), channel=object(), elastic=True)
+
+
 def test_serve_uniform_sampler_not_low_id_biased():
     """The legacy uniform draw is prefix-stable in k at a fixed round;
     the serve loop must advance the sampler round per DRAW, or every

@@ -440,9 +440,9 @@ def test_chip_queue_carries_conn_step():
     assert "profile_bench.py CONN" in src, (
         "run_chip_queue.sh lost the CONN live-connection reactor step "
         "(ISSUE 11 queues it for the next chip window)")
-    assert "13/15" in src, (
-        "run_chip_queue.sh lost the CONN step numbering (13/15 since "
-        "ISSUE 12 appended bench_diff and ISSUE 13 exp_POD)")
+    assert "13/16" in src, (
+        "run_chip_queue.sh lost the CONN step numbering (13/16 since "
+        "ISSUEs 12-14 appended bench_diff, exp_POD and exp_ELASTIC)")
     assert "exp_CONN" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
@@ -530,20 +530,83 @@ def test_bench_json_schema_v12_carries_multihost_block():
             f"{field} (the v12 acceptance gate)")
 
 
+def test_bench_json_schema_v13_carries_elastic_chaos_arm():
+    """ISSUE 14: schema v13 adds the elastic chaos arm to the
+    multihost block — survivor_goodput_ratio (>= 0.5x gate),
+    view-change latency/count, survivor_deaths and the
+    bitwise_after_death_ok pin — plus the elastic runtime it drives
+    (ElasticChannel membership/heartbeats/rejoin, ElasticRunner block
+    re-adoption, the spawn_cluster elastic/respawn launch policy) and
+    the chip-queue ELASTIC step.  Static source check like the v3-v12
+    guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 13, (
+        "bench schema must stay >= v13 (elastic chaos arm)")
+    for field in ("survivor_goodput_ratio", "bitwise_after_death_ok",
+                  "view_change_latency_s", "survivor_deaths",
+                  "mh_chaos_procs", "mh_arms"):
+        assert field in src, (
+            f"bench.py lost the v13 elastic-chaos field {field} "
+            "(see fedml_tpu/parallel/multihost.py ISSUE 14)")
+    base = os.path.join(os.path.dirname(__file__), "..")
+    mh = open(os.path.join(base, "fedml_tpu", "parallel",
+                           "multihost.py")).read()
+    for sym in ("class ElasticChannel", "class ElasticRunner",
+                "class ClusterView", "def spawn_cluster_report",
+                "def rejoin_handshake", "def admit_rejoins",
+                "def _dial_with_backoff"):
+        assert sym in mh, (
+            f"fedml_tpu/parallel/multihost.py lost {sym!r} — the "
+            "ISSUE-14 elastic runtime the v13 chaos arm drives")
+    # fail-fast must stay the DEFAULT launch policy
+    assert re.search(r"elastic:\s*bool\s*=\s*False", mh), (
+        "spawn_cluster's elastic policy must default OFF (fail-fast "
+        "kill-the-rest is the documented default)")
+    # bench_diff must judge the new fields
+    bd = open(os.path.join(base, "tools", "bench_diff.py")).read()
+    for field in ("survivor_goodput_ratio", "bitwise_after_death_ok",
+                  "survivor_deaths"):
+        assert field in bd, (
+            f"tools/bench_diff.py lost the elastic-chaos rule field "
+            f"{field} (the v13 acceptance gate)")
+    # serve-loop re-adoption + cli wiring
+    serve = open(os.path.join(base, "fedml_tpu", "scale",
+                              "serve.py")).read()
+    assert "_ServeLane" in serve and "elastic" in serve, (
+        "fedml_tpu/scale/serve.py lost the elastic lane re-adoption "
+        "(ISSUE 14 satellite)")
+    cli = open(os.path.join(base, "fedml_tpu", "cli.py")).read()
+    assert "--elastic" in cli and "ElasticRunner" in cli, (
+        "fedml_tpu/cli.py lost the --elastic wiring (fail-fast "
+        "default, elastic opt-in)")
+    # chip queue: the ELASTIC step + its experiment
+    queue = open(os.path.join(base, "scripts",
+                              "run_chip_queue.sh")).read()
+    assert "profile_bench.py ELASTIC" in queue and "16/16" in queue, (
+        "run_chip_queue.sh lost the 16/16 ELASTIC chaos step "
+        "(ISSUE 14 queues it for the next chip window)")
+    assert "exp_ELASTIC" in open(os.path.join(
+        base, "tools", "profile_bench.py")).read(), (
+        "profile_bench.py lost the exp_ELASTIC experiment the queue "
+        "runs")
+
+
 def test_chip_queue_carries_pod_step():
     """ISSUE 13: the next chip window must price the multi-host
     weak-scaling sweep on a real pod slice —
-    scripts/run_chip_queue.sh carries the POD step (15/15) and
-    profile_bench.py defines the exp_POD experiment it runs."""
+    scripts/run_chip_queue.sh carries the POD step (15/16 since
+    ISSUE 14 appended the ELASTIC arm as 16) and profile_bench.py
+    defines the exp_POD experiment it runs."""
     queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
                          "run_chip_queue.sh")
     src = open(queue).read()
     assert "profile_bench.py POD" in src, (
         "run_chip_queue.sh lost the POD multi-host weak-scaling sweep "
         "(ISSUE 13 queues it for the next chip window)")
-    assert "15/15" in src, (
-        "run_chip_queue.sh lost the 15/15 step numbering (exp_POD is "
-        "queue step 15)")
+    assert "15/16" in src, (
+        "run_chip_queue.sh lost the 15/16 step numbering (exp_POD is "
+        "queue step 15; ISSUE 14's exp_ELASTIC is 16)")
     assert "exp_POD" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
@@ -592,8 +655,9 @@ def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
 
 def test_chip_queue_carries_bench_diff_step():
     """ISSUE 12: the chip queue's judgment pass diffs the fresh bench
-    record against the committed trajectory (step 14/15 since ISSUE 13
-    appended exp_POD as 15), and the script stays shell-valid."""
+    record against the committed trajectory (step 14/16 since ISSUEs
+    13/14 appended exp_POD and exp_ELASTIC), and the script stays
+    shell-valid."""
     import subprocess
     queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
                          "run_chip_queue.sh")
@@ -601,10 +665,10 @@ def test_chip_queue_carries_bench_diff_step():
     assert "bench_diff.py" in src, (
         "run_chip_queue.sh lost the bench_diff regression step "
         "(ISSUE 12 appends it as the queue's judgment pass)")
-    assert "14/15" in src, (
-        "run_chip_queue.sh lost the 14/15 bench_diff step numbering "
+    assert "14/16" in src, (
+        "run_chip_queue.sh lost the 14/16 bench_diff step numbering "
         "(the judgment pass rides right after the bench artifacts; "
-        "exp_POD is 15)")
+        "exp_POD is 15, exp_ELASTIC 16)")
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr

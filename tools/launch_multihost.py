@@ -42,6 +42,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="also wire jax.distributed (FEDML_MH_JAX_COORD; "
                          "required on real pods, optional on CPU where "
                          "the HostChannel carries the cross-host tier)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic launch policy (ISSUE 14): a dead rank "
+                         "does NOT take the survivors down — only "
+                         "rank-0 (coordinator) death or the deadline "
+                         "fails the launch.  Pair with a worker that "
+                         "runs the elastic runtime (mh_worker "
+                         "'elastic': true / cli --elastic); fail-fast "
+                         "kill-the-rest stays the default")
+    ap.add_argument("--respawn", action="store_true",
+                    help="with --elastic: relaunch a dead rank ONCE "
+                         "with FEDML_MH_REJOIN=1 so it re-enters the "
+                         "cluster through the rejoin handshake")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command (prefix with --)")
     args = ap.parse_args(argv)
@@ -57,6 +69,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                  "`-- python -m fedml_tpu.parallel.mh_worker cfg.json`)")
     if args.timeout <= 0:
         ap.error(f"--timeout must be > 0, got {args.timeout}")
+    if args.respawn and not args.elastic:
+        ap.error("--respawn needs --elastic (a fail-fast cluster kills "
+                 "the survivors the rejoiner would rejoin)")
     args.cmd = cmd
     return args
 
@@ -69,6 +84,8 @@ def main(argv=None) -> int:
         outs = spawn_cluster(args.cmd, args.procs,
                              timeout_s=args.timeout,
                              jax_distributed=args.jax_distributed,
+                             elastic=args.elastic,
+                             respawn=args.respawn,
                              echo=True)
     except MultihostLaunchError as e:
         print(f"launch_multihost: {e}", file=sys.stderr)

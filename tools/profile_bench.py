@@ -1207,6 +1207,32 @@ def exp_POD():
                          f"(rc={r.returncode})")
 
 
+def exp_ELASTIC():
+    """Elastic-chaos arm chip-attached (ISSUE 14): `bench.py --mode
+    multihost --mh_arms chaos` — a 3-process ELASTIC cluster (one per
+    host/slice; FEDML_POD_ELASTIC_PROCS overrides) with a seeded kill
+    of rank 1 mid-run vs the clean elastic run.  Gates: the survivors
+    FINISH (zero survivor deaths — the elastic launch policy + view
+    change + block re-adoption), survivor goodput >= 0.5x clean, and
+    bitwise_after_death_ok — the re-adopted blocks commit the same
+    bits, because every block partial is a pure function of [seed,
+    round, block].  On chips this also prices view-change latency on
+    real DCN heartbeat/detection paths instead of loopback."""
+    import subprocess
+    procs = os.environ.get("FEDML_POD_ELASTIC_PROCS", "3")
+    bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "bench.py")
+    r = subprocess.run(
+        [sys.executable, bench, "--mode", "multihost",
+         "--mh_arms", "chaos", "--mh_chaos_procs", procs],
+        text=True, capture_output=True, timeout=3600)
+    sys.stderr.write(r.stderr)
+    print(r.stdout, flush=True)
+    if r.returncode != 0:
+        raise SystemExit(f"exp_ELASTIC: bench.py --mode multihost "
+                         f"--mh_arms chaos failed (rc={r.returncode})")
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
