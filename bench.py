@@ -132,6 +132,22 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the weak-scaling arms above still run non-elastic).  --mh_arms
 #     selects weak/bitwise/chaos subsets; v12 readers that ignore
 #     unknown keys keep working
+# v14: the "multihost" block gains the "compress" arm (ISSUE 16 —
+#     fedml_tpu/parallel/carry_codec.py + the overlapped exchange in
+#     multihost.py): paired 2-process clusters at the SAME block
+#     partition price the compressed inter-host carry tier — an f32
+#     serial baseline, the f32+overlap escape-hatch run (digests must
+#     be byte-identical to serial: bitwise_f32_escape_ok), and one row
+#     per compressed codec (int8, int8_ef; overlap on, eval on)
+#     carrying carry_wire_bytes_per_round (measured ON the wire via
+#     the channel's per-round delta, not inferred host-side),
+#     carry_compression_ratio (raw f32 bytes / encoded payload),
+#     wire_reduction_vs_f32 (>= 3x gate rides bench_diff),
+#     overlap_fraction (> 0 when the DCN exchange hides behind block
+#     compute), eval_acc + acc_delta_vs_f32 (abs; the quality band),
+#     and efficiency_at_constant_bytes ((rps_codec/rps_f32) x
+#     wire_reduction — rounds per byte-budget).  --mh_arms grows
+#     "compress"; v13 readers that ignore unknown keys keep working
 # v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
 #     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
 #     attack x defense arms on the async MNIST-LR workload (each row:
@@ -144,7 +160,7 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 
 
 # the programs block's window opens when main() configures obs (set
@@ -430,13 +446,16 @@ def main() -> None:
     ap.add_argument("--mh_seed", type=int, default=0,
                     help="multihost mode: workload seed (same seed = "
                          "same cohorts = the bitwise pin's premise)")
-    ap.add_argument("--mh_arms", default="weak,bitwise,chaos",
+    ap.add_argument("--mh_arms", default="weak,bitwise,chaos,compress",
                     help="multihost mode: comma-subset of "
-                         "{weak,bitwise,chaos} — weak = the v12 "
-                         "weak-scaling sweep, bitwise = the "
+                         "{weak,bitwise,chaos,compress} — weak = the "
+                         "v12 weak-scaling sweep, bitwise = the "
                          "1p-vs-2p digest pin, chaos = the v13 elastic "
                          "kill-a-rank arm (survivor goodput + "
-                         "bitwise_after_death_ok)")
+                         "bitwise_after_death_ok), compress = the v14 "
+                         "compressed+overlapped carry tier (bytes on "
+                         "the wire, quality band, f32 escape-hatch "
+                         "bitwise pin)")
     ap.add_argument("--mh_chaos_procs", type=int, default=3,
                     help="multihost chaos arm: elastic cluster size "
                          "(rank 1 is killed mid-run; the survivors "
@@ -1428,10 +1447,11 @@ def _bench_multihost(args) -> None:
         raise SystemExit(f"--mh_rounds ({args.mh_rounds}) must exceed "
                          f"--mh_warmup ({args.mh_warmup})")
     arms = {a.strip() for a in str(args.mh_arms).split(",") if a.strip()}
-    bad_arms = arms - {"weak", "bitwise", "chaos"}
+    bad_arms = arms - {"weak", "bitwise", "chaos", "compress"}
     if bad_arms or not arms:
         raise SystemExit(f"--mh_arms must be a non-empty subset of "
-                         f"weak,bitwise,chaos; got {args.mh_arms!r}")
+                         f"weak,bitwise,chaos,compress; got "
+                         f"{args.mh_arms!r}")
     if args.mh_chaos_procs < 2:
         raise SystemExit(f"--mh_chaos_procs must be >= 2 (someone has "
                          f"to die AND someone has to survive), got "
@@ -1624,6 +1644,106 @@ def _bench_multihost(args) -> None:
             chaos = {"error": str(e), "survivor_deaths": None,
                      "bitwise_after_death_ok": False}
 
+    # v14 compress arm (ISSUE 16): price the compressed + overlapped
+    # carry tier against the f32 serial baseline at the SAME block
+    # partition (2 processes, 2 blocks).  Four spawned clusters:
+    #   f32 serial   — the PR-13 wire bytes and digest baseline
+    #   f32 +overlap — the escape hatch MUST stay byte-identical to
+    #                  serial (overlap reorders nothing: frames
+    #                  concatenate in global block order)
+    #   int8 / int8_ef +overlap — the compressed rows; wire bytes are
+    #                  the CHANNEL's per-round delta (measured on the
+    #                  wire), accuracy rides eval at rank 0
+    compress = None
+    if "compress" in arms:
+        def _wire_b(docs):
+            return max(docs[r]["carry_wire_sent_bytes_per_round"]
+                       for r in docs)
+
+        try:
+            ev = {"eval": True}
+            f32_docs, _ = run_arm(2, 2, args.mh_rounds, ["streaming"],
+                                  extra_cfg=ev)
+            f32_ov_docs, _ = run_arm(
+                2, 2, args.mh_rounds, ["streaming"],
+                extra_cfg={**ev, "carry_codec": "f32",
+                           "overlap_exchange": True})
+            escape_ok = all(
+                f32_ov_docs[r]["digests"] == f32_docs[0]["digests"]
+                for r in f32_ov_docs)
+            f32_rps = f32_docs[0]["rounds_per_sec"]
+            f32_wire = _wire_b(f32_docs)
+            f32_acc = f32_docs[0].get("eval", {}).get("streaming")
+            codec_rows = []
+            for codec in ("int8", "int8_ef"):
+                docs, _ = run_arm(
+                    2, 2, args.mh_rounds, ["streaming"],
+                    extra_cfg={**ev, "carry_codec": codec,
+                               "overlap_exchange": True})
+                d0 = docs[0]
+                wire = _wire_b(docs)
+                rps = d0["rounds_per_sec"]
+                acc = d0.get("eval", {}).get("streaming")
+                reduction = (round(f32_wire / wire, 4)
+                             if wire > 0 else None)
+                crow = {
+                    "codec": codec,
+                    "rounds_per_sec": round(rps, 4),
+                    "carry_wire_bytes_per_round": round(wire, 1),
+                    "carry_payload_bytes_per_round": round(
+                        d0["carry_payload_bytes_per_round"], 1),
+                    "carry_raw_bytes_per_round": round(
+                        d0["carry_raw_bytes_per_round"], 1),
+                    "carry_compression_ratio": round(
+                        d0["carry_compression_ratio"], 4),
+                    "wire_reduction_vs_f32": reduction,
+                    "overlap_fraction": round(
+                        d0["overlap_fraction"], 4),
+                    "ranks_agree": all(
+                        docs[r]["digests"] == d0["digests"]
+                        for r in docs),
+                    "eval_acc": (round(acc, 4)
+                                 if acc is not None else None),
+                    "acc_delta_vs_f32": (
+                        round(abs(acc - f32_acc), 4)
+                        if acc is not None and f32_acc is not None
+                        else None),
+                    "efficiency_at_constant_bytes": (
+                        round((rps / f32_rps) * reduction, 4)
+                        if f32_rps > 0 and reduction else None),
+                }
+                codec_rows.append(crow)
+                print(f"multihost compress {codec}: "
+                      f"{crow['carry_wire_bytes_per_round']:.0f} "
+                      f"B/round on the wire "
+                      f"({crow['wire_reduction_vs_f32']}x vs f32), "
+                      f"overlap {crow['overlap_fraction']}, "
+                      f"acc_delta {crow['acc_delta_vs_f32']}",
+                      file=sys.stderr)
+            compress = {
+                "procs": 2,
+                "rounds": args.mh_rounds,
+                "f32_rounds_per_sec": round(f32_rps, 4),
+                "f32_wire_bytes_per_round": round(f32_wire, 1),
+                "f32_eval_acc": (round(f32_acc, 4)
+                                 if f32_acc is not None else None),
+                "f32_overlap_fraction": round(
+                    f32_ov_docs[0]["overlap_fraction"], 4),
+                "bitwise_f32_escape_ok": bool(escape_ok),
+                "codecs": codec_rows,
+            }
+            print(f"multihost f32 escape hatch under overlap: "
+                  f"{'OK' if escape_ok else 'MISMATCH'} (overlap "
+                  f"fraction "
+                  f"{compress['f32_overlap_fraction']})",
+                  file=sys.stderr)
+        except MultihostLaunchError as e:
+            print(f"multihost compress arm FAILED: {e}",
+                  file=sys.stderr)
+            deaths_total += 1
+            compress = {"error": str(e),
+                        "bitwise_f32_escape_ok": False}
+
     head = (rows[-1] if rows and "error" not in rows[-1] else
             (base or (rows[-1] if rows else {})))
     doc = _stamp({
@@ -1647,6 +1767,7 @@ def _bench_multihost(args) -> None:
             "weak_efficiency_4p": _eff(4),
             "bitwise_2proc_ok": bitwise_ok,
             "chaos": chaos,
+            "compress": compress,
             "process_deaths": deaths_total,
             "k_per_block": args.mh_k_per_block,
             "clients_per_block": args.mh_clients_per_block,

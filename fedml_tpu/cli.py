@@ -421,6 +421,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "a rank is suspected hung (the SIGSTOP "
                         "detector; detection runs between allgathers, "
                         "not only inside one)")
+    p.add_argument("--carry_codec", type=str, default="f32",
+                   choices=("f32", "int8", "int8_ef"),
+                   help="multihost: wire codec for the inter-host carry "
+                        "(ISSUE 16). f32 (default) is the bitwise "
+                        "escape hatch — bytes identical to the PR-13/14 "
+                        "tier; int8 is per-chunk affine fixed-point "
+                        "(~4x fewer bytes); int8_ef adds per-block "
+                        "error-feedback residuals so the SUM over "
+                        "rounds converges to the true sum")
+    p.add_argument("--overlap_exchange", action="store_true",
+                   help="multihost: ship each block's encoded carry as "
+                        "soon as it is computed so the DCN exchange "
+                        "overlaps the remaining blocks' compute "
+                        "(AsyncValue send chain). Commits are "
+                        "bitwise-identical to the serial exchange at "
+                        "the same codec — frames concatenate in the "
+                        "same global block order")
     p.add_argument("--group_num", type=int, default=2,
                    help="hierarchical: silo count")
     p.add_argument("--group_comm_round", type=int, default=2)
@@ -1235,12 +1252,16 @@ def main(argv: Optional[list[str]] = None) -> int:
             # elastic membership: view changes + block re-adoption on
             # rank death, rejoin on respawn; fail-fast stays the
             # default below
-            mh_runner = ElasticRunner(eng, mh_ctx,
-                                      n_blocks=args.agg_blocks,
-                                      hb_timeout_s=args.hb_timeout_s)
+            mh_runner = ElasticRunner(
+                eng, mh_ctx, n_blocks=args.agg_blocks,
+                hb_timeout_s=args.hb_timeout_s,
+                carry_codec=args.carry_codec,
+                overlap_exchange=args.overlap_exchange)
         else:
-            mh_runner = MultihostRunner(eng, mh_ctx,
-                                        n_blocks=args.agg_blocks)
+            mh_runner = MultihostRunner(
+                eng, mh_ctx, n_blocks=args.agg_blocks,
+                carry_codec=args.carry_codec,
+                overlap_exchange=args.overlap_exchange)
 
     run_params = inspect.signature(eng.run).parameters
     engine_logs = "logger" in run_params

@@ -163,6 +163,31 @@ def _bf16_dtype() -> np.dtype:
     return np.dtype(ml_dtypes.bfloat16)
 
 
+# -- the v2 int8/affine fixed-point discipline -------------------------------
+# Shared by the per-tensor wire transport below AND the carry codec
+# (parallel/carry_codec.py): ONE definition of the quantization math so
+# the dequant prologue on every consumer agrees bit-for-bit with the
+# encoder.  scale/min may be scalars (per-tensor) or arrays broadcast
+# per element (per-chunk).
+
+def affine_int8_scale(mn, mx):
+    """Affine scale for [mn, mx] → 255 int8 steps; 1.0 for a degenerate
+    (constant) range so encode/decode stay finite."""
+    return (mx - mn) / 255.0 or 1.0
+
+
+def affine_int8_encode(a: np.ndarray, mn, scale) -> np.ndarray:
+    """q = round((x - min)/scale) - 128, clipped to int8 — f64 math so
+    every host quantizes identically regardless of simd path."""
+    return np.clip(np.rint((a.astype(np.float64) - mn) / scale) - 128,
+                   -128, 127).astype(np.int8)
+
+
+def affine_int8_decode(q: np.ndarray, mn, scale, dtype=np.float32):
+    """Exact inverse placement: x̂ = (q + 128)·scale + min, f64 math."""
+    return ((q.astype(np.float64) + 128.0) * scale + mn).astype(dtype)
+
+
 class MessageCodec:
     """Binary wire format: magic ‖ header length ‖ JSON header ‖ buffers.
 
@@ -258,9 +283,8 @@ class MessageCodec:
             return a
         mn = float(np.min(a))
         mx = float(np.max(a))
-        scale = (mx - mn) / 255.0 or 1.0
-        q = np.clip(np.rint((a.astype(np.float64) - mn) / scale) - 128,
-                    -128, 127).astype(np.int8)
+        scale = affine_int8_scale(mn, mx)
+        q = affine_int8_encode(a, mn, scale)
         m["dtype"] = "int8"
         m["enc"] = {"kind": "int8", "orig": str(a.dtype),
                     "scale": scale, "min": mn}
@@ -274,8 +298,7 @@ class MessageCodec:
         if enc["kind"] == "bf16":
             return a.astype(orig)
         if enc["kind"] == "int8":
-            return ((a.astype(np.float64) + 128.0) * enc["scale"]
-                    + enc["min"]).astype(orig)
+            return affine_int8_decode(a, enc["min"], enc["scale"], orig)
         raise ValueError(f"unknown wire transport encoding "
                          f"{enc.get('kind')!r}")
 

@@ -30,6 +30,12 @@ ISSUE 14 (elastic) keys:
     SIGSTOP shape; the coordinator must evict it via heartbeat timeout
     and the evicted rank exits rc=4 when it wakes into a closed
     channel), hb_timeout_s/hb_interval_s (elastic failure detector).
+
+ISSUE 16 (compressed carry) keys:
+    carry_codec ("f32" default escape hatch | "int8" | "int8_ef"),
+    carry_chunk (f32 elements per quantization scale), and
+    overlap_exchange (bool: pipeline each block's encoded carry under
+    the remaining blocks' compute).
 """
 import json
 import os
@@ -45,6 +51,12 @@ DEFAULTS = {
     "elastic": False, "hang_rank": None, "hang_at_round": None,
     "hang_s": 20.0, "hb_timeout_s": 2.0, "hb_interval_s": 0.25,
     "round_sleep_s": 0.0, "round_sleep_mode": None,
+    # ISSUE 16: compressed + overlapped carry exchange.  carry_codec
+    # f32|int8|int8_ef (f32 = the bitwise escape hatch), carry_chunk =
+    # f32 elements per quantization scale, overlap_exchange pipelines
+    # each block's encoded carry under the remaining blocks' compute
+    "carry_codec": "f32", "carry_chunk": None,
+    "overlap_exchange": False,
 }
 
 
@@ -222,6 +234,9 @@ def main(argv=None) -> int:
         for mi, mode in enumerate(modes):
             current_mode["mode"] = mode
             engine = make_engine(streaming=(mode == "streaming"))
+            codec_kw = {"carry_codec": cfg["carry_codec"],
+                        "carry_chunk": cfg["carry_chunk"],
+                        "overlap_exchange": cfg["overlap_exchange"]}
             if cfg["elastic"]:
                 runner = ElasticRunner(
                     engine, ctx, n_blocks=n_blocks, channel=channel,
@@ -229,12 +244,12 @@ def main(argv=None) -> int:
                     hb_interval_s=cfg["hb_interval_s"],
                     hb_timeout_s=cfg["hb_timeout_s"],
                     run_tag=mode,
-                    on_round_end=on_round_end)
+                    on_round_end=on_round_end, **codec_kw)
             else:
                 runner = MultihostRunner(
                     engine, ctx, n_blocks=n_blocks, channel=channel,
                     timeout_s=cfg["channel_timeout_s"],
-                    on_round_end=on_round_end)
+                    on_round_end=on_round_end, **codec_kw)
             t0 = time.perf_counter()
             try:
                 if cfg["elastic"]:
@@ -270,6 +285,11 @@ def main(argv=None) -> int:
         out["rounds_per_sec"] = out["per_mode"][head]["rounds_per_sec"]
         out["carry_allreduce_bytes_per_round"] = \
             out["per_mode"][head]["carry_allreduce_bytes_per_round"]
+        for k in ("carry_codec", "carry_compression_ratio",
+                  "carry_wire_sent_bytes_per_round",
+                  "carry_payload_bytes_per_round",
+                  "carry_raw_bytes_per_round", "overlap_fraction"):
+            out[k] = out["per_mode"][head][k]
         out["jax"] = jax.__version__
         print(json.dumps(out), flush=True)
     finally:

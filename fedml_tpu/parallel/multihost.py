@@ -577,6 +577,57 @@ def _export_channel_byte_counters(rank: int, bytes_sent: int,
     recv.inc(max(0.0, bytes_received - recv.value))
 
 
+def _account_carry(raw: int, wire: int) -> None:
+    """Carry-codec compression accounting (ISSUE 16), mirroring the
+    comm layer's MessageCodec._account: raw = the f32 bytes of the
+    carry partials this rank encoded, wire = the encoded payload it
+    shipped; the gauge is the cumulative raw/wire quotient."""
+    c_raw = obs.counter("multihost_carry_raw_bytes_total")
+    c_wire = obs.counter("multihost_carry_compressed_bytes_total")
+    c_raw.inc(raw)
+    c_wire.inc(wire)
+    if c_wire.value > 0:
+        obs.gauge("multihost_carry_compression_ratio").set(
+            c_raw.value / c_wire.value)
+
+
+class _GatherHandle:
+    """In-flight state of ONE pipelined carry gather (ISSUE 16): rank 0
+    carries the background frame collector, workers the chained
+    frame-push tail; gather_finish() consumes it.  One handle per
+    collective — never reused."""
+
+    __slots__ = ("n_frames", "deadline", "seq", "own", "pending",
+                 "collector", "pushed", "aborted")
+
+    def __init__(self, n_frames: int, deadline: float, seq: int):
+        self.n_frames = int(n_frames)
+        self.deadline = float(deadline)
+        self.seq = int(seq)
+        self.own: list[bytes] = []
+        self.pending = None
+        self.collector = None
+        self.pushed = 0
+        self.aborted = False
+
+
+class _ContribHandle:
+    """In-flight early contributions of one elastic exchange (ISSUE
+    16): workers chain per-block contrib sends (the coordinator's
+    multi-contrib protocol already accepts them), rank 0 stashes its
+    own blocks locally; ElasticChannel.exchange(pending=...) drains the
+    handle.  Stale handles are harmless — the coordinator drops
+    contribs whose round header does not match the round in flight."""
+
+    __slots__ = ("round_idx", "blocks", "stash", "pending")
+
+    def __init__(self, round_idx: int):
+        self.round_idx = int(round_idx)
+        self.blocks: list[int] = []
+        self.stash: dict[int, bytes] = {}
+        self.pending = None
+
+
 class HostChannel:
     """Small-payload allgather/barrier between the cluster's processes —
     the inter-host (DCN) tier of the two-level aggregation, carrying the
@@ -600,6 +651,7 @@ class HostChannel:
         self.timeout_s = float(timeout_s)
         self.bytes_sent = 0
         self.bytes_received = 0
+        self._mark = (0, 0)
         self._seq = 0
         self._peers: dict[int, socket.socket] = {}
         self._sock: Optional[socket.socket] = None
@@ -738,6 +790,170 @@ class HostChannel:
 
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         self.allgather(b"", timeout_s=timeout_s)
+
+    # -- per-round wire accounting -------------------------------------------
+    def mark_round(self) -> None:
+        """Open a per-round wire window (ISSUE 16 satellite): the
+        compressed arm's bytes-per-round is what the CHANNEL moved
+        between mark_round() and round_wire_delta(), not a host-side
+        re-derivation of what it should have moved."""
+        self._mark = (self.bytes_sent, self.bytes_received)
+
+    def round_wire_delta(self) -> dict[str, int]:
+        s0, r0 = self._mark
+        return {"sent": self.bytes_sent - s0,
+                "received": self.bytes_received - r0}
+
+    # -- pipelined gather (compute/DCN overlap, ISSUE 16) --------------------
+    def gather_begin(self, n_frames: int,
+                     timeout_s: Optional[float] = None) -> _GatherHandle:
+        """Open a pipelined allgather of `n_frames` frames per rank:
+        each rank pushes frames as they materialize (gather_push) and
+        the collective completes in gather_finish() — frame j's wire
+        transfer overlaps frame j+1's block compute instead of
+        serializing behind the whole payload.  Equivalent by
+        construction to allgather(b"".join(frames)): the per-rank
+        frames concatenate in push order (the deterministic owned-block
+        order), and the broadcast blob is identical — which is why the
+        f32 escape hatch stays bitwise under overlap."""
+        timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        self._seq += 1
+        h = _GatherHandle(n_frames, time.monotonic() + timeout, self._seq)
+        if self.ctx.world > 1 and self.ctx.rank == 0 and h.n_frames:
+            from fedml_tpu.parallel.prefetch import AsyncValue
+            h.collector = AsyncValue(self._collect_frames, h,
+                                     name=f"gather#{h.seq}")
+        return h
+
+    def _collect_frames(self, h: _GatherHandle) -> dict[int, list[bytes]]:
+        """Rank 0's background collector: drain every peer's frames in
+        per-peer FIFO order while rank 0's own blocks compute.  Runs on
+        the gather handle's AsyncValue thread; joined in
+        gather_finish() (errors re-raise there)."""
+        remaining = {r: h.n_frames for r in self._peers}
+        frames: dict[int, list[bytes]] = {r: [] for r in self._peers}
+        by_sock = {s: r for r, s in self._peers.items()}
+        while any(remaining.values()) and not h.aborted:
+            budget = h.deadline - time.monotonic()
+            if budget <= 0:
+                owing = sorted(r for r, n in remaining.items() if n)
+                raise DeadRankError(
+                    f"multihost gather #{h.seq}: rank(s) {owing} still "
+                    f"owe carry frames at the deadline (process dead, "
+                    f"hung, or its block compute overran the window)")
+            socks = [self._peers[r] for r, n in remaining.items() if n]
+            try:
+                rl, _, _ = select.select(socks, [], [], min(0.2, budget))
+            except (OSError, ValueError):
+                rl = []          # a sock closed under us: deadline names it
+            for s in rl:
+                r = by_sock[s]
+                s.settimeout(max(0.001, h.deadline - time.monotonic()))
+                try:
+                    f = _recv_frame(s)
+                except (socket.timeout, ConnectionError, OSError) as e:
+                    raise DeadRankError(
+                        f"multihost gather #{h.seq}: rank {r} died "
+                        f"mid-frame ({type(e).__name__})") from e
+                self.bytes_received += len(f)
+                frames[r].append(f)
+                remaining[r] -= 1
+        return frames
+
+    def gather_push(self, h: _GatherHandle, frame: bytes) -> None:
+        """Ship one frame into an open gather.  Rank 0 stashes locally
+        (its frames never cross the wire); workers chain the send onto
+        the previous push's AsyncValue so socket writes serialize while
+        the caller returns to computing the next block."""
+        h.pushed += 1
+        if self.ctx.world <= 1 or self.ctx.rank == 0:
+            h.own.append(bytes(frame))
+            return
+        from fedml_tpu.parallel.prefetch import AsyncValue
+
+        prev = h.pending
+
+        def _ship(prev=prev, frame=frame):
+            if prev is not None:
+                prev.result()
+            self._sock.settimeout(max(0.001,
+                                      h.deadline - time.monotonic()))
+            try:
+                _send_frame(self._sock, frame)
+            except (socket.timeout, ConnectionError, OSError) as e:
+                raise DeadRankError(
+                    f"multihost gather #{h.seq}: rank {self.ctx.rank} "
+                    f"could not ship a carry frame to the rank-0 "
+                    f"coordinator ({type(e).__name__})") from e
+            self.bytes_sent += len(frame) + 8
+
+        h.pending = AsyncValue(_ship, name=f"gather_push#{h.seq}")
+
+    def gather_finish(self, h: _GatherHandle) -> list[bytes]:
+        """Complete the collective: returns the rank-ordered list of
+        per-rank payloads (each rank's frames concatenated in push
+        order) — the same shape allgather returns."""
+        ctx = self.ctx
+        if ctx.world <= 1:
+            return [b"".join(h.own)]
+        if h.pushed != h.n_frames:
+            raise ValueError(
+                f"multihost gather #{h.seq}: {h.pushed} frames pushed "
+                f"but {h.n_frames} promised — the collective would "
+                f"hang every peer")
+        if ctx.rank == 0:
+            parts: list[bytes] = [b""] * ctx.world
+            parts[0] = b"".join(h.own)
+            frames = (h.collector.result() if h.collector is not None
+                      else {r: [] for r in self._peers})
+            for r, fl in frames.items():
+                parts[r] = b"".join(fl)
+            blob = struct.pack("<I", ctx.world) + b"".join(
+                struct.pack("<Q", len(p)) + p for p in parts)
+            for r in sorted(self._peers):
+                try:
+                    self._peers[r].settimeout(
+                        max(0.001, h.deadline - time.monotonic()))
+                    _send_frame(self._peers[r], blob)
+                except (socket.timeout, ConnectionError, OSError) as e:
+                    raise DeadRankError(
+                        f"multihost gather #{h.seq}: broadcast to rank "
+                        f"{r} failed ({type(e).__name__}: rank died "
+                        f"after contributing)") from e
+                self.bytes_sent += len(blob) + 8
+            return parts
+        if h.pending is not None:
+            h.pending.result()           # drain the push tail first
+        self._sock.settimeout(max(0.001, h.deadline - time.monotonic()))
+        try:
+            blob = _recv_frame(self._sock)
+        except (socket.timeout, ConnectionError, OSError) as e:
+            raise DeadRankError(
+                f"multihost gather #{h.seq}: rank {ctx.rank} got no "
+                f"broadcast from the rank-0 coordinator "
+                f"({type(e).__name__}: coordinator dead, or a peer "
+                f"stalled it)") from e
+        self.bytes_received += len(blob)
+        (world,) = struct.unpack_from("<I", blob, 0)
+        off, parts = 4, []
+        for _ in range(world):
+            (n,) = struct.unpack_from("<Q", blob, off)
+            off += 8
+            parts.append(blob[off:off + n])
+            off += n
+        return parts
+
+    def gather_abort(self, h: _GatherHandle) -> None:
+        """Invalidate an in-flight gather on the error path: the
+        collector exits at its next poll instead of camping on the
+        deadline, and the push tail is drained best-effort."""
+        h.aborted = True
+        for av in (h.pending, h.collector):
+            if av is not None:
+                try:
+                    av.result()
+                except Exception:
+                    pass
 
     def export_byte_counters(self) -> None:
         _export_channel_byte_counters(self.ctx.rank, self.bytes_sent,
@@ -928,6 +1144,7 @@ class ElasticChannel:
         self.hb_timeout_s = float(hb_timeout_s)
         self.bytes_sent = 0
         self.bytes_received = 0
+        self._mark = (0, 0)
         self.view = ClusterView(0, tuple(range(ctx.world)), self.n_items)
         self.view_events: list[dict] = []
         self.hb_paused = False          # fault-injection hook: a paused
@@ -978,6 +1195,20 @@ class ElasticChannel:
         with self._io_lock:
             self.bytes_received += n
         return mtype, hdr, payload
+
+    # -- per-round wire accounting -------------------------------------------
+    def mark_round(self) -> None:
+        """Open a per-round wire window (ISSUE 16 satellite) — same
+        contract as HostChannel.mark_round, under the io lock because
+        the heartbeat/accept threads bump the counters concurrently."""
+        with self._io_lock:
+            self._mark = (self.bytes_sent, self.bytes_received)
+
+    def round_wire_delta(self) -> dict[str, int]:
+        with self._io_lock:
+            s0, r0 = self._mark
+            return {"sent": self.bytes_sent - s0,
+                    "received": self.bytes_received - r0}
 
     # -- worker side ---------------------------------------------------------
     def _connect_worker(self) -> None:
@@ -1264,26 +1495,78 @@ class ElasticChannel:
                     f"expected {self._item_nbytes} (config skew or a "
                     f"truncated frame)")
 
+    def contrib_begin(self, round_idx: int) -> _ContribHandle:
+        """Open an early-contribution window for `round_idx` (the
+        overlap path): blocks pushed through contrib_push ship while
+        the remaining blocks still compute, and exchange(pending=h)
+        closes the window."""
+        return _ContribHandle(round_idx)
+
+    def contrib_push(self, h: _ContribHandle, block: int,
+                     data: bytes) -> None:
+        """Ship one block's payload into an open window.  Rank 0
+        stashes (its blocks never cross the wire); workers chain a
+        single-block contrib send onto the previous push so socket
+        writes serialize while the caller computes the next block.  A
+        death mid-window surfaces at the exchange() join — the round's
+        re-adoption then runs against the frozen carry via `compute`,
+        never against this stale buffer."""
+        data = bytes(data)
+        self._note_items([data])
+        h.blocks.append(int(block))
+        if self.ctx.world <= 1 or self.ctx.rank == 0:
+            h.stash[int(block)] = data
+            return
+        from fedml_tpu.parallel.prefetch import AsyncValue
+
+        prev = h.pending
+
+        def _ship(prev=prev, block=int(block), data=data):
+            if prev is not None:
+                prev.result()
+            self._send_contrib(h.round_idx, {block: data})
+
+        h.pending = AsyncValue(_ship,
+                               name=f"contrib_push#{h.round_idx}")
+
     def exchange(self, round_idx: int, parts: dict,
-                 compute: Optional[Callable] = None
+                 compute: Optional[Callable] = None,
+                 pending: Optional[_ContribHandle] = None
                  ) -> tuple[dict, ClusterView]:
         """The block-keyed elastic allgather: contribute `parts`
         ({item: f32 bytes/ndarray}), receive ALL n_items item payloads
         plus the view that completed the round.  `compute(items)` is
         the re-adoption callback — invoked when a view change
         re-assigns a dead rank's missing items to this rank mid-round.
-        Every rank receives the identical payload set, so any
-        deterministic fold over it (fold_block_partials) commits the
-        same bits on every survivor."""
+        `pending` closes an overlap window opened by contrib_begin:
+        its pushes are drained (worker) or merged into `parts` (rank
+        0) before the collective proper.  Every rank receives the
+        identical payload set, so any deterministic fold over it
+        (fold_block_partials) commits the same bits on every
+        survivor."""
         t0 = time.perf_counter()
         parts = {int(b): (v.tobytes() if hasattr(v, "tobytes")
                           else bytes(v))
                  for b, v in parts.items()}
         self._note_items(parts.values())
+        pre_sent: tuple = ()
+        if pending is not None:
+            if pending.round_idx != round_idx:
+                raise ValueError(
+                    f"elastic exchange round {round_idx}: pending "
+                    f"contributions belong to round "
+                    f"{pending.round_idx}")
+            if self.ctx.rank == 0 or self.ctx.world <= 1:
+                parts = {**pending.stash, **parts}
+            else:
+                if pending.pending is not None:
+                    pending.pending.result()   # DeadRankError re-raises
+                pre_sent = tuple(pending.blocks)
         try:
             if self.ctx.rank == 0:
                 return self._exchange_coord(round_idx, parts, compute)
-            return self._exchange_worker(round_idx, parts, compute)
+            return self._exchange_worker(round_idx, parts, compute,
+                                         pre_sent)
         finally:
             obs.histogram("multihost_allgather_seconds").observe(
                 time.perf_counter() - t0)
@@ -1395,9 +1678,13 @@ class ElasticChannel:
                     self._suspect.setdefault(m, "result send failed")
         return have, view
 
-    def _exchange_worker(self, round_idx, parts, compute):
-        sent = set(parts)
-        self._send_contrib(round_idx, parts)
+    def _exchange_worker(self, round_idx, parts, compute,
+                         pre_sent: tuple = ()):
+        sent = set(parts) | set(pre_sent)
+        if parts or not pre_sent:
+            # an all-early overlap round has nothing left to contribute
+            # inline; everything else keeps the eager single contrib
+            self._send_contrib(round_idx, parts)
         deadline = time.monotonic() + self.timeout_s
         while True:
             self._sock.settimeout(
@@ -1661,6 +1948,9 @@ class MultihostRunner:
                  *, n_blocks: Optional[int] = None,
                  channel: Optional[HostChannel] = None,
                  timeout_s: float = 120.0,
+                 carry_codec: str = "f32",
+                 carry_chunk: Optional[int] = None,
+                 overlap_exchange: bool = False,
                  on_round_end: Optional[Callable[[int], None]] = None):
         from fedml_tpu.parallel.engine import MeshFedAvgEngine
         from fedml_tpu.parallel.hierarchical import MeshHierarchicalEngine
@@ -1725,8 +2015,19 @@ class MultihostRunner:
         self._range_stack = None
         self._range_stack_w = None
         self._prefetched = None
+        from fedml_tpu.parallel.carry_codec import (DEFAULT_CHUNK,
+                                                    make_carry_codec)
+        self.codec = make_carry_codec(
+            carry_codec,
+            chunk=DEFAULT_CHUNK if carry_chunk is None else carry_chunk)
+        self.overlap_exchange = bool(overlap_exchange)
         self.round_walls: list[float] = []
         self.carry_bytes: list[int] = []
+        self.carry_wire_sent: list[int] = []
+        self.carry_raw: list[int] = []       # f32 bytes before encoding
+        self.carry_payload: list[int] = []   # encoded payload bytes
+        self.overlap_waits: list[float] = []
+        self.exchange_walls: list[float] = []
         engine._ensure_twolevel()
 
     # -- setup ---------------------------------------------------------------
@@ -1752,6 +2053,11 @@ class MultihostRunner:
             "seed": eng.cfg.seed,
             "family": eng.program_family,
             "streaming": bool(eng.streaming),
+            # the carry codec shapes every wire payload — a mixed-codec
+            # cluster must be NAMED at handshake, not discovered as a
+            # size mismatch mid-round
+            "carry_codec": self.codec.name,
+            "carry_chunk": self.codec.chunk,
         }, sort_keys=True).encode()
 
     def _handshake(self) -> None:
@@ -1837,12 +2143,13 @@ class MultihostRunner:
             parts[b] = np.asarray(flat, dtype=np.float32)
         return parts
 
-    def _partials_streaming(self, variables, round_idx: int, train_rng,
-                            rng_base, rounds: int):
-        """Streaming partials with the per-host double-buffered
-        prefetch: round r+1's gather+upload runs on a background thread
-        while round r computes (parallel/prefetch.py AsyncValue — the
-        engines' own pipeline, reused per host)."""
+    def _streaming_blocks(self, round_idx: int, train_rng, rng_base,
+                          rounds: int) -> list:
+        """The streaming input head with the per-host double-buffered
+        prefetch: consume round r's gathered blocks (from the prefetch
+        thread when pipelined), schedule round r+1's gather+upload
+        (parallel/prefetch.py AsyncValue — the engines' own pipeline,
+        reused per host)."""
         from fedml_tpu.parallel.prefetch import AsyncValue
         eng = self.engine
         pre = self._prefetched
@@ -1865,37 +2172,148 @@ class MultihostRunner:
                 round_idx + 1,
                 AsyncValue(self._gather_streaming, round_idx + 1,
                            nxt_rng, stats=eng.transfer_stats))
+        return blocks
+
+    def _partials_streaming(self, variables, round_idx: int, train_rng,
+                            rng_base, rounds: int):
+        eng = self.engine
         parts = {}
-        for b, cohort, weights, crngs in blocks:
+        for b, cohort, weights, crngs in self._streaming_blocks(
+                round_idx, train_rng, rng_base, rounds):
             flat = eng._twolevel_partial(variables, cohort, weights,
                                          jax.numpy.asarray(crngs))
             parts[b] = np.asarray(flat, dtype=np.float32)
         return parts
 
-    def _allreduce(self, parts: dict[int, np.ndarray]) -> np.ndarray:
-        """Inter-host carry allreduce: ship owned block partials (block
-        order, f32 LE), receive everyone's, fold in global block
-        order."""
-        payload = b"".join(parts[b].tobytes()
-                           for b in sorted(parts))
-        rx0 = self.channel.bytes_received
-        docs = self.channel.allgather(payload, timeout_s=self.timeout_s)
-        self.carry_bytes.append(self.channel.bytes_received - rx0)
+    def _iter_partials(self, variables, round_idx: int, train_rng,
+                       rng_base, rounds: int):
+        """Per-block partial stream for the overlapped exchange: yields
+        (block, f32 vector) in owned-block order, so each block's carry
+        can ship while the next one computes."""
+        eng = self.engine
+        if eng.streaming:
+            for b, cohort, weights, crngs in self._streaming_blocks(
+                    round_idx, train_rng, rng_base, rounds):
+                flat = eng._twolevel_partial(variables, cohort, weights,
+                                             jax.numpy.asarray(crngs))
+                yield b, np.asarray(flat, dtype=np.float32)
+            return
+        stack, stack_w = self._upload_range_stack()
+        for b in self.owned_blocks:
+            ids, wmask, crngs = self._block_inputs(round_idx, b,
+                                                   train_rng)
+            local_ids = ids - self.range_lo
+            flat = eng._twolevel_partial_resident(
+                variables, stack, stack_w, jax.numpy.asarray(local_ids),
+                jax.numpy.asarray(wmask), jax.numpy.asarray(crngs))
+            yield b, np.asarray(flat, dtype=np.float32)
+
+    # -- codec plumbing ------------------------------------------------------
+    def _encode_block(self, block: int, vec: np.ndarray) -> bytes:
+        with obs.span("multihost.encode_carry", codec=self.codec.name,
+                      block=block):
+            data = self.codec.encode(block, vec)
+        self._round_raw += vec.size * 4
+        self._round_payload += len(data)
+        return data
+
+    def _finish_round_bytes(self) -> None:
+        """Close this round's byte accounting: payload-level raw/wire
+        into the codec counters + the channel-measured wire deltas (the
+        ISSUE-16 satellite: the ratio the bench judges is what the
+        channel moved)."""
+        _account_carry(self._round_raw, self._round_payload)
+        self.carry_raw.append(self._round_raw)
+        self.carry_payload.append(self._round_payload)
+        d = self.channel.round_wire_delta()
+        self.carry_bytes.append(d["received"])
+        self.carry_wire_sent.append(d["sent"])
+
+    def _fold_docs(self, docs: list, dim: int) -> np.ndarray:
+        """Decode every rank's payload through the codec and fold in
+        global block order — decode is deterministic f64 math, so all
+        ranks fold identical f32 partials from identical wire bytes."""
         world = self.ctx.world
         bpp = self.n_blocks // world
-        dim = next(iter(parts.values())).size
+        enb = self.codec.encoded_nbytes(dim)
         all_parts: dict[int, np.ndarray] = {}
         for r, doc in enumerate(docs):
-            if len(doc) != bpp * dim * 4:
+            if len(doc) != bpp * enb:
                 raise DeadRankError(
                     f"two-level allreduce: rank {r} shipped "
-                    f"{len(doc)} bytes, expected {bpp * dim * 4} "
-                    f"({bpp} blocks x {dim} f32) — config skew or a "
-                    f"truncated frame")
-            vecs = np.frombuffer(doc, dtype="<f4").reshape(bpp, dim)
+                    f"{len(doc)} bytes, expected {bpp * enb} "
+                    f"({bpp} blocks x {enb} B {self.codec.name} "
+                    f"carry) — config skew or a truncated frame")
             for j in range(bpp):
-                all_parts[r * bpp + j] = vecs[j]
+                all_parts[r * bpp + j] = self.codec.decode(
+                    doc[j * enb:(j + 1) * enb])
         return fold_block_partials(all_parts, self.n_blocks)
+
+    def carry_state(self) -> dict:
+        """The codec's residual state (error-feedback accumulators):
+        ship it as FedCheckpointManager extra_state so crash-resume
+        continues the same compression-error trajectory."""
+        return self.codec.state_dict()
+
+    def load_carry_state(self, state: Optional[dict]) -> None:
+        self.codec.load_state_dict(state or {})
+
+    def _round_exchange(self, variables, round_idx: int, train_rng,
+                        rng_base, rounds: int) -> np.ndarray:
+        """One round's partials + inter-host carry allreduce, returning
+        the folded carry.  Serial path: compute everything, then one
+        blocking allgather of the encoded payload.  Overlapped path
+        (--overlap_exchange): open a pipelined gather and push each
+        block's encoded carry as it materializes, so the DCN transfer
+        rides under the remaining blocks' compute; only the final
+        gather_finish is visible wait (the multihost.overlap_wait
+        span).  Both paths move identical bytes in identical order —
+        the f32 escape hatch stays bitwise under overlap."""
+        ch = self.channel
+        ch.mark_round()
+        self._round_raw = self._round_payload = 0
+        w0 = time.perf_counter()
+        if self.overlap_exchange and self.ctx.world > 1:
+            h = ch.gather_begin(len(self.owned_blocks),
+                                timeout_s=self.timeout_s)
+            dim = 0
+            try:
+                for b, vec in self._iter_partials(
+                        variables, round_idx, train_rng, rng_base,
+                        rounds):
+                    dim = vec.size
+                    ch.gather_push(h, self._encode_block(b, vec))
+                with obs.span("multihost.overlap_wait",
+                              round=round_idx):
+                    t0 = time.perf_counter()
+                    docs = ch.gather_finish(h)
+                    wait = time.perf_counter() - t0
+            except Exception:
+                ch.gather_abort(h)
+                raise
+            self.overlap_waits.append(wait)
+            self.exchange_walls.append(time.perf_counter() - w0)
+        else:
+            if self.engine.streaming:
+                parts = self._partials_streaming(
+                    variables, round_idx, train_rng, rng_base, rounds)
+            else:
+                parts = self._partials_resident(variables, round_idx,
+                                                train_rng)
+            dim = next(iter(parts.values())).size
+            payload = b"".join(self._encode_block(b, parts[b])
+                               for b in sorted(parts))
+            with obs.span("multihost.allreduce", round=round_idx):
+                t0 = time.perf_counter()
+                docs = ch.allgather(payload, timeout_s=self.timeout_s)
+                wait = time.perf_counter() - t0
+            # the whole exchange is visible wait on the serial path, so
+            # overlap_fraction reports an honest ~0 (InlineFetcher's
+            # convention)
+            self.overlap_waits.append(wait)
+            self.exchange_walls.append(wait)
+        self._finish_round_bytes()
+        return self._fold_docs(docs, dim)
 
     # -- the loop ------------------------------------------------------------
     def run(self, variables=None, rounds: Optional[int] = None,
@@ -1922,16 +2340,9 @@ class MultihostRunner:
                 with obs.span("round.twolevel", round=round_idx,
                               rank=self.ctx.rank,
                               blocks=len(self.owned_blocks)):
-                    if eng.streaming:
-                        parts = self._partials_streaming(
-                            variables, round_idx, train_rng, rng_base,
-                            rounds)
-                    else:
-                        parts = self._partials_resident(
-                            variables, round_idx, train_rng)
-                    with obs.span("multihost.allreduce",
-                                  round=round_idx):
-                        total = self._allreduce(parts)
+                    total = self._round_exchange(variables, round_idx,
+                                                 train_rng, rng_base,
+                                                 rounds)
                     variables, server_state, m = eng._twolevel_commit(
                         variables, server_state,
                         jax.numpy.asarray(total), agg_rng)
@@ -1997,6 +2408,11 @@ class MultihostRunner:
         excluded from the rate)."""
         walls = self.round_walls[warmup_rounds:]
         carry = self.carry_bytes[warmup_rounds:] or [0]
+        sent = self.carry_wire_sent[warmup_rounds:] or [0]
+        raw = self.carry_raw[warmup_rounds:]
+        payload = self.carry_payload[warmup_rounds:]
+        waits = self.overlap_waits[warmup_rounds:]
+        ewalls = self.exchange_walls[warmup_rounds:]
         return {
             "rank": self.ctx.rank,
             "world": self.ctx.world,
@@ -2011,6 +2427,25 @@ class MultihostRunner:
             # the channel also carries handshake/rollup frames and (in
             # mh_worker) a sibling runner's traffic
             "carry_allreduce_bytes_total": int(sum(self.carry_bytes)),
+            # -- compressed tier (ISSUE 16) --
+            "carry_codec": self.codec.name,
+            "carry_raw_bytes_per_round": (float(np.mean(raw))
+                                          if raw else 0.0),
+            "carry_payload_bytes_per_round": (float(np.mean(payload))
+                                              if payload else 0.0),
+            # payload-level ratio: deterministic per (codec, dim); the
+            # channel-measured per-round deltas above price the framing
+            "carry_compression_ratio": (sum(raw) / sum(payload)
+                                        if sum(payload) else 1.0),
+            "carry_wire_sent_bytes_per_round": float(np.mean(sent)),
+            # fraction of the exchange window (first partial shipped →
+            # folded carry ready) NOT spent blocking the round loop:
+            # ~0 on the serial path, > 0 when --overlap_exchange hides
+            # the DCN transfer behind block compute
+            "overlap_fraction": (max(0.0, 1.0 - sum(waits)
+                                     / sum(ewalls))
+                                 if ewalls and sum(ewalls) > 0
+                                 else 0.0),
         }
 
     def close(self) -> None:
@@ -2053,6 +2488,9 @@ class ElasticRunner(MultihostRunner):
                  hb_interval_s: float = 0.25,
                  hb_timeout_s: float = 2.0,
                  run_tag: str = "run",
+                 carry_codec: str = "f32",
+                 carry_chunk: Optional[int] = None,
+                 overlap_exchange: bool = False,
                  on_round_end: Optional[Callable[[int], None]] = None):
         if channel is not None and not isinstance(channel,
                                                   ElasticChannel):
@@ -2062,6 +2500,9 @@ class ElasticRunner(MultihostRunner):
                 f"the fail-fast HostChannel")
         super().__init__(engine, ctx, n_blocks=n_blocks,
                          channel=channel, timeout_s=timeout_s,
+                         carry_codec=carry_codec,
+                         carry_chunk=carry_chunk,
+                         overlap_exchange=overlap_exchange,
                          on_round_end=on_round_end)
         self.connect_timeout_s = float(connect_timeout_s)
         self.hb_interval_s = float(hb_interval_s)
@@ -2139,7 +2580,12 @@ class ElasticRunner(MultihostRunner):
                       blocks=len(tuple(blocks))):
             parts = self._compute_partials(variables, round_idx,
                                            train_rng, blocks)
-        return {b: v.tobytes() for b, v in parts.items()}
+        # re-adopted blocks ship through the SAME codec as owned ones
+        # (the channel's uniform-item contract); an int8_ef residual
+        # for a freshly adopted block starts at zero — compression
+        # error trajectory only, never replica agreement
+        return {b: self.codec.encode(int(b), v)
+                for b, v in parts.items()}
 
     def _snapshot_blob(self, resume_round: int, variables,
                        server_state) -> bytes:
@@ -2216,18 +2662,47 @@ class ElasticRunner(MultihostRunner):
                     for b in list(self._block_stacks):
                         if b not in mine:
                             del self._block_stacks[b]
-                    parts = self._compute_partials(variables, round_idx,
-                                                   train_rng, mine)
-                    rx0 = ch.bytes_received
-                    with obs.span("multihost.allreduce",
-                                  round=round_idx):
-                        all_parts, _view = ch.exchange(
-                            round_idx,
-                            {b: v.tobytes() for b, v in parts.items()},
-                            self._readopt_compute)
-                    self.carry_bytes.append(ch.bytes_received - rx0)
+                    # error-feedback residuals follow ownership too
+                    self.codec.retain_blocks(mine)
+                    ch.mark_round()
+                    self._round_raw = self._round_payload = 0
+                    w0 = time.perf_counter()
+                    if self.overlap_exchange and self.ctx.world > 1:
+                        hnd = ch.contrib_begin(round_idx)
+                        for b in mine:
+                            part = self._compute_partials(
+                                variables, round_idx, train_rng, [b])
+                            ch.contrib_push(
+                                hnd, b,
+                                self._encode_block(b, part[int(b)]))
+                        with obs.span("multihost.overlap_wait",
+                                      round=round_idx), \
+                             obs.span("multihost.allreduce",
+                                      round=round_idx):
+                            t0 = time.perf_counter()
+                            all_parts, _view = ch.exchange(
+                                round_idx, {}, self._readopt_compute,
+                                pending=hnd)
+                            wait = time.perf_counter() - t0
+                        self.overlap_waits.append(wait)
+                        self.exchange_walls.append(
+                            time.perf_counter() - w0)
+                    else:
+                        parts = self._compute_partials(
+                            variables, round_idx, train_rng, mine)
+                        enc = {b: self._encode_block(b, v)
+                               for b, v in parts.items()}
+                        with obs.span("multihost.allreduce",
+                                      round=round_idx):
+                            t0 = time.perf_counter()
+                            all_parts, _view = ch.exchange(
+                                round_idx, enc, self._readopt_compute)
+                            wait = time.perf_counter() - t0
+                        self.overlap_waits.append(wait)
+                        self.exchange_walls.append(wait)
+                    self._finish_round_bytes()
                     total = fold_block_partials(
-                        {b: np.frombuffer(v, dtype="<f4")
+                        {b: self.codec.decode(bytes(v))
                          for b, v in all_parts.items()},
                         self.n_blocks)
                     variables, server_state, m = eng._twolevel_commit(

@@ -55,7 +55,13 @@
 #      (DCN tier) at 1/2/4 processes; gates: bitwise 1-vs-2-process
 #      commit pin, zero process deaths, measured weak-scaling
 #      efficiency extending the v4-128 projection with real points
-#  16. profile_bench ELASTIC  — elastic-chaos arm chip-attached
+#  16. profile_bench POD compress — compressed-carry arm under exp_POD
+#      (ISSUE 16): bytes-on-wire per round measured ON the channel,
+#      int8/int8_ef compression ratio + efficiency-at-constant-bytes,
+#      overlap fraction, and the f32 escape hatch staying bitwise under
+#      --overlap_exchange — the bytes column chip-attached prices real
+#      DCN frames instead of loopback
+#  17. profile_bench ELASTIC  — elastic-chaos arm chip-attached
 #      (ISSUE 14): a 3-process ELASTIC cluster with a seeded kill of
 #      rank 1 mid-run vs the clean elastic run — gates: survivors
 #      finish (zero survivor deaths), survivor goodput >= 0.5x clean,
@@ -72,49 +78,49 @@ if ! timeout 180 python -c "import jax; assert jax.devices()[0].platform in ('tp
   echo "chip unavailable; aborting queue"; exit 1
 fi
 
-echo "== 1/16 bench.py"
+echo "== 1/17 bench.py"
 timeout 1500 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.json"
 
-echo "== 2/16 nwp_convergence (600 rounds, vocab 10004 — must match the"
+echo "== 2/17 nwp_convergence (600 rounds, vocab 10004 — must match the"
 echo "   600-round band pinned in test_quality_regression.py)"
 timeout 3600 python tools/nwp_convergence.py 600 \
     --out benchmarks/nwp_convergence_r5.json 2>"$OUT/nwp.err" \
     | tee "$OUT/nwp.log"
 
-echo "== 3/16 profile_bench C4096B (block-streamed 4096 clients)"
+echo "== 3/17 profile_bench C4096B (block-streamed 4096 clients)"
 timeout 5400 python tools/profile_bench.py C4096B 2>&1 | tee "$OUT/c4096b.log"
 
-echo "== 4/16 profile_bench OS256 OSB256 (order-stat timing)"
+echo "== 4/17 profile_bench OS256 OSB256 (order-stat timing)"
 timeout 3600 python tools/profile_bench.py OS256 OSB256 2>&1 | tee "$OUT/os.log"
 
-echo "== 5/16 profile_bench DN128 (donate on/off + restructured carry A/B)"
+echo "== 5/17 profile_bench DN128 (donate on/off + restructured carry A/B)"
 timeout 1800 python tools/profile_bench.py DN128 2>&1 | tee "$OUT/dn128.log"
 
-echo "== 6/16 profile_bench PF512 SD512 (prefetch + stack-dtype A/Bs)"
+echo "== 6/17 profile_bench PF512 SD512 (prefetch + stack-dtype A/Bs)"
 timeout 3600 python tools/profile_bench.py PF512 SD512 2>&1 | tee "$OUT/pfsd.log"
 
-echo "== 7/16 profile_bench ASYNC (async federation K=8 vs K=32 A/B)"
+echo "== 7/17 profile_bench ASYNC (async federation K=8 vs K=32 A/B)"
 timeout 3600 python tools/profile_bench.py ASYNC 2>&1 | tee "$OUT/async.log"
 
-echo "== 8/16 profile_bench INGEST (uplink ingestion legacy-vs-streaming A/B)"
+echo "== 8/17 profile_bench INGEST (uplink ingestion legacy-vs-streaming A/B)"
 timeout 1800 python tools/profile_bench.py INGEST 2>&1 | tee "$OUT/ingest.log"
 
-echo "== 9/16 profile_bench TRACE (traced-vs-untraced ingest overhead gate)"
+echo "== 9/17 profile_bench TRACE (traced-vs-untraced ingest overhead gate)"
 timeout 1200 python tools/profile_bench.py TRACE 2>&1 | tee "$OUT/trace.log"
 
-echo "== 10/16 profile_bench CHAOS (chaos goodput under seeded wire faults)"
+echo "== 10/17 profile_bench CHAOS (chaos goodput under seeded wire faults)"
 timeout 1800 python tools/profile_bench.py CHAOS 2>&1 | tee "$OUT/chaos.log"
 
-echo "== 11/16 profile_bench ATTACK (adversarial attack x defense matrix)"
+echo "== 11/17 profile_bench ATTACK (adversarial attack x defense matrix)"
 timeout 3600 python tools/profile_bench.py ATTACK 2>&1 | tee "$OUT/attack.log"
 
-echo "== 12/16 profile_bench SERVE (million-client serving spine)"
+echo "== 12/17 profile_bench SERVE (million-client serving spine)"
 timeout 1800 python tools/profile_bench.py SERVE 2>&1 | tee "$OUT/serve.log"
 
-echo "== 13/16 profile_bench CONN (live-connection reactor A/B)"
+echo "== 13/17 profile_bench CONN (live-connection reactor A/B)"
 timeout 1800 python tools/profile_bench.py CONN 2>&1 | tee "$OUT/conn.log"
 
-echo "== 14/16 bench_diff (cross-run regression verdicts, ISSUE 12)"
+echo "== 14/17 bench_diff (cross-run regression verdicts, ISSUE 12)"
 # judge the fresh chip record against the committed trajectory: named
 # regression/improvement verdicts with the encoded noise bands; a
 # nonzero exit flags the queue log, it does not abort banked artifacts.
@@ -125,13 +131,21 @@ echo "== 14/16 bench_diff (cross-run regression verdicts, ISSUE 12)"
     2>&1 | tee "$OUT/bench_diff.log" ) \
     || echo "bench_diff: REGRESSIONS NAMED ABOVE (see $OUT/bench_diff.json)"
 
-echo "== 15/16 profile_bench POD (multi-host weak-scaling sweep, ISSUE 13)"
+echo "== 15/17 profile_bench POD (multi-host weak-scaling sweep, ISSUE 13)"
 # exp_POD = bench.py --mode multihost on the pod slice: per-process
 # local-chip training + DCN carry allreduce; FEDML_POD_PROCS overrides
 # the 1,2,4 process sweep when the slice has more hosts
 timeout 1800 python tools/profile_bench.py POD 2>&1 | tee "$OUT/pod.log"
 
-echo "== 16/16 profile_bench ELASTIC (elastic-chaos survivor arm, ISSUE 14)"
+echo "== 16/17 profile_bench POD compress (compressed-carry arm, ISSUE 16)"
+# the compressed-carry arm under exp_POD, isolated so its bytes column
+# is priced on real DCN frames: f32 escape hatch bitwise under overlap,
+# int8/int8_ef wire reduction (>= 3x gate rides bench_diff), overlap
+# fraction on chip-attached compute instead of loopback round-trips
+FEDML_POD_ARMS=compress timeout 1800 python tools/profile_bench.py POD \
+    2>&1 | tee "$OUT/pod_compress.log"
+
+echo "== 17/17 profile_bench ELASTIC (elastic-chaos survivor arm, ISSUE 14)"
 # exp_ELASTIC = bench.py --mode multihost --mh_arms chaos: the elastic
 # 3-process kill-a-rank arm chip-attached — survivor goodput, view-
 # change latency on real DCN detection paths, bitwise_after_death_ok

@@ -1,0 +1,173 @@
+"""Carry wire codec pins (ISSUE 16 — parallel/carry_codec.py).
+
+The compressed inter-host tier's correctness contract, pinned in
+process:
+
+* f32 is the IDENTITY codec — bytes exactly `vec.tobytes()`, which is
+  what the PR-13/14 bitwise anchors were built on;
+* int8 round-trips within the documented per-chunk tolerance
+  (scale/2 = chunk_range/510) at a payload size that is a pure
+  function of (dim, chunk) — the ElasticChannel uniform-item contract;
+* decode is deterministic f64 math against the f32-ROUNDED wire
+  headers, so every rank reconstructs identical carries from identical
+  bytes;
+* error feedback makes the SUM over rounds converge (single-round
+  error bound, not O(rounds)), and its residual accumulator
+  round-trips through orbax as FedCheckpointManager extra_state so
+  crash-resume continues the same error trajectory.
+"""
+import numpy as np
+import pytest
+
+from fedml_tpu.parallel.carry_codec import (CARRY_CODECS, CarryCodec,
+                                            Int8CarryCodec,
+                                            Int8EFCarryCodec,
+                                            make_carry_codec)
+
+
+def _vec(n, seed=0, scale=3.0):
+    rng = np.random.default_rng(seed)
+    return (scale * rng.standard_normal(n)).astype(np.float32)
+
+
+def test_f32_codec_is_identity_bytes():
+    """The escape hatch: encode must be byte-identical to
+    `vec.tobytes()` of a little-endian f32 vector — the PR-13/14
+    runners shipped exactly those bytes, and the bitwise anchors pin
+    behavior built on them."""
+    c = make_carry_codec("f32")
+    v = _vec(97)
+    buf = c.encode(0, v)
+    assert buf == v.astype("<f4").tobytes()
+    assert len(buf) == c.encoded_nbytes(97) == 4 * 97
+    out = c.decode(buf)
+    assert out.dtype == np.float32
+    np.testing.assert_array_equal(out, v)
+    # stateless: nothing to checkpoint, nonempty state is a config bug
+    assert c.state_dict() == {}
+    c.load_state_dict({})
+    with pytest.raises(ValueError, match="carries no state"):
+        c.load_state_dict({"residual": {}})
+
+
+@pytest.mark.parametrize("dim", [1, 7, 64, 100, 129])
+def test_int8_roundtrip_within_tolerance_fixed_size(dim):
+    """Round-trip error bounded by scale/2 per element, and the
+    payload size is a pure function of (dim, chunk) — equal-length
+    vectors MUST produce equal-length payloads (the channel splits
+    collective blobs by uniform item size)."""
+    c = Int8CarryCodec(chunk=64)
+    v = _vec(dim, seed=dim)
+    buf = c.encode(0, v)
+    assert len(buf) == c.encoded_nbytes(dim)
+    out = c.decode(buf)
+    # per-chunk bound: scale = (max-min)/255, error <= scale/2
+    for start in range(0, dim, 64):
+        sl = v[start:start + 64]
+        tol = (float(sl.max() - sl.min()) / 255.0) / 2 + 1e-6
+        np.testing.assert_allclose(out[start:start + 64], sl, atol=tol)
+    # uniform-size contract across different payloads of the same dim
+    assert len(c.encode(1, _vec(dim, seed=dim + 1))) == len(buf)
+
+
+def test_int8_decode_deterministic_and_requantization_stable():
+    """decode is f64 math on the f32-rounded wire headers — identical
+    on every host — and re-encoding a decoded vector reproduces the
+    identical bytes (the representable points are fixed points)."""
+    c = Int8CarryCodec(chunk=32)
+    v = _vec(80, seed=5)
+    buf = c.encode(0, v)
+    a, b = c.decode(buf), c.decode(bytes(buf))
+    np.testing.assert_array_equal(a, b)
+    assert c.encode(0, a) == buf
+    # degenerate range (constant chunk) must stay finite and exact
+    flat = np.full(48, 2.5, np.float32)
+    np.testing.assert_array_equal(c.decode(c.encode(0, flat)), flat)
+
+
+def test_int8_nonfinite_raises_naming_escape_hatch():
+    c = Int8CarryCodec()
+    bad = _vec(16)
+    bad[3] = np.nan
+    with pytest.raises(ValueError, match="carry_codec f32"):
+        c.encode(0, bad)
+    # size mismatch on decode names the mixed-codec failure mode
+    with pytest.raises(ValueError, match="mixed-codec"):
+        c.decode(c.encode(0, _vec(16)) + b"x")
+
+
+def test_error_feedback_sum_over_rounds_converges():
+    """The EF pin: the summed DECODED carry over many rounds tracks
+    the true sum within a single round's quantization error, while the
+    plain int8 sum accumulates error linearly.  This is the reason
+    int8_ef exists."""
+    rounds, dim = 40, 256
+    plain, ef = Int8CarryCodec(chunk=64), Int8EFCarryCodec(chunk=64)
+    true_sum = np.zeros(dim)
+    plain_sum = np.zeros(dim)
+    ef_sum = np.zeros(dim)
+    for r in range(rounds):
+        v = _vec(dim, seed=r)
+        true_sum += v.astype(np.float64)
+        plain_sum += plain.decode(plain.encode(0, v)).astype(np.float64)
+        ef_sum += ef.decode(ef.encode(0, v)).astype(np.float64)
+    ef_err = np.abs(ef_sum - true_sum).max()
+    plain_err = np.abs(plain_sum - true_sum).max()
+    # single-round error bound for EF vs accumulating error for plain
+    one_round_tol = 2 * (6 * 3.0 / 255.0)  # ~2x a generous scale/2
+    assert ef_err < one_round_tol, (ef_err, plain_err)
+    assert ef_err < plain_err / 3, (
+        f"error feedback must beat plain int8 by a wide margin over "
+        f"{rounds} rounds: ef={ef_err:.4g} plain={plain_err:.4g}")
+
+
+def test_ef_residual_retain_blocks_and_state_shape():
+    ef = Int8EFCarryCodec(chunk=32)
+    for b in (0, 1, 2):
+        ef.encode(b, _vec(64, seed=b))
+    assert sorted(ef.state_dict()["residual"]) == ["0", "1", "2"]
+    ef.retain_blocks([0, 2])
+    assert sorted(ef.state_dict()["residual"]) == ["0", "2"]
+    # a re-adopted block restarts its residual at zero: encoding block
+    # 1 again equals a fresh codec's encoding (agreement is wire-level,
+    # only the error trajectory resets)
+    v = _vec(64, seed=9)
+    assert ef.encode(1, v) == Int8EFCarryCodec(chunk=32).encode(1, v)
+
+
+def test_ef_residual_checkpoint_roundtrip_orbax(tmp_path):
+    """Crash-resume continues the SAME error trajectory: the residual
+    dict rides FedCheckpointManager extra_state; a codec restored from
+    the checkpoint emits byte-identical wire payloads to the
+    uninterrupted one on every subsequent round."""
+    from fedml_tpu.utils.checkpoint import FedCheckpointManager
+
+    ef = Int8EFCarryCodec(chunk=64)
+    for r in range(3):
+        for b in (0, 1):
+            ef.encode(b, _vec(128, seed=10 * b + r))
+    ck = FedCheckpointManager(str(tmp_path / "carry_ck"))
+    variables = {"w": np.zeros(2, np.float32)}
+    ck.save(3, variables, (), extra_state=ef.state_dict())
+    step, _, _, extra = ck.restore(variables, (),
+                                   extra_template=ef.state_dict())
+    ck.close()
+    assert step == 3
+    resumed = Int8EFCarryCodec(chunk=64)
+    resumed.load_state_dict(extra)
+    for r in range(3, 6):
+        for b in (0, 1):
+            v = _vec(128, seed=10 * b + r)
+            assert resumed.encode(b, v) == ef.encode(b, v), (
+                f"round {r} block {b}: resumed codec diverged from the "
+                f"uninterrupted error trajectory")
+
+
+def test_make_carry_codec_registry():
+    assert [make_carry_codec(n).name for n in CARRY_CODECS] == \
+        list(CARRY_CODECS)
+    assert isinstance(make_carry_codec("f32"), CarryCodec)
+    with pytest.raises(ValueError, match="unknown carry codec"):
+        make_carry_codec("zstd")
+    with pytest.raises(ValueError, match="positive"):
+        Int8CarryCodec(chunk=0)

@@ -282,6 +282,24 @@ def test_twolevel_two_process_bitwise_pin(tmp_path):
     # the carry genuinely crossed processes in the 2-proc arm
     assert two[0]["carry_allreduce_bytes_per_round"] > 0
     assert one[0]["carry_allreduce_bytes_per_round"] == 0
+    # ISSUE 16: the f32 escape hatch stays bitwise UNDER OVERLAP — the
+    # ONE extra spawned arm this PR adds (the other compression/
+    # overlap pins are in-process): same case, f32 codec + overlapped
+    # exchange, digests byte-identical to the serial arms above, and
+    # the exchange measurably hid behind compute
+    ov, r3 = _run_launcher(2, {**MH_CASE, "carry_codec": "f32",
+                               "overlap_exchange": True}, tmp_path)
+    assert r3.returncode == 0, r3.stderr[-3000:]
+    for mode in ("streaming", "resident"):
+        d1 = one[0]["digests"][mode]
+        for r in (0, 1):
+            assert ov[r]["digests"][mode] == d1, (
+                f"{mode}: rank {r} diverged under --overlap_exchange "
+                f"— the overlapped gather broke the f32 escape hatch")
+    assert ov[0]["carry_codec"] == "f32"
+    assert ov[0]["overlap_fraction"] > 0, (
+        "overlapped arm reported zero overlap — the exchange never "
+        "rode under block compute")
 
 
 def test_twolevel_crash_names_dead_rank(tmp_path):
@@ -668,7 +686,7 @@ def test_elastic_heartbeat_detects_hung_rank_within_timeout():
     def hung_worker():
         ch = _elastic_channel(1, 2, port, n_items=2)
         ch.hb_paused = True              # the process "stops"
-        time.sleep(4.0)                  # hung, not dead: socket open
+        time.sleep(3.0)                  # hung, not dead: socket open
         ch.close()
 
     tw = threading.Thread(target=hung_worker, daemon=True)
@@ -696,7 +714,7 @@ def test_elastic_rejoin_snapshot_and_stale_digest_rejected():
     rejoined rank finishes the remaining rounds as a member."""
     from fedml_tpu.parallel.multihost import DeadRankError, free_port
     port = free_port()
-    n_items, rounds = 2, 8
+    n_items, rounds = 2, 6
     out, errs = {}, []
 
     def coord():
@@ -716,7 +734,7 @@ def test_elastic_rejoin_snapshot_and_stale_digest_rejected():
                     tag="streaming")
                 if admitted:
                     out["admitted_at"] = rnd + 1
-                time.sleep(0.4)
+                time.sleep(0.3)
             out["events"] = list(ch.view_events)
             ch.close()
         except Exception as e:
@@ -729,7 +747,7 @@ def test_elastic_rejoin_snapshot_and_stale_digest_rejected():
         ch.close()
 
     def stale_rejoiner():
-        time.sleep(0.8)
+        time.sleep(0.6)
         ch = _elastic_channel(1, 2, port, n_items=n_items,
                               digest="STALE-DIGEST", rejoin=True)
         with pytest.raises(DeadRankError) as ei:
@@ -742,7 +760,7 @@ def test_elastic_rejoin_snapshot_and_stale_digest_rejected():
 
     def rejoiner():
         try:
-            time.sleep(1.4)
+            time.sleep(1.0)
             ch = _elastic_channel(1, 2, port, n_items=n_items,
                                   rejoin=True)
             blob, resume, tag = ch.rejoin_handshake()
@@ -792,7 +810,7 @@ def test_dial_backoff_late_listener_and_named_failure():
     port = free_port()
 
     def late_listener():
-        time.sleep(0.7)                 # refuse first, accept later
+        time.sleep(0.4)                 # refuse first, accept later
         srv = sk.create_server(("localhost", port))
         conn, _ = srv.accept()
         conn.close()
@@ -807,7 +825,7 @@ def test_dial_backoff_late_listener_and_named_failure():
     t0 = time.monotonic()
     with pytest.raises(DeadRankError) as ei:
         _dial_with_backoff("localhost", dead_port,
-                           time.monotonic() + 1.0,
+                           time.monotonic() + 0.6,
                            "worker 7 dialing the coordinator")
     assert time.monotonic() - t0 < 5.0
     assert "worker 7 dialing the coordinator" in str(ei.value)
@@ -828,7 +846,7 @@ def test_spawn_cluster_blame_names_every_rank():
             "time.sleep(30)\n")
     with pytest.raises(MultihostLaunchError) as ei:
         spawn_cluster([sys.executable, "-c", prog], 3, timeout_s=25,
-                      kill_grace_s=1.0)
+                      kill_grace_s=0.3)
     msg = str(ei.value)
     assert "rank 1/3 failed first" in msg
     assert "rc=7" in msg
@@ -845,7 +863,7 @@ MH_ELASTIC_CLEAN = {
     # tiny LR case, 3 blocks; local_devices=1 — the elastic pin is
     # about MEMBERSHIP, the intra-host psum tier is pinned above
     "clients": 12, "spc": 24, "dim": 8, "classes": 4, "k_per_round": 6,
-    "n_blocks": 3, "rounds": 7, "warmup": 0, "seed": 0,
+    "n_blocks": 3, "rounds": 5, "warmup": 0, "seed": 0,
     "modes": ["streaming", "resident"], "local_devices": 1,
     "elastic": True,
 }
@@ -861,7 +879,7 @@ def test_elastic_kill_respawn_bitwise_pin(tmp_path):
     round_sleep_s paces the run so the respawn (a fresh jax boot)
     rejoins deterministically inside the first (streaming) run."""
     cfg = {**MH_ELASTIC_CLEAN, "die_rank": 1,
-           "die_at_round": 0, "round_sleep_s": 1.0,
+           "die_at_round": 0, "round_sleep_s": 0.9,
            "round_sleep_mode": "streaming",
            "hb_timeout_s": 1.5, "channel_timeout_s": 60}
     cleanb, r0b = _run_launcher(1, MH_ELASTIC_CLEAN, tmp_path)
@@ -892,6 +910,210 @@ def test_elastic_kill_respawn_bitwise_pin(tmp_path):
     assert rep["view_changes"] >= 2, rep
     assert rep["epoch"] >= 2, rep
     assert "respawning once" in r1.stderr, r1.stderr[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: compressed + overlapped carry exchange — fast in-process
+# pins over REAL sockets (threads, not spawned clusters).  The one
+# spawned overlap arm rides test_twolevel_two_process_bitwise_pin.
+# ---------------------------------------------------------------------------
+
+
+def test_gather_primitive_bitwise_equals_allgather():
+    """The overlap substrate: the two-phase gather (gather_begin /
+    per-frame gather_push / gather_finish) must return EXACTLY what
+    `allgather(b"".join(frames))` returns — frames concatenate in push
+    order, rank 0 broadcasts the standard allgather blob — which is
+    the whole argument for the f32 escape hatch staying bitwise under
+    --overlap_exchange.  Also pins the per-round wire delta (ISSUE-16
+    satellite: bytes measured ON the channel, not inferred)."""
+    from fedml_tpu.parallel.multihost import (HostChannel,
+                                              MultihostContext,
+                                              free_port)
+    port = free_port()
+    frames = {r: [bytes([65 + r]) * 7 + bytes([i]) for i in range(3)]
+              for r in range(2)}
+    out, errs = {}, []
+
+    def run(r):
+        try:
+            ctx = MultihostContext(rank=r, world=2,
+                                   coordinator=f"localhost:{port}")
+            ch = HostChannel(ctx, timeout_s=20.0,
+                             connect_timeout_s=10.0)
+            try:
+                ch.mark_round()
+                h = ch.gather_begin(3, timeout_s=20.0)
+                for f in frames[r]:
+                    ch.gather_push(h, f)
+                docs_g = ch.gather_finish(h)
+                d_gather = ch.round_wire_delta()
+                ch.mark_round()
+                docs_a = ch.allgather(b"".join(frames[r]))
+                d_all = ch.round_wire_delta()
+                out[r] = (docs_g, docs_a, d_gather, d_all)
+            finally:
+                ch.close()
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    want = [b"".join(frames[0]), b"".join(frames[1])]
+    for r in (0, 1):
+        docs_g, docs_a, d_gather, d_all = out[r]
+        assert docs_g == docs_a == want, (
+            f"rank {r}: pipelined gather diverged from allgather")
+        # the wire delta window: both rounds moved bytes both ways
+        for d in (d_gather, d_all):
+            assert d["sent"] > 0 and d["received"] > 0, (r, d)
+
+
+def test_gather_abort_and_push_count_validation():
+    """gather_finish validates the push count (a short round is a
+    named bug, not a hang) and gather_abort tears down a half-open
+    gather so the next collective starts clean."""
+    from fedml_tpu.parallel.multihost import (HostChannel,
+                                              MultihostContext,
+                                              free_port)
+    port = free_port()
+    out, errs = {}, []
+
+    def run(r):
+        try:
+            ctx = MultihostContext(rank=r, world=2,
+                                   coordinator=f"localhost:{port}")
+            ch = HostChannel(ctx, timeout_s=20.0,
+                             connect_timeout_s=10.0)
+            try:
+                h = ch.gather_begin(2, timeout_s=20.0)
+                ch.gather_push(h, b"only-one")
+                if r == 0:
+                    with pytest.raises(ValueError,
+                                       match="1 frames pushed"):
+                        ch.gather_finish(h)
+                ch.gather_abort(h)
+                out[r] = True
+            finally:
+                ch.close()
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    assert out == {0: True, 1: True}
+
+
+def test_elastic_early_contrib_matches_inline_exchange():
+    """ElasticChannel's overlap shape: per-item early sends
+    (contrib_begin/contrib_push) + exchange(pending=...) must commit
+    the identical full item set as the inline PR-14 exchange — the
+    coordinator's multi-contrib protocol and the round-stamped drop of
+    stale frames make early frames safe across the same round."""
+    from fedml_tpu.parallel.multihost import free_port
+    port = free_port()
+    n_items = 4
+    results, errs = {}, []
+
+    def run_rank(r):
+        try:
+            ch = _elastic_channel(r, 2, port, n_items=n_items)
+            if r == 0:
+                ch.wait_members()
+            try:
+                ch.mark_round()
+                h = ch.contrib_begin(0)
+                for b in ch.view.assigned(r):
+                    ch.contrib_push(h, b, _evec(b, 0))
+                allp0, _ = ch.exchange(
+                    0, {}, lambda need: {b: _evec(b, 0) for b in need},
+                    pending=h)
+                delta = ch.round_wire_delta()
+                allp1, _ = ch.exchange(
+                    1, {b: _evec(b, 1) for b in ch.view.assigned(r)},
+                    lambda need: {b: _evec(b, 1) for b in need})
+                results[r] = (allp0, allp1, delta)
+            finally:
+                ch.close()
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run_rank, args=(r,))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs
+    for r in (0, 1):
+        allp0, allp1, delta = results[r]
+        assert set(allp0) == set(range(n_items))
+        assert all(allp0[b] == _evec(b, 0) for b in range(n_items)), (
+            f"rank {r}: early-contrib round lost or corrupted items")
+        assert all(allp1[b] == _evec(b, 1) for b in range(n_items))
+        assert delta["sent"] > 0 and delta["received"] > 0, (r, delta)
+
+
+def test_int8_carry_over_channel_fold_agreement_and_wire_cut():
+    """The compressed tier end-to-end over a real socket pair, without
+    an engine: each rank int8-encodes its block's f32 carry, the
+    payloads cross the HostChannel, and BOTH ranks fold bitwise-equal
+    totals (decode is deterministic f64 math on shared wire bytes).
+    The measured per-round wire bytes must be < 1/3 of the raw f32
+    bytes — the ISSUE-16 acceptance ratio, on the wire."""
+    from fedml_tpu.parallel.carry_codec import Int8CarryCodec
+    from fedml_tpu.parallel.multihost import (HostChannel,
+                                              MultihostContext,
+                                              fold_block_partials,
+                                              free_port)
+    dim = 4096
+    rng = np.random.default_rng(7)
+    vecs = {r: (3.0 * rng.standard_normal(dim)).astype(np.float32)
+            for r in range(2)}
+    port = free_port()
+    out, errs = {}, []
+
+    def run(r):
+        try:
+            codec = Int8CarryCodec()
+            ctx = MultihostContext(rank=r, world=2,
+                                   coordinator=f"localhost:{port}")
+            ch = HostChannel(ctx, timeout_s=20.0,
+                             connect_timeout_s=10.0)
+            try:
+                ch.mark_round()
+                docs = ch.allgather(codec.encode(r, vecs[r]))
+                total = fold_block_partials(
+                    {b: codec.decode(docs[b]) for b in range(2)}, 2)
+                out[r] = (total.tobytes(), ch.round_wire_delta())
+            finally:
+                ch.close()
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    assert out[0][0] == out[1][0], (
+        "ranks folded different totals from identical wire bytes — "
+        "int8 decode is not deterministic")
+    raw_bytes = 2 * dim * 4             # what the f32 tier would ship
+    for r in (0, 1):
+        d = out[r][1]
+        assert max(d["sent"], d["received"]) < raw_bytes / 3, (
+            f"rank {r}: wire bytes {d} not under 1/3 of raw "
+            f"{raw_bytes} — the compressed tier is not compressing")
 
 
 def test_multihost_context_env_roundtrip(monkeypatch):

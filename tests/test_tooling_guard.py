@@ -440,9 +440,10 @@ def test_chip_queue_carries_conn_step():
     assert "profile_bench.py CONN" in src, (
         "run_chip_queue.sh lost the CONN live-connection reactor step "
         "(ISSUE 11 queues it for the next chip window)")
-    assert "13/16" in src, (
-        "run_chip_queue.sh lost the CONN step numbering (13/16 since "
-        "ISSUEs 12-14 appended bench_diff, exp_POD and exp_ELASTIC)")
+    assert "13/17" in src, (
+        "run_chip_queue.sh lost the CONN step numbering (13/17 since "
+        "ISSUEs 12-16 appended bench_diff, exp_POD, exp_ELASTIC and "
+        "the compressed-carry arm)")
     assert "exp_CONN" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
@@ -583,9 +584,10 @@ def test_bench_json_schema_v13_carries_elastic_chaos_arm():
     # chip queue: the ELASTIC step + its experiment
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert "profile_bench.py ELASTIC" in queue and "16/16" in queue, (
-        "run_chip_queue.sh lost the 16/16 ELASTIC chaos step "
-        "(ISSUE 14 queues it for the next chip window)")
+    assert "profile_bench.py ELASTIC" in queue and "17/17" in queue, (
+        "run_chip_queue.sh lost the ELASTIC chaos step (ISSUE 14 "
+        "queues it for the next chip window; ISSUE 16 renumbered it "
+        "17/17 when the compressed-carry arm landed as 16)")
     assert "exp_ELASTIC" in open(os.path.join(
         base, "tools", "profile_bench.py")).read(), (
         "profile_bench.py lost the exp_ELASTIC experiment the queue "
@@ -595,18 +597,20 @@ def test_bench_json_schema_v13_carries_elastic_chaos_arm():
 def test_chip_queue_carries_pod_step():
     """ISSUE 13: the next chip window must price the multi-host
     weak-scaling sweep on a real pod slice —
-    scripts/run_chip_queue.sh carries the POD step (15/16 since
-    ISSUE 14 appended the ELASTIC arm as 16) and profile_bench.py
-    defines the exp_POD experiment it runs."""
+    scripts/run_chip_queue.sh carries the POD step (15/17 since
+    ISSUE 14 appended the ELASTIC arm and ISSUE 16 the
+    compressed-carry arm) and profile_bench.py defines the exp_POD
+    experiment it runs."""
     queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
                          "run_chip_queue.sh")
     src = open(queue).read()
     assert "profile_bench.py POD" in src, (
         "run_chip_queue.sh lost the POD multi-host weak-scaling sweep "
         "(ISSUE 13 queues it for the next chip window)")
-    assert "15/16" in src, (
-        "run_chip_queue.sh lost the 15/16 step numbering (exp_POD is "
-        "queue step 15; ISSUE 14's exp_ELASTIC is 16)")
+    assert "15/17" in src, (
+        "run_chip_queue.sh lost the 15/17 step numbering (exp_POD is "
+        "queue step 15; ISSUE 16's compressed arm is 16, ISSUE 14's "
+        "exp_ELASTIC is 17)")
     assert "exp_POD" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
@@ -615,6 +619,75 @@ def test_chip_queue_carries_pod_step():
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr
+
+
+def test_bench_json_schema_v14_carries_compressed_carry_arm():
+    """ISSUE 16: schema v14 adds the compressed-carry arm to the
+    multihost block — bytes-on-wire measured ON the channel,
+    compression ratio, efficiency-at-constant-bytes, overlap fraction
+    and the f32-escape-hatch bitwise pin — plus the runtime it drives
+    (the carry codec registry, the two-phase overlapped gather on
+    HostChannel, early contributions on ElasticChannel, the cli
+    wiring) and the renumbered chip-queue step.  Static source check
+    like the v3-v13 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 14, (
+        "bench schema must stay >= v14 (compressed-carry arm)")
+    for field in ('"compress"', "carry_wire_bytes_per_round",
+                  "carry_compression_ratio", "wire_reduction_vs_f32",
+                  "efficiency_at_constant_bytes", "overlap_fraction",
+                  "bitwise_f32_escape_ok", "acc_delta_vs_f32"):
+        assert field in src, (
+            f"bench.py lost the v14 compressed-carry field {field} "
+            "(see fedml_tpu/parallel/carry_codec.py ISSUE 16)")
+    base = os.path.join(os.path.dirname(__file__), "..")
+    # the codec module: registry + the three wire tiers
+    codec = open(os.path.join(base, "fedml_tpu", "parallel",
+                              "carry_codec.py")).read()
+    for sym in ("CARRY_CODECS", "class CarryCodec",
+                "class Int8CarryCodec", "class Int8EFCarryCodec",
+                "def make_carry_codec"):
+        assert sym in codec, (
+            f"fedml_tpu/parallel/carry_codec.py lost {sym!r} — the "
+            "ISSUE-16 wire tier the v14 compress arm drives")
+    # f32 must stay the registry DEFAULT (the bitwise escape hatch)
+    assert re.search(r'CARRY_CODECS\s*=\s*\(\s*"f32"', codec), (
+        "the carry codec registry must keep f32 first/default — the "
+        "PR-13/14 bitwise anchors ride it")
+    # the overlap substrate on both channels
+    mh = open(os.path.join(base, "fedml_tpu", "parallel",
+                           "multihost.py")).read()
+    for sym in ("def gather_begin", "def gather_push",
+                "def gather_finish", "def gather_abort",
+                "def contrib_begin", "def contrib_push",
+                "def mark_round", "def round_wire_delta"):
+        assert sym in mh, (
+            f"fedml_tpu/parallel/multihost.py lost {sym!r} — the "
+            "ISSUE-16 overlapped exchange / wire-delta substrate")
+    # bench_diff must judge the new fields
+    bd = open(os.path.join(base, "tools", "bench_diff.py")).read()
+    for field in ("wire_reduction_vs_f32", "efficiency_at_constant_bytes",
+                  "acc_delta_vs_f32", "bitwise_f32_escape_ok"):
+        assert field in bd, (
+            f"tools/bench_diff.py lost the compressed-carry rule field "
+            f"{field} (the v14 acceptance gate)")
+    # cli wiring: codec choice + overlap opt-in, f32/serial defaults
+    cli = open(os.path.join(base, "fedml_tpu", "cli.py")).read()
+    assert "--carry_codec" in cli and "--overlap_exchange" in cli, (
+        "fedml_tpu/cli.py lost the ISSUE-16 wire-tier flags")
+    assert re.search(r'default="f32"', cli), (
+        "--carry_codec must default to f32 (the bitwise escape hatch)")
+    # chip queue: the compressed arm rides exp_POD, renumbered 16/17
+    queue = open(os.path.join(base, "scripts",
+                              "run_chip_queue.sh")).read()
+    assert "FEDML_POD_ARMS=compress" in queue and "16/17" in queue, (
+        "run_chip_queue.sh lost the 16/17 compressed-carry step "
+        "(ISSUE 16 prices the bytes column on real DCN frames)")
+    assert "FEDML_POD_ARMS" in open(os.path.join(
+        base, "tools", "profile_bench.py")).read(), (
+        "profile_bench.py exp_POD lost the FEDML_POD_ARMS override "
+        "the queue's compressed step uses")
 
 
 def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
@@ -655,9 +728,9 @@ def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
 
 def test_chip_queue_carries_bench_diff_step():
     """ISSUE 12: the chip queue's judgment pass diffs the fresh bench
-    record against the committed trajectory (step 14/16 since ISSUEs
-    13/14 appended exp_POD and exp_ELASTIC), and the script stays
-    shell-valid."""
+    record against the committed trajectory (step 14/17 since ISSUEs
+    13-16 appended exp_POD, exp_ELASTIC and the compressed-carry
+    arm), and the script stays shell-valid."""
     import subprocess
     queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
                          "run_chip_queue.sh")
@@ -665,10 +738,10 @@ def test_chip_queue_carries_bench_diff_step():
     assert "bench_diff.py" in src, (
         "run_chip_queue.sh lost the bench_diff regression step "
         "(ISSUE 12 appends it as the queue's judgment pass)")
-    assert "14/16" in src, (
-        "run_chip_queue.sh lost the 14/16 bench_diff step numbering "
+    assert "14/17" in src, (
+        "run_chip_queue.sh lost the 14/17 bench_diff step numbering "
         "(the judgment pass rides right after the bench artifacts; "
-        "exp_POD is 15, exp_ELASTIC 16)")
+        "exp_POD is 15, the compressed arm 16, exp_ELASTIC 17)")
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr

@@ -12,7 +12,7 @@ review — and emits named regression/improvement verdicts:
     python tools/bench_diff.py --dir .          # BENCH_r*.json trajectory
     python tools/bench_diff.py OLD NEW --json out.json
 
-Accepted input shapes (schema v4-v13, normalized by `prune()`):
+Accepted input shapes (schema v4-v14, normalized by `prune()`):
 
   * a raw bench.py JSON line (any --mode);
   * a driver record wrapping one under "parsed" (BENCH_r*.json);
@@ -40,7 +40,12 @@ Noise-band sources (don't tighten without re-measuring):
   * attack accuracies: the quality-band convention (+-0.04 absolute,
     benchmarks/quality_bands.json);
   * serve: registry bytes/client is deterministic (1% band); the
-    sustain ratio carries PR-10's 0.5 floor.
+    sustain ratio carries PR-10's 0.5 floor;
+  * multihost compress (v14): wire_reduction_vs_f32 is deterministic
+    per (dim, chunk) — tight band with the ISSUE-16 >= 3x gate;
+    acc_delta_vs_f32 rides the +-0.04 quality-band convention;
+    bitwise_f32_escape_ok is a boolean pin (the f32 escape hatch must
+    stay byte-identical under overlap).
 """
 from __future__ import annotations
 
@@ -52,7 +57,7 @@ import os
 import sys
 from typing import Optional
 
-SCHEMA_MIN, SCHEMA_MAX = 2, 13
+SCHEMA_MIN, SCHEMA_MAX = 2, 14
 
 
 # ---------------------------------------------------------------------------
@@ -186,6 +191,18 @@ def prune(doc: dict) -> dict:
             if row.get("carry_allreduce_bytes_per_round") is not None:
                 f[f"carry_bytes_per_round[procs={n}]"] = \
                     row["carry_allreduce_bytes_per_round"]
+        # v14 compressed carry arm (ISSUE 16)
+        cp = m.get("compress") or {}
+        f["bitwise_f32_escape_ok"] = cp.get("bitwise_f32_escape_ok")
+        f["f32_overlap_fraction"] = cp.get("f32_overlap_fraction")
+        for crow in cp.get("codecs") or []:
+            cname = crow.get("codec")
+            for k in ("wire_reduction_vs_f32", "acc_delta_vs_f32",
+                      "carry_wire_bytes_per_round",
+                      "efficiency_at_constant_bytes",
+                      "overlap_fraction", "ranks_agree"):
+                if crow.get(k) is not None:
+                    f[f"{k}[codec={cname}]"] = crow[k]
     elif mode == "connections":
         c = doc.get("connections") or {}
         deaths, leaks = 0.0, 0.0
@@ -327,6 +344,12 @@ RULES: dict[tuple, Rule] = {
         0, note="detection->re-tasked wall; box-load sensitive"),
     ("multihost", "view_changes"): Rule(
         0, note="death + (optional) rejoin admissions"),
+    # -- multihost compress (ISSUE 16): the f32 overlap fraction is a
+    # wall-clock ratio on a loaded box — informational; the boolean
+    # escape-hatch pin rides the boolean gate path.
+    ("multihost", "f32_overlap_fraction"): Rule(
+        0, note="box-load sensitive; the >0 acceptance rides the "
+                "codec rows"),
 }
 # pattern rules for the per-count connection fields
 PATTERN_RULES: list[tuple] = [
@@ -339,6 +362,23 @@ PATTERN_RULES: list[tuple] = [
      Rule(+1, 0.65, note="GIL/loopback noise band")),
     ("multihost", "carry_bytes_per_round[",
      Rule(0, note="deterministic per topology; informational")),
+    # -- multihost compress per-codec fields (ISSUE 16)
+    ("multihost", "wire_reduction_vs_f32[",
+     Rule(+1, 0.10, gate_min=3.0,
+          note="ISSUE-16 >=3x bytes gate; deterministic per "
+               "(dim, chunk) so the band is tight")),
+    ("multihost", "acc_delta_vs_f32[",
+     Rule(-1, 0.0, abs_band=0.04, gate_max=0.04,
+          note="quality-band +-0.04 absolute on the compressed arm")),
+    ("multihost", "carry_wire_bytes_per_round[",
+     Rule(0, note="measured on the wire via the channel round delta; "
+                  "informational — the gated ratio judges")),
+    ("multihost", "efficiency_at_constant_bytes[",
+     Rule(+1, 0.65, note="rps ratio x wire reduction; rps is "
+                         "GIL/loopback-noisy on the 2-core box")),
+    ("multihost", "overlap_fraction[",
+     Rule(0, note="wall-clock ratio, box-load sensitive; "
+                  "informational")),
 ]
 # v11 slo block: clean arms must stay breach-free in EVERY mode
 SLO_RULE = Rule(-1, 0.0, gate_max=0.0,

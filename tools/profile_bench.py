@@ -1191,15 +1191,25 @@ def exp_POD():
     deaths, and weak-scaling efficiency at 2 processes — the 2-core
     CPU floor is 0.5x; on a pod slice each process owns real chips, so
     the measured point prices the DCN carry tier for the v4-128
-    projection."""
+    projection.
+
+    Since schema v14 the default arm set includes the COMPRESSED-carry
+    arm (ISSUE 16): bytes-on-wire per round, compression ratio,
+    efficiency-at-constant-bytes and overlap fraction measured on the
+    channel itself — on a pod slice the bytes column prices real DCN
+    frames instead of loopback.  FEDML_POD_ARMS narrows the arm set
+    (e.g. `FEDML_POD_ARMS=compress` reruns just the wire-tier A/B)."""
     import subprocess
     procs = os.environ.get("FEDML_POD_PROCS", "1,2,4")
     bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "..", "bench.py")
+    cmd = [sys.executable, bench, "--mode", "multihost",
+           "--mh_procs", procs]
+    arms = os.environ.get("FEDML_POD_ARMS")
+    if arms:
+        cmd += ["--mh_arms", arms]
     r = subprocess.run(
-        [sys.executable, bench, "--mode", "multihost",
-         "--mh_procs", procs],
-        text=True, capture_output=True, timeout=3600)
+        cmd, text=True, capture_output=True, timeout=3600)
     sys.stderr.write(r.stderr)
     print(r.stdout, flush=True)
     if r.returncode != 0:
