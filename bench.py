@@ -148,6 +148,19 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     and efficiency_at_constant_bytes ((rps_codec/rps_f32) x
 #     wire_reduction — rounds per byte-budget).  --mh_arms grows
 #     "compress"; v13 readers that ignore unknown keys keep working
+# v15: the "multihost" block gains the "straggler" block (ISSUE 17 —
+#     fedml_tpu/obs/cluster.py, the cluster observatory): rank 0 keeps
+#     an always-on barrier ledger (per-rank arrival timestamps at every
+#     gather/allgather/exchange), so the chaos arm now also reports WHO
+#     gated each round — barriers observed on the clean and killed
+#     elastic runs, per-rank gating_counts, top_gating_rank,
+#     worst_gate_margin_s, per_rank_wait_s percentiles, the last few
+#     ledger entries (each naming its round_gating_rank), plus the
+#     cluster SLO verdicts: cluster_clean_breaches (must be 0 — the
+#     clean arm's cluster pack is green) and cluster_killed_breached
+#     (the killed arm MUST breach cluster_no_rank_deaths with rank 1
+#     named in the attribution — straggler_attribution_ok pins that);
+#     v14 readers that ignore unknown keys keep working
 # v8: + "attack" block (`python bench.py --mode attack`, ISSUE 9 —
 #     fedml_tpu/async_/adversary.py + defense.py): a "matrix" of
 #     attack x defense arms on the async MNIST-LR workload (each row:
@@ -160,7 +173,7 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 
 
 # the programs block's window opens when main() configures obs (set
@@ -1574,6 +1587,7 @@ def _bench_multihost(args) -> None:
     # default everywhere else in this mode — the weak/bitwise arms
     # above run the non-elastic runtime unchanged.
     chaos = None
+    straggler = None
     if "chaos" in arms:
         cp = args.mh_chaos_procs
         # the killed arm pays ONE detection stall (~hb_timeout) at the
@@ -1636,6 +1650,52 @@ def _bench_multihost(args) -> None:
                   f"{chaos['view_change_latency_s']*1e3:.1f} ms, "
                   f"bitwise_after_death_ok="
                   f"{chaos['bitwise_after_death_ok']}",
+                  file=sys.stderr)
+            # v15 straggler block (ISSUE 17): rank 0's always-on
+            # barrier ledger + cluster SLO verdicts, from the SAME
+            # chaos clusters — no extra spawns.  The killed arm must
+            # breach cluster_no_rank_deaths AND name rank 1 dead in
+            # the attribution; the clean arm's cluster pack must be
+            # green (loopback barrier waits are µs-ms, far under the
+            # 2.5 s p95 ceiling).
+            c_sl = clean_docs[0].get("straggler") or {}
+            k_sl = killed_docs[0].get("straggler") or {}
+            c_slo = clean_docs[0].get("cluster_slo") or {}
+            k_slo = killed_docs[0].get("cluster_slo") or {}
+            k_attr = k_slo.get("attribution") or {}
+            straggler = {
+                "clean_barriers": c_sl.get("barriers", 0),
+                "killed_barriers": k_sl.get("barriers", 0),
+                "clean_gating_counts": c_sl.get(
+                    "gating_counts", {}),
+                "killed_gating_counts": k_sl.get(
+                    "gating_counts", {}),
+                "top_gating_rank": k_sl.get("top_gating_rank"),
+                "worst_gate_margin_s": k_sl.get(
+                    "worst_gate_margin_s"),
+                "per_rank_wait_s": k_sl.get("per_rank_wait_s", {}),
+                # tail of the ledger — each entry names its
+                # round_gating_rank and per-rank waits_s
+                "recent": (k_sl.get("recent") or [])[-4:],
+                "cluster_clean_breaches": len(
+                    c_slo.get("breached") or []),
+                "cluster_killed_breached": sorted(
+                    k_slo.get("breached") or []),
+                "straggler_attribution_ok": bool(
+                    k_sl.get("barriers", 0) > 0
+                    and "1" in (k_attr.get("dead_ranks") or [])
+                    and "cluster_no_rank_deaths"
+                    in (k_slo.get("breached") or [])),
+            }
+            print(f"multihost straggler ledger: clean "
+                  f"{straggler['clean_barriers']} / killed "
+                  f"{straggler['killed_barriers']} barriers, "
+                  f"top_gating_rank="
+                  f"{straggler['top_gating_rank']}, "
+                  f"clean breaches "
+                  f"{straggler['cluster_clean_breaches']}, "
+                  f"attribution_ok="
+                  f"{straggler['straggler_attribution_ok']}",
                   file=sys.stderr)
         except MultihostLaunchError as e:
             print(f"multihost elastic chaos arm FAILED: {e}",
@@ -1767,6 +1827,7 @@ def _bench_multihost(args) -> None:
             "weak_efficiency_4p": _eff(4),
             "bitwise_2proc_ok": bitwise_ok,
             "chaos": chaos,
+            "straggler": straggler,
             "compress": compress,
             "process_deaths": deaths_total,
             "k_per_block": args.mh_k_per_block,

@@ -1001,4 +1001,9 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
         c.finish()
     for t in threads:
         t.join(timeout=10)
+    # the final commit may have run finish() ON a pool worker (where it
+    # cannot self-join); this external idempotent finish() is the
+    # quiescing join the pool contract promises, so callers reading the
+    # ingest metrics (pool depth, decode walls) see a drained pool
+    server.finish()
     return jax.tree.map(jnp.asarray, server.variables), server

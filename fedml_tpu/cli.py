@@ -1172,15 +1172,22 @@ def main(argv: Optional[list[str]] = None) -> int:
         port = obs.serve_http(args.obs_http_port).port
         logging.getLogger(__name__).info(
             "obs introspection endpoint on http://127.0.0.1:%d "
-            "(/metrics /rollup /healthz /slo /flight)", port)
+            "(/metrics /rollup /healthz /slo /cluster /flight)", port)
     slo_engine = None
     if args.slo:
         if args.slo_period_s <= 0:
             raise SystemExit(
                 f"--slo_period_s must be > 0, got {args.slo_period_s}")
         from fedml_tpu.obs import slo as slo_mod
-        slo_engine = slo_mod.SloEngine(
-            slo_mod.default_slo_pack()).start(args.slo_period_s)
+        specs = slo_mod.default_slo_pack()
+        if mh_ctx is not None and mh_ctx.rank == 0 and mh_ctx.world > 1:
+            # the coordinator judges the CLUSTER too (ISSUE 17): its
+            # folded registry carries every rank's series, so the
+            # cluster pack (round floor, barrier-wait p95, view-change
+            # latency, zero deaths) evaluates alongside the local one
+            from fedml_tpu.obs import cluster as cluster_mod
+            specs = specs + cluster_mod.cluster_slo_pack()
+        slo_engine = slo_mod.SloEngine(specs).start(args.slo_period_s)
     if mh_ctx is not None and mh_ctx.jax_coordinator:
         # launcher-wired jax.distributed (chip path: makes each host's
         # local chips visible); must run before any backend init

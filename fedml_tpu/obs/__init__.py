@@ -147,9 +147,10 @@ def reset() -> None:
         old_http.close()
     from fedml_tpu.obs import propagate
     propagate.reset_clocks()
-    from fedml_tpu.obs import programs, slo
+    from fedml_tpu.obs import cluster, programs, slo
     programs.reset()
     slo.reset()
+    cluster.reset()
 
 
 # -- tracing -----------------------------------------------------------------
@@ -328,6 +329,10 @@ def export() -> dict[str, str]:
                             (obs/propagate.py), when any traffic was
                             trace-stamped — the timeline tool's
                             cross-process alignment input
+        barrier_ledger.json per-barrier arrival/wait ledger
+                            (obs/cluster.py), written on the
+                            coordinator when any barrier was recorded
+                            — trace_timeline's straggler annotations
 
     Returns {artifact: path}.  No-op ({}) when disabled."""
     t, d = _tracer, _dir
@@ -353,6 +358,11 @@ def export() -> dict[str, str]:
         with open(cj, "w") as f:
             json.dump(clocks, f, indent=1)
         out["clock_offsets"] = cj
+    from fedml_tpu.obs import cluster
+    cluster.export_dir(d)
+    bl = os.path.join(d, "barrier_ledger.json")
+    if os.path.exists(bl):
+        out["barrier_ledger"] = bl
     return out
 
 

@@ -10,7 +10,15 @@ ThreadingHTTPServer (zero dependencies) serves:
     /rollup    obs.rollup() JSON — headline counters + artifact paths
     /healthz   200 + {status, pid, uptime_s} — the liveness probe
     /slo       the installed SLO engine's pack report (obs/slo.py);
-               503 until one is installed (cli --slo or SloEngine.start)
+               503 until one is installed (cli --slo or SloEngine.start).
+               Carries ``scope: local|cluster`` (ISSUE 17): "local"
+               means ONE rank's view — a dashboard must not read a
+               worker's green as the cluster's
+    /cluster   the cluster observatory (obs/cluster.py): per-rank
+               liveness/epoch/last-fold age, the barrier straggler
+               summary, the cluster SLO view, top counters — real
+               cluster-wide data only on the coordinator
+               (scope == "cluster")
     /flight    POST: trigger a flight-recorder dump, return its path.
                GET: return the LAST dump's path WITHOUT triggering —
                a metrics scraper or browser prefetch walking the
@@ -35,6 +43,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 class ObsHttpServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         from fedml_tpu import obs
+        from fedml_tpu.obs import cluster as cluster_mod
         from fedml_tpu.obs import slo as slo_mod
         started = time.monotonic()
 
@@ -69,7 +78,14 @@ class ObsHttpServer:
                                                   "installed (cli --slo "
                                                   "or SloEngine.start)"})
                     else:
-                        self._json(200, eng.report())
+                        doc = eng.report()
+                        # scope marks whose truth this is: "local" =
+                        # this rank only; "cluster" = the coordinator's
+                        # folded view (ISSUE 17 satellite)
+                        doc["scope"] = cluster_mod.scope()
+                        self._json(200, doc)
+                elif path == "/cluster":
+                    self._json(200, cluster_mod.cluster_report())
                 elif path == "/flight":
                     # READ-ONLY: report the last dump, never trigger —
                     # GETs must stay safe (scrapers, prefetchers)

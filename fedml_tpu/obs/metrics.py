@@ -96,6 +96,10 @@ CANONICAL_BUCKETS = {
     # full engine round in seconds — the same sub-ms-to-seconds ladder
     # the decode walls use resolves both ends
     "program_dispatch_seconds": DECODE_SECONDS_BUCKETS,
+    # per-rank commit-barrier waits (ISSUE 17, obs/cluster.py): a
+    # loopback barrier gates in µs-ms, a straggler/death stall spills
+    # into seconds — the same sub-ms-to-seconds ladder covers both
+    "multihost_barrier_wait_seconds": DECODE_SECONDS_BUCKETS,
 }
 
 

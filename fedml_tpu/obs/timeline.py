@@ -368,10 +368,59 @@ def lane_events(report: dict) -> list[dict]:
     return out
 
 
+BARRIER_PID = LANES_PID + 1  # synthetic "cluster barriers" process row
+
+
+def barrier_lane_events(entries: list[dict]) -> list[dict]:
+    """Synthetic Chrome events rendering the coordinator's barrier
+    ledger (obs/cluster.py, ISSUE 17) as per-RANK lanes: each rank's
+    wait behind the gate is a slice ending at the gate instant, the
+    gating rank's slice is labeled GATE, and a tid-0 instant names the
+    gating rank per barrier — the cross-rank straggler view next to
+    the per-stage critical path."""
+    if not entries:
+        return []
+    ranks = sorted({int(r) for e in entries
+                    for r in e.get("waits_s", {})})
+    out = [{"name": "process_name", "ph": "M", "pid": BARRIER_PID,
+            "tid": 0, "args": {"name": "cluster barriers"}}]
+    tid_of = {}
+    for i, r in enumerate(ranks):
+        tid_of[r] = i + 1
+        out.append({"name": "thread_name", "ph": "M",
+                    "pid": BARRIER_PID, "tid": i + 1,
+                    "args": {"name": f"rank {r} wait"}})
+    for e in entries:
+        gate_us = e["t_unix"] * 1e6
+        gating = e.get("round_gating_rank")
+        label = (f"round {e['round']}" if e.get("round") is not None
+                 else f"{e.get('kind', 'barrier')} #{e.get('seq')}")
+        out.append({"name": f"gate: rank {gating} ({label})",
+                    "ph": "i", "pid": BARRIER_PID, "tid": 0,
+                    "ts": gate_us, "s": "p",
+                    "args": {"round_gating_rank": gating,
+                             "gate_margin_s": e.get("gate_margin_s"),
+                             "kind": e.get("kind"),
+                             "seq": e.get("seq")}})
+        for r_str, w in e.get("waits_s", {}).items():
+            r = int(r_str)
+            out.append({"name": ("GATE" if r == gating else "wait"),
+                        "ph": "X", "pid": BARRIER_PID,
+                        "tid": tid_of[r], "ts": gate_us - w * 1e6,
+                        "dur": max(w * 1e6, 1.0),
+                        "args": {"rank": r, "wait_s": w,
+                                 "round": e.get("round"),
+                                 "gating": r == gating}})
+    return out
+
+
 def export_chrome(events: list[dict], path: str,
-                  report: Optional[dict] = None) -> str:
-    doc = {"traceEvents": (events + (lane_events(report) if report
-                                     else [])),
+                  report: Optional[dict] = None,
+                  barriers: Optional[list[dict]] = None) -> str:
+    doc = {"traceEvents": (events
+                           + (lane_events(report) if report else [])
+                           + (barrier_lane_events(barriers)
+                              if barriers else [])),
            "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(doc, f)

@@ -157,6 +157,20 @@ def main(argv=None) -> int:
     n_blocks = cfg["n_blocks"] or ctx.world
     rejoining = (os.environ.get("FEDML_MH_REJOIN") == "1"
                  and ctx.rank != 0)
+    obs_root = os.environ.get("FEDML_OBS_DIR")
+    if obs_root:
+        # per-RANK obs namespace, same scheme as the cli (ISSUE 17):
+        # co-spawned workers handed one dir would race each other's
+        # exports, and a rejoining incarnation reuses its rank id —
+        # namespace it by pid so both incarnations' traces survive.
+        # Enabling obs here also arms the telemetry piggybacks and the
+        # coordinated-dump fan-out; with the env unset the wire stays
+        # byte-identical to the pre-observatory channel.
+        from fedml_tpu import obs
+        sub = f"rank{ctx.rank}"
+        if os.environ.get("FEDML_MH_REJOIN") == "1":
+            sub = f"rank{ctx.rank}-pid{os.getpid()}"
+        obs.configure(os.path.join(obs_root, sub))
 
     current_mode = {"mode": None}
 
@@ -290,6 +304,15 @@ def main(argv=None) -> int:
                   "carry_payload_bytes_per_round",
                   "carry_raw_bytes_per_round", "overlap_fraction"):
             out[k] = out["per_mode"][head][k]
+        if ctx.rank == 0:
+            # cluster observatory (ISSUE 17): the coordinator's barrier
+            # ledger + cluster SLO verdict ride the worker doc — both
+            # are always-on local bookkeeping, so the bench straggler
+            # block and the spawned test pins read them without
+            # enabling obs
+            from fedml_tpu.obs import cluster as cluster_mod
+            out["straggler"] = cluster_mod.straggler_summary()
+            out["cluster_slo"] = cluster_mod.cluster_slo_report()
         out["jax"] = jax.__version__
         print(json.dumps(out), flush=True)
     finally:

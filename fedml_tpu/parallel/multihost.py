@@ -93,6 +93,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from fedml_tpu import obs
+from fedml_tpu.obs import cluster as _cluster
 from fedml_tpu.parallel.mesh import CLIENT_AXIS, make_mesh, make_mesh_2d
 
 log = logging.getLogger(__name__)
@@ -653,9 +654,13 @@ class HostChannel:
         self.bytes_received = 0
         self._mark = (0, 0)
         self._seq = 0
+        # the runner stamps the round in flight here so the barrier
+        # ledger (ISSUE 17) can attribute gather waits to a round
+        self.round_hint: Optional[int] = None
         self._peers: dict[int, socket.socket] = {}
         self._sock: Optional[socket.socket] = None
         self._listener: Optional[socket.socket] = None
+        _cluster.set_role(ctx.rank, ctx.world)
         if ctx.world <= 1:
             return
         host, port = ctx.coordinator.rsplit(":", 1)
@@ -721,6 +726,12 @@ class HostChannel:
         deadline = time.monotonic() + timeout
         try:
             if ctx.rank == 0:
+                # barrier ledger (ISSUE 17): rank 0 is the star's single
+                # observer — its own arrival is the loop open, each
+                # peer's is its frame landing.  Piggybacked metric
+                # sidecars are stripped BEFORE the broadcast, so every
+                # rank folds the identical payload bytes.
+                arrivals = {0: time.monotonic()}
                 parts: list[Optional[bytes]] = [None] * ctx.world
                 parts[0] = payload
                 for r in sorted(self._peers):
@@ -737,7 +748,13 @@ class HostChannel:
                             f"payload from rank(s) {missing} within "
                             f"{timeout:.0f}s ({type(e).__name__}: "
                             f"process dead or hung)") from e
+                    arrivals[r] = time.monotonic()
                     self.bytes_received += len(parts[r])
+                    parts[r], side = _cluster.split_sidecar(parts[r])
+                    if side is not None:
+                        _cluster.fold_remote(r, side)
+                _cluster.note_barrier("allgather", self._seq,
+                                      self.round_hint, arrivals)
                 blob = struct.pack("<I", ctx.world) + b"".join(
                     struct.pack("<Q", len(p)) + p for p in parts)
                 for r in sorted(self._peers):
@@ -757,15 +774,23 @@ class HostChannel:
             # near-expired recv deadline the previous allgather left
             self._sock.settimeout(max(0.001,
                                       deadline - time.monotonic()))
+            # live telemetry plane (ISSUE 17): ship a bounded metrics
+            # delta as a self-describing payload trailer — rank 0
+            # strips it before the broadcast.  Attached ONLY when an
+            # obs dir is configured: the obs-off wire stays
+            # byte-identical.
+            out = payload
+            if _cluster.telemetry_enabled():
+                out = _cluster.attach_sidecar(payload, _piggyback_delta())
             try:
-                _send_frame(self._sock, payload)
+                _send_frame(self._sock, out)
             except (socket.timeout, ConnectionError, OSError) as e:
                 raise DeadRankError(
                     f"multihost allgather #{self._seq}: rank {ctx.rank} "
                     f"could not ship its payload to the rank-0 "
                     f"coordinator ({type(e).__name__}: coordinator dead "
                     f"or backpressured past {timeout:.0f}s)") from e
-            self.bytes_sent += len(payload) + 8
+            self.bytes_sent += len(out) + 8
             self._sock.settimeout(max(0.001, deadline - time.monotonic()))
             try:
                 blob = _recv_frame(self._sock)
@@ -825,13 +850,16 @@ class HostChannel:
                                      name=f"gather#{h.seq}")
         return h
 
-    def _collect_frames(self, h: _GatherHandle) -> dict[int, list[bytes]]:
+    def _collect_frames(self, h: _GatherHandle):
         """Rank 0's background collector: drain every peer's frames in
         per-peer FIFO order while rank 0's own blocks compute.  Runs on
         the gather handle's AsyncValue thread; joined in
-        gather_finish() (errors re-raise there)."""
+        gather_finish() (errors re-raise there).  Returns (frames,
+        arrivals): a peer "arrives" at the barrier when its LAST frame
+        lands — the ledger stamp the straggler attribution keys on."""
         remaining = {r: h.n_frames for r in self._peers}
         frames: dict[int, list[bytes]] = {r: [] for r in self._peers}
+        arrivals: dict[int, float] = {}
         by_sock = {s: r for r, s in self._peers.items()}
         while any(remaining.values()) and not h.aborted:
             budget = h.deadline - time.monotonic()
@@ -858,7 +886,9 @@ class HostChannel:
                 self.bytes_received += len(f)
                 frames[r].append(f)
                 remaining[r] -= 1
-        return frames
+                if remaining[r] == 0:
+                    arrivals[r] = time.monotonic()
+        return frames, arrivals
 
     def gather_push(self, h: _GatherHandle, frame: bytes) -> None:
         """Ship one frame into an open gather.  Rank 0 stashes locally
@@ -902,10 +932,17 @@ class HostChannel:
                 f"but {h.n_frames} promised — the collective would "
                 f"hang every peer")
         if ctx.rank == 0:
+            # rank 0 "arrives" when its own frames are all pushed and
+            # it enters the finish — the collector stamps each peer
+            t_own = time.monotonic()
             parts: list[bytes] = [b""] * ctx.world
             parts[0] = b"".join(h.own)
-            frames = (h.collector.result() if h.collector is not None
-                      else {r: [] for r in self._peers})
+            frames, arrivals = (h.collector.result()
+                                if h.collector is not None
+                                else ({r: [] for r in self._peers}, {}))
+            arrivals[0] = t_own
+            _cluster.note_barrier("gather", h.seq, self.round_hint,
+                                  arrivals)
             for r, fl in frames.items():
                 parts[r] = b"".join(fl)
             blob = struct.pack("<I", ctx.world) + b"".join(
@@ -1169,9 +1206,14 @@ class ElasticChannel:
         self._pending_rejoin: list[tuple[int, socket.socket]] = []
         host, port = ctx.coordinator.rsplit(":", 1)
         self._host, self._port = host, int(port)
+        _cluster.set_role(ctx.rank, ctx.world, elastic=True)
         if ctx.world <= 1:
             return
         if ctx.rank == 0:
+            # coordinated incident dumps (ISSUE 17): the observatory's
+            # throttled chokepoint fans out through this channel's
+            # typed DUMP frames (telemetry-gated — obs-off wire clean)
+            _cluster.set_dump_broadcaster(self._broadcast_dump_frames)
             grace = time.monotonic() + self.connect_timeout_s
             for m in self.view.members:
                 if m != 0:
@@ -1246,8 +1288,17 @@ class ElasticChannel:
     def _hb_loop(self) -> None:
         while not self._closed:
             if not self.hb_paused:
+                # live telemetry plane (ISSUE 17): piggyback a bounded
+                # metrics delta on the heartbeat header.  With
+                # telemetry off the header stays exactly {} — the
+                # obs-off heartbeat bytes are byte-identical.
+                hdr = {}
+                if _cluster.telemetry_enabled():
+                    d = _piggyback_delta()
+                    if d is not None:
+                        hdr["delta"] = d
                 try:
-                    self._send(self._hb_sock, "hb", {})
+                    self._send(self._hb_sock, "hb", hdr)
                 except OSError:
                     return      # coordinator gone: the data path names it
             time.sleep(self.hb_interval_s)
@@ -1367,10 +1418,14 @@ class ElasticChannel:
         conn.settimeout(self.hb_timeout_s)
         while not self._closed:
             try:
-                self._recv(conn)   # byte-counted like every frame
+                mtype, hdr, _ = self._recv(conn)   # byte-counted
                 with self._lock:
                     self._hb_last[rank] = time.monotonic()
                     self._suspect.pop(rank, None)
+                _cluster.note_heartbeat(rank)
+                delta = (hdr.get("delta") if mtype == "hb" else None)
+                if delta:
+                    _cluster.fold_remote(rank, delta)
             except socket.timeout:
                 with self._lock:
                     fresh = (rank in self.view.members
@@ -1475,6 +1530,10 @@ class ElasticChannel:
         log.warning("elastic view change: epoch %d, rank(s) %s evicted "
                     "(%s), members now %s (%.1f ms)", view.epoch, dead,
                     reason, list(view.members), latency * 1e3)
+        # coordinated incident dump (ISSUE 17): every survivor snapshots
+        # the same incident window (throttled; no-op with telemetry off)
+        _cluster.maybe_coordinated_dump(
+            f"view_change:epoch{view.epoch}:dead{dead}")
         # rank 0's own re-adoption (outside the latency window: this is
         # recompute goodput, the survivors are already re-tasked)
         if have is not None and compute is not None:
@@ -1573,6 +1632,10 @@ class ElasticChannel:
 
     def _exchange_coord(self, round_idx, parts, compute):
         have: dict[int, bytes] = dict(parts)
+        # barrier ledger (ISSUE 17): rank 0 arrives with its own parts
+        # in hand; each member arrives at its first accepted contrib
+        # for THIS round.  Dead ranks never arrive and stay absent.
+        arrivals: dict[int, float] = {self.ctx.rank: time.monotonic()}
         deadline = time.monotonic() + self.timeout_s
         while True:
             missing = [b for b in range(self.n_items) if b not in have]
@@ -1645,6 +1708,7 @@ class ElasticChannel:
                                 "round %s from rank %d (at round %d)",
                                 hdr.get("round"), m, round_idx)
                     continue
+                arrivals.setdefault(m, time.monotonic())
                 blocks = [int(b) for b in hdr.get("blocks", [])]
                 if self._item_nbytes is None and blocks:
                     self._item_nbytes = len(payload) // len(blocks)
@@ -1659,6 +1723,8 @@ class ElasticChannel:
                 for j, b in enumerate(blocks):
                     if 0 <= b < self.n_items and b not in have:
                         have[b] = payload[j * sz:(j + 1) * sz]
+        _cluster.note_barrier("exchange", round_idx, round_idx,
+                              arrivals)
         # broadcast the complete, identically-ordered payload set
         blob = b"".join(have[b] for b in range(self.n_items))
         view = self.view
@@ -1723,6 +1789,13 @@ class ElasticChannel:
                         f"tile {self.n_items} items")
                 return ({b: payload[b * sz:(b + 1) * sz]
                          for b in range(self.n_items)}, self.view)
+            elif mtype == "dump":
+                # coordinated incident dump (ISSUE 17): the coordinator
+                # saw a view change / death / SLO breach — snapshot the
+                # same window into THIS rank's obs dir (no-op when obs
+                # is off)
+                obs.dump_flight(
+                    "coordinated:" + str(hdr.get("reason", "")))
             # other message types: ignore
 
     def _send_contrib(self, round_idx: int,
@@ -1868,6 +1941,21 @@ class ElasticChannel:
                             m, "view notification failed")
         return admitted
 
+    def _broadcast_dump_frames(self, reason: str) -> None:
+        """Fan a coordinated-dump order out to every surviving member's
+        data link (registered with the observatory as the DUMP
+        broadcaster at construction).  Best-effort: a member that died
+        between the snapshot and the send is already being handled by
+        the failure detector."""
+        with self._lock:
+            socks = {m: s for m, s in self._data.items()
+                     if m in self.view.members}
+        for m, s in socks.items():
+            try:
+                self._send(s, "dump", {"reason": str(reason)})
+            except OSError:
+                pass
+
     # -- plumbing shared with HostChannel ------------------------------------
     def export_byte_counters(self) -> None:
         _export_channel_byte_counters(self.ctx.rank, self.bytes_sent,
@@ -1875,6 +1963,8 @@ class ElasticChannel:
 
     def close(self) -> None:
         self._closed = True
+        if self.ctx.rank == 0:
+            _cluster.set_dump_broadcaster(None)
         with self._lock:
             socks = (list(self._data.values()) + list(self._hb.values())
                      + [c for _, c in self._pending_rejoin])
@@ -1902,19 +1992,46 @@ class ElasticChannel:
 
 # per-PROCESS metrics-rollup baseline: (registry identity, prev state).
 # Keyed on the registry object so obs.reset() (tests) naturally resets
-# the baseline with it.
+# the baseline with it.  The heartbeat thread's live piggyback (ISSUE
+# 17) and the end-of-run rollup advance the SAME baseline — their
+# shipped windows are disjoint, so rank 0 never double-counts — which
+# is why a lock guards the read-modify-write.
 _rollup_state: Optional[tuple] = None
+_rollup_lock = threading.Lock()
 
 
 def _delta_since_last_rollup() -> dict:
     global _rollup_state
-    reg = obs.registry()
-    prev = (_rollup_state[1]
-            if _rollup_state is not None and _rollup_state[0] is reg
-            else None)
-    delta, state = reg.delta_snapshot(prev)
-    _rollup_state = (reg, state)
-    return delta
+    with _rollup_lock:
+        reg = obs.registry()
+        prev = (_rollup_state[1]
+                if _rollup_state is not None and _rollup_state[0] is reg
+                else None)
+        delta, state = reg.delta_snapshot(prev)
+        _rollup_state = (reg, state)
+        return delta
+
+
+def _piggyback_delta(
+        cap_bytes: int = _cluster.SIDECAR_CAP_BYTES) -> Optional[dict]:
+    """Bounded per-beat metrics delta for the live telemetry plane.
+    Advances the rollup baseline ONLY when something ships: an empty
+    delta returns None, and a delta over the frame budget returns None
+    WITHOUT advancing — it rides a later beat or the final rollup
+    instead of bloating a control frame."""
+    global _rollup_state
+    with _rollup_lock:
+        reg = obs.registry()
+        prev = (_rollup_state[1]
+                if _rollup_state is not None and _rollup_state[0] is reg
+                else None)
+        delta, state = reg.delta_snapshot(prev)
+        if not delta.get("metrics"):
+            return None
+        if len(json.dumps(delta, sort_keys=True).encode()) > cap_bytes:
+            return None
+        _rollup_state = (reg, state)
+        return delta
 
 
 class MultihostRunner:
@@ -2340,6 +2457,7 @@ class MultihostRunner:
                 with obs.span("round.twolevel", round=round_idx,
                               rank=self.ctx.rank,
                               blocks=len(self.owned_blocks)):
+                    self.channel.round_hint = round_idx
                     total = self._round_exchange(variables, round_idx,
                                                  train_rng, rng_base,
                                                  rounds)
@@ -2347,6 +2465,8 @@ class MultihostRunner:
                         variables, server_state,
                         jax.numpy.asarray(total), agg_rng)
                 jax.block_until_ready(variables)
+                obs.counter("multihost_rounds_committed_total",
+                            rank=str(self.ctx.rank)).inc()
                 self.round_walls.append(time.perf_counter() - t0)
                 self.channel.export_byte_counters()
                 if self.ctx.rank == 0 and (
@@ -2389,6 +2509,7 @@ class MultihostRunner:
         if self.ctx.world <= 1:
             return
         try:
+            self.channel.round_hint = None   # ledger: not a round barrier
             delta = _delta_since_last_rollup()
             docs = self.channel.allgather(
                 json.dumps(delta).encode(), timeout_s=self.timeout_s)
@@ -2709,6 +2830,8 @@ class ElasticRunner(MultihostRunner):
                         variables, server_state,
                         jax.numpy.asarray(total), agg_rng)
                 jax.block_until_ready(variables)
+                obs.counter("multihost_rounds_committed_total",
+                            rank=str(self.ctx.rank)).inc()
                 self._round_ctx = None
                 self.round_walls.append(time.perf_counter() - t0)
                 ch.export_byte_counters()

@@ -1,0 +1,441 @@
+"""Cluster observatory tests (ISSUE 17): the live telemetry plane
+(heartbeat/allgather piggybacks folding under origin="host<i>"), the
+barrier straggler ledger, the cluster SLO pack + attribution, the
+coordinated incident dumps, and the obs-off wire-bytes pin — all
+in-process over real loopback sockets (threads, NOT spawned clusters:
+the spawned-pin riders live in test_multihost_spmd.py on the runs that
+already exist).
+
+Pinned invariants:
+
+* the metrics sidecar round-trips exactly and rejects non-sidecar
+  tails (mixed obs-on/obs-off ranks stay safe);
+* a slowed rank is NAMED as the round's gating rank with its margin,
+  and the per-rank waits land in multihost_barrier_wait_seconds;
+* a worker's heartbeat piggyback folds into the coordinator's registry
+  continuously (origin="host1"), and /cluster reports it alive;
+* the cluster SLO pack breaches cluster_no_rank_deaths with the dead
+  rank named in the attribution block;
+* one coordinated dump fans out to every member's flight recorder and
+  the throttle window holds (a breach storm yields ONE artifact set);
+* with obs disabled the wire bytes are IDENTICAL to the
+  pre-observatory channel: heartbeat headers stay exactly {}, worker
+  allgather frames are exactly the payload, and no DUMP frame exists;
+* tools/trace_timeline.py auto-discovers rank*/ obs dirs (rejoin
+  rank<i>-pid<pid> namespaces included) and merges the barrier ledger
+  into the report + Chrome trace.
+"""
+import glob
+import importlib.util
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from fedml_tpu import obs
+from fedml_tpu.obs import cluster, slo
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture
+def clean_obs():
+    prev = signal.getsignal(signal.SIGUSR1)
+    obs.reset()
+    yield
+    obs.reset()
+    signal.signal(signal.SIGUSR1, prev)
+
+
+def _series_with_origin(name: str, origin: str) -> bool:
+    return any(m.name == name
+               and dict(m.labels).get("origin") == origin
+               for m in obs.registry().metrics())
+
+
+def _elastic(rank, world, port, *, n_items=2, hb_timeout_s=2.0):
+    from fedml_tpu.parallel.multihost import (ElasticChannel,
+                                              MultihostContext)
+    ctx = MultihostContext(rank=rank, world=world,
+                           coordinator=f"localhost:{port}")
+    return ElasticChannel(ctx, n_items=n_items, config_digest="cfg",
+                          timeout_s=20.0, connect_timeout_s=10.0,
+                          hb_interval_s=0.1, hb_timeout_s=hb_timeout_s)
+
+
+def _build_pair(port, world=2):
+    """Construct one channel per rank concurrently (the hello
+    handshake needs both sides live)."""
+    chans, errs = {}, []
+
+    def mk(r):
+        try:
+            chans[r] = _elastic(r, world, port)
+        except Exception as e:           # pragma: no cover - diagnostics
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=mk, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20)
+    assert not errs, errs
+    chans[0].wait_members()
+    return chans
+
+
+# -- sidecar wire format -----------------------------------------------------
+
+
+def test_sidecar_roundtrip_cap_and_rejection(clean_obs):
+    delta = {"schema": 1, "metrics": [{"name": "x_total", "value": 3}]}
+    payload = b"\x01\x02carry-bytes\x00fml"
+    frame = cluster.attach_sidecar(payload, delta)
+    assert frame != payload and frame.startswith(payload)
+    got_payload, got_delta = cluster.split_sidecar(frame)
+    assert got_payload == payload and got_delta == delta
+    # nothing to ship / oversized delta -> frame untouched
+    assert cluster.attach_sidecar(payload, None) == payload
+    assert cluster.attach_sidecar(payload, {"schema": 1,
+                                            "metrics": []}) == payload
+    big = {"schema": 1, "metrics": [{"name": "y", "value": "z" * (
+        cluster.SIDECAR_CAP_BYTES + 1)}]}
+    assert cluster.attach_sidecar(payload, big) == payload
+    # frames WITHOUT the trailer pass through untouched — including a
+    # payload that happens to end in the magic but carries no sane
+    # length/JSON behind it
+    for raw in (payload, b"", b"x" * 3, payload + cluster.SIDECAR_MAGIC,
+                b"\xff" * 12 + cluster.SIDECAR_MAGIC):
+        p, d = cluster.split_sidecar(raw)
+        assert p == raw and d is None
+
+
+# -- barrier ledger ----------------------------------------------------------
+
+
+def test_note_barrier_names_gating_rank_and_summary(clean_obs):
+    cluster.set_role(0, 3)
+    base = 1000.0
+    # rank 2 arrives last by 0.4s twice, rank 1 once
+    cluster.note_barrier("allgather", 1, 0,
+                         {0: base, 1: base + 0.1, 2: base + 0.5})
+    cluster.note_barrier("allgather", 2, 1,
+                         {0: base, 1: base + 0.6, 2: base + 0.2})
+    cluster.note_barrier("exchange", 3, 2,
+                         {0: base, 1: base + 0.1, 2: base + 0.5})
+    # a 1-rank "barrier" is not a barrier
+    assert cluster.note_barrier("gather", 4, None, {0: base}) is None
+    led = cluster.barrier_ledger()
+    assert [e["round_gating_rank"] for e in led] == [2, 1, 2]
+    assert led[0]["gate_margin_s"] == pytest.approx(0.4)
+    assert led[0]["waits_s"] == {"0": pytest.approx(0.5),
+                                 "1": pytest.approx(0.4),
+                                 "2": pytest.approx(0.0)}
+    s = cluster.straggler_summary()
+    assert s["barriers"] == 3
+    assert s["gating_counts"] == {"1": 1, "2": 2}
+    assert s["top_gating_rank"] == 2
+    assert s["worst_gate_margin_s"] == pytest.approx(0.4)
+    assert s["per_rank_wait_s"]["0"]["max"] == pytest.approx(0.6)
+    # the waits landed in the histogram the SLO pack judges
+    h = obs.histogram("multihost_barrier_wait_seconds", rank="0")
+    assert h.count == 3
+
+
+def test_hostchannel_allgather_ledger_names_slowed_rank(clean_obs,
+                                                        tmp_path):
+    """3-rank HostChannel over loopback with rank 2 slowed ~0.3s: the
+    ledger entry must name rank 2 as gating with a comparable margin,
+    and (obs on) the workers' payload sidecars must fold into the
+    coordinator's registry under origin labels while the BROADCAST
+    payloads stay bitwise-clean."""
+    from fedml_tpu.parallel.multihost import (HostChannel,
+                                              MultihostContext,
+                                              free_port)
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    obs.counter("probe_sidecar_total").inc()
+    port = free_port()
+    out, errs = {}, []
+
+    def run(r):
+        try:
+            ch = HostChannel(MultihostContext(
+                rank=r, world=3, coordinator=f"localhost:{port}"),
+                timeout_s=20.0, connect_timeout_s=10.0)
+            try:
+                ch.round_hint = 7
+                if r == 2:
+                    time.sleep(0.3)
+                out[r] = ch.allgather(b"p%d" % r)
+            finally:
+                ch.close()
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    # broadcast payloads are the raw contributions — sidecars stripped
+    assert out[0] == out[1] == out[2] == [b"p0", b"p1", b"p2"]
+    led = cluster.barrier_ledger()
+    assert len(led) == 1 and led[0]["kind"] == "allgather"
+    assert led[0]["round"] == 7
+    assert led[0]["round_gating_rank"] == 2
+    assert led[0]["gate_margin_s"] > 0.15, led[0]
+    assert led[0]["waits_s"]["2"] == 0.0
+    # at least one worker's piggybacked delta folded live (the other's
+    # may have found an already-advanced baseline -> None, by design)
+    assert (_series_with_origin("probe_sidecar_total", "host1")
+            or _series_with_origin("probe_sidecar_total", "host2")), (
+        "no worker sidecar folded into the coordinator registry")
+
+
+# -- live telemetry plane (heartbeat piggyback) ------------------------------
+
+
+def test_hb_piggyback_folds_into_coordinator_live(clean_obs, tmp_path):
+    from fedml_tpu.parallel.multihost import free_port
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    chans = _build_pair(free_port())
+    try:
+        obs.counter("piggy_probe_total").inc()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if _series_with_origin("piggy_probe_total", "host1"):
+                break
+            time.sleep(0.05)
+        assert _series_with_origin("piggy_probe_total", "host1"), (
+            "the worker's heartbeat delta never folded into the "
+            "coordinator's registry")
+        rep = cluster.cluster_report()
+        assert rep["scope"] == "cluster" and rep["world"] == 2
+        assert rep["ranks"]["1"]["alive"] is True
+        assert rep["ranks"]["1"]["last_fold_age_s"] is not None
+        json.dumps(rep)                  # endpoint doc must serialize
+    finally:
+        for ch in chans.values():
+            ch.close()
+
+
+# -- cluster SLO pack + attribution ------------------------------------------
+
+
+def test_cluster_slo_breach_names_dead_rank(clean_obs):
+    cluster.set_role(0, 3)
+    obs.counter("multihost_rounds_committed_total", rank="0").inc()
+    cluster.note_barrier("exchange", 0, 0,
+                         {0: 1.0, 1: 1.2, 2: 1.1})
+    rep = cluster.cluster_slo_report()
+    assert rep["scope"] == "cluster" and rep["healthy"], rep
+    # a rank death breaches with the rank NAMED
+    obs.counter("multihost_rank_deaths_total", rank="1").inc()
+    rep = cluster.cluster_slo_report()
+    assert rep["healthy"] is False
+    assert "cluster_no_rank_deaths" in rep["breached"], rep
+    att = rep["attribution"]
+    assert att["dead_ranks"] == ["1"]
+    assert att["gating_rank"] == 1
+    assert "1" in att["per_rank_wait_p95_s"]
+    # non-coordinators have no engine -> no cluster verdict to fake
+    obs.reset()
+    cluster.set_role(2, 3)
+    assert cluster.cluster_slo_report() is None
+    assert cluster.scope() == "local"
+
+
+# -- coordinated incident dumps ----------------------------------------------
+
+
+def test_coordinated_dump_fans_out_and_throttles(clean_obs, tmp_path):
+    from fedml_tpu.parallel.multihost import free_port
+    obs.configure(str(tmp_path), install_signal=False,
+                  export_at_exit=False)
+    chans = _build_pair(free_port())
+    try:
+        assert cluster.maybe_coordinated_dump("test_incident") is True
+        # inside the throttle window: a breach storm yields ONE set
+        assert cluster.maybe_coordinated_dump("storm") is False
+        assert obs.counter("multihost_coordinated_dumps_total"
+                           ).value == 1
+        # the DUMP frame is consumed on the worker's next exchange
+        res = {}
+
+        def rnd(r):
+            parts = {b: b"r%d" % r for b in chans[r].view.assigned(r)}
+            res[r] = chans[r].exchange(0, parts, lambda need: {})
+
+        ts = [threading.Thread(target=rnd, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        assert set(res) == {0, 1}
+        dumps = []
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(dumps) < 2:
+            dumps = [p for p in glob.glob(str(tmp_path / "flight-*.json"))
+                     if json.load(open(p))["reason"]
+                     == "coordinated:test_incident"]
+            time.sleep(0.05)
+        assert len(dumps) == 2, (
+            f"expected the coordinator's dump AND the worker's "
+            f"fanned-out dump, got {len(dumps)}")
+    finally:
+        for ch in chans.values():
+            ch.close()
+
+
+def test_coordinated_dump_noop_with_obs_off(clean_obs):
+    assert not cluster.telemetry_enabled()
+    assert cluster.maybe_coordinated_dump("nope") is False
+
+
+# -- THE wire pin: obs off => bytes identical --------------------------------
+
+
+def test_wire_bytes_identical_with_obs_off(clean_obs, monkeypatch):
+    """With no obs dir configured the observatory must be INVISIBLE on
+    the wire: every heartbeat header is exactly {}, no DUMP frame is
+    ever sent, and a worker's allgather frame is exactly its payload —
+    the PR-13/14/16 bitwise anchors ride these bytes."""
+    from fedml_tpu.parallel import multihost as mh
+    assert not cluster.telemetry_enabled()
+    sent_msgs = []
+    real_send_msg = mh._send_msg
+
+    def spy_send_msg(sock, mtype, header, payload=b""):
+        sent_msgs.append((mtype, dict(header)))
+        return real_send_msg(sock, mtype, header, payload)
+
+    monkeypatch.setattr(mh, "_send_msg", spy_send_msg)
+    chans = _build_pair(mh.free_port())
+    try:
+        res = {}
+
+        def rnd(r):
+            parts = {b: b"r%d" % r for b in chans[r].view.assigned(r)}
+            res[r] = chans[r].exchange(0, parts, lambda need: {})
+
+        ts = [threading.Thread(target=rnd, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        time.sleep(0.35)                 # let a few heartbeats flow
+        assert set(res) == {0, 1}
+    finally:
+        for ch in chans.values():
+            ch.close()
+    hbs = [h for (m, h) in sent_msgs if m == "hb"]
+    assert hbs and all(h == {} for h in hbs), (
+        "obs-off heartbeat headers must stay exactly {} — found "
+        f"{[h for h in hbs if h != {}][:3]}")
+    assert all(m != "dump" for (m, _h) in sent_msgs)
+
+    # HostChannel tier: the worker frame is EXACTLY the payload
+    sent_frames = []
+    real_send_frame = mh._send_frame
+
+    def spy_send_frame(sock, payload):
+        sent_frames.append(bytes(payload))
+        return real_send_frame(sock, payload)
+
+    monkeypatch.setattr(mh, "_send_frame", spy_send_frame)
+    port = mh.free_port()
+    out, errs = {}, []
+
+    def run(r):
+        try:
+            ch = mh.HostChannel(mh.MultihostContext(
+                rank=r, world=2, coordinator=f"localhost:{port}"),
+                timeout_s=20.0, connect_timeout_s=10.0)
+            try:
+                out[r] = ch.allgather(b"payload-%d" % r)
+            finally:
+                ch.close()
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20)
+    assert not errs, errs
+    assert out[0] == out[1] == [b"payload-0", b"payload-1"]
+    assert b"payload-1" in sent_frames, (
+        "obs-off worker allgather frame must be exactly the payload "
+        "(no metrics trailer)")
+
+
+# -- endpoints ---------------------------------------------------------------
+
+
+def test_httpd_cluster_endpoint_and_slo_scope(clean_obs):
+    import urllib.request
+    cluster.set_role(0, 2)
+    eng = slo.SloEngine([slo.spec("ok", "q_total", "delta_max", 10.0)])
+    eng.prime()
+    eng.evaluate()
+    slo.install(eng)
+    srv = obs.serve_http(0)
+    base = f"http://127.0.0.1:{srv.port}"
+    cl = json.loads(urllib.request.urlopen(f"{base}/cluster").read())
+    assert cl["scope"] == "cluster" and cl["world"] == 2
+    assert "straggler" in cl and "ranks" in cl
+    assert cl["slo"]["scope"] == "cluster"      # the coordinator pack
+    sl = json.loads(urllib.request.urlopen(f"{base}/slo").read())
+    assert sl["healthy"] and sl["scope"] == "cluster"
+
+
+# -- timeline auto-discovery -------------------------------------------------
+
+
+def test_trace_timeline_autodiscovers_rank_dirs(clean_obs, tmp_path):
+    """A parent obs dir expands to its rank*/ children — the plain
+    rank0 AND a rejoiner's rank1-pid777 namespace, labeled apart — and
+    rank 0's barrier ledger lands in the report + the Chrome trace's
+    barrier lanes."""
+    parent = tmp_path / "obs"
+    for sub in ("rank0", "rank1-pid777"):
+        obs.reset()
+        obs.configure(str(parent / sub), install_signal=False,
+                      export_at_exit=False)
+        with obs.span("round", idx=0):
+            time.sleep(0.01)
+        if sub == "rank0":
+            cluster.set_role(0, 2)
+            cluster.note_barrier("exchange", 0, 0, {0: 5.0, 1: 5.4})
+        obs.export()
+    obs.reset()
+    assert os.path.exists(parent / "rank0" / "barrier_ledger.json")
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_timeline", os.path.join(REPO, "tools",
+                                       "trace_timeline.py"))
+    tt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tt)
+    assert tt.main([str(parent)]) == 0
+    report = json.load(open(parent / "critical_path.json"))
+    labels = {s["label"] for s in report["sources"]}
+    assert labels == {"rank0", "rank1-pid777"}, report["sources"]
+    assert report["straggler"]["barriers"] == 1
+    assert report["straggler"]["gating_counts"] == {"1": 1}
+    chrome = json.load(open(parent / "merged.chrome.json"))
+    if isinstance(chrome, dict):
+        chrome = chrome["traceEvents"]
+    names = {e.get("name") for e in chrome}
+    assert any(e.get("name") == "process_name"
+               and (e.get("args") or {}).get("name") == "cluster barriers"
+               for e in chrome), "barrier lane process missing"
+    assert "GATE" in names, "per-rank gate slices missing"
+    assert any(str(e.get("name", "")).startswith("gate: rank 1")
+               for e in chrome), "gating-rank annotation missing"

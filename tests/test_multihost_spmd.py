@@ -282,6 +282,19 @@ def test_twolevel_two_process_bitwise_pin(tmp_path):
     # the carry genuinely crossed processes in the 2-proc arm
     assert two[0]["carry_allreduce_bytes_per_round"] > 0
     assert one[0]["carry_allreduce_bytes_per_round"] == 0
+    # ISSUE 17 rider on the SAME spawned run (no new cluster): rank
+    # 0's always-on barrier ledger attributed every allgather — each
+    # entry names its gating rank — and the cluster SLO pack is green
+    # on a clean run
+    sl = two[0].get("straggler")
+    assert sl and sl["barriers"] > 0, (
+        "rank 0's barrier ledger is empty on a 2-process run — the "
+        "allgather arrival stamps (obs/cluster.py note_barrier) broke")
+    assert all(e["round_gating_rank"] in (0, 1)
+               for e in sl["recent"]), sl["recent"]
+    cslo = two[0].get("cluster_slo")
+    assert cslo and cslo["healthy"] is True, (
+        f"clean 2-process run breached the cluster SLO pack: {cslo}")
     # ISSUE 16: the f32 escape hatch stays bitwise UNDER OVERLAP — the
     # ONE extra spawned arm this PR adds (the other compression/
     # overlap pins are in-process): same case, f32 codec + overlapped
@@ -910,6 +923,21 @@ def test_elastic_kill_respawn_bitwise_pin(tmp_path):
     assert rep["view_changes"] >= 2, rep
     assert rep["epoch"] >= 2, rep
     assert "respawning once" in r1.stderr, r1.stderr[-2000:]
+    # ISSUE 17 rider on the SAME spawned chaos run: the cluster SLO
+    # pack must BREACH the zero-deaths objective and NAME the killed
+    # rank in the attribution, and the barrier ledger observed the
+    # exchange barriers (round_hint-free exchange entries included)
+    cslo = killed[0].get("cluster_slo")
+    assert cslo and cslo["healthy"] is False, (
+        f"killed-arm cluster SLO stayed green: {cslo}")
+    assert "cluster_no_rank_deaths" in cslo["breached"], cslo
+    assert "1" in (cslo["attribution"]["dead_ranks"] or []), (
+        f"attribution failed to name the killed rank: "
+        f"{cslo['attribution']}")
+    sl = killed[0].get("straggler")
+    assert sl and sl["barriers"] > 0, (
+        "rank 0's barrier ledger is empty on the elastic chaos run — "
+        "the exchange arrival stamps (obs/cluster.py) broke")
 
 
 # ---------------------------------------------------------------------------

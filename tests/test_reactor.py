@@ -352,7 +352,7 @@ def test_connection_torture_churn_audits_fds():
     r = run_connection_torture(
         n_connections=96, commits=5, warmup_commits=1, buffer_k=8,
         ingest_pool=2, offered_rate=1200.0, base_port=_PORT + 40,
-        timeout_s=180, storm=True, churn_lifetime_s=1.0)
+        timeout_s=180, storm=True, churn_lifetime_s=0.3)
     assert r["finite"]
     assert r["swarm"]["reconnects"] >= 1         # churn actually churned
     assert r["recv_thread_deaths"] == 0

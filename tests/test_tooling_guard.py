@@ -440,10 +440,10 @@ def test_chip_queue_carries_conn_step():
     assert "profile_bench.py CONN" in src, (
         "run_chip_queue.sh lost the CONN live-connection reactor step "
         "(ISSUE 11 queues it for the next chip window)")
-    assert "13/17" in src, (
-        "run_chip_queue.sh lost the CONN step numbering (13/17 since "
-        "ISSUEs 12-16 appended bench_diff, exp_POD, exp_ELASTIC and "
-        "the compressed-carry arm)")
+    assert "13/18" in src, (
+        "run_chip_queue.sh lost the CONN step numbering (13/18 since "
+        "ISSUEs 12-17 appended bench_diff, exp_POD, exp_ELASTIC, the "
+        "compressed-carry arm and the straggler observatory arm)")
     assert "exp_CONN" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
@@ -584,10 +584,11 @@ def test_bench_json_schema_v13_carries_elastic_chaos_arm():
     # chip queue: the ELASTIC step + its experiment
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert "profile_bench.py ELASTIC" in queue and "17/17" in queue, (
+    assert "profile_bench.py ELASTIC" in queue and "17/18" in queue, (
         "run_chip_queue.sh lost the ELASTIC chaos step (ISSUE 14 "
         "queues it for the next chip window; ISSUE 16 renumbered it "
-        "17/17 when the compressed-carry arm landed as 16)")
+        "17 when the compressed-carry arm landed as 16, ISSUE 17 "
+        "appended the straggler observatory arm as 18)")
     assert "exp_ELASTIC" in open(os.path.join(
         base, "tools", "profile_bench.py")).read(), (
         "profile_bench.py lost the exp_ELASTIC experiment the queue "
@@ -597,20 +598,20 @@ def test_bench_json_schema_v13_carries_elastic_chaos_arm():
 def test_chip_queue_carries_pod_step():
     """ISSUE 13: the next chip window must price the multi-host
     weak-scaling sweep on a real pod slice —
-    scripts/run_chip_queue.sh carries the POD step (15/17 since
-    ISSUE 14 appended the ELASTIC arm and ISSUE 16 the
-    compressed-carry arm) and profile_bench.py defines the exp_POD
-    experiment it runs."""
+    scripts/run_chip_queue.sh carries the POD step (15/18 since
+    ISSUEs 14-17 appended the ELASTIC arm, the compressed-carry arm
+    and the straggler observatory arm) and profile_bench.py defines
+    the exp_POD experiment it runs."""
     queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
                          "run_chip_queue.sh")
     src = open(queue).read()
     assert "profile_bench.py POD" in src, (
         "run_chip_queue.sh lost the POD multi-host weak-scaling sweep "
         "(ISSUE 13 queues it for the next chip window)")
-    assert "15/17" in src, (
-        "run_chip_queue.sh lost the 15/17 step numbering (exp_POD is "
+    assert "15/18" in src, (
+        "run_chip_queue.sh lost the 15/18 step numbering (exp_POD is "
         "queue step 15; ISSUE 16's compressed arm is 16, ISSUE 14's "
-        "exp_ELASTIC is 17)")
+        "exp_ELASTIC is 17, ISSUE 17's straggler arm is 18)")
     assert "exp_POD" in open(os.path.join(
         os.path.dirname(__file__), "..", "tools",
         "profile_bench.py")).read(), (
@@ -678,16 +679,86 @@ def test_bench_json_schema_v14_carries_compressed_carry_arm():
         "fedml_tpu/cli.py lost the ISSUE-16 wire-tier flags")
     assert re.search(r'default="f32"', cli), (
         "--carry_codec must default to f32 (the bitwise escape hatch)")
-    # chip queue: the compressed arm rides exp_POD, renumbered 16/17
+    # chip queue: the compressed arm rides exp_POD, renumbered 16/18
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert "FEDML_POD_ARMS=compress" in queue and "16/17" in queue, (
-        "run_chip_queue.sh lost the 16/17 compressed-carry step "
+    assert "FEDML_POD_ARMS=compress" in queue and "16/18" in queue, (
+        "run_chip_queue.sh lost the 16/18 compressed-carry step "
         "(ISSUE 16 prices the bytes column on real DCN frames)")
     assert "FEDML_POD_ARMS" in open(os.path.join(
         base, "tools", "profile_bench.py")).read(), (
         "profile_bench.py exp_POD lost the FEDML_POD_ARMS override "
         "the queue's compressed step uses")
+
+
+def test_bench_json_schema_v15_carries_straggler_observatory():
+    """ISSUE 17: schema v15 adds the straggler block to the multihost
+    chaos arm — barrier-ledger gating counts, per-rank wait
+    percentiles, the cluster SLO verdicts (clean arm green, killed arm
+    breaching with the dead rank named: straggler_attribution_ok) —
+    plus the cluster observatory runtime it reads (obs/cluster.py
+    telemetry fold + barrier ledger + coordinated dumps, the httpd
+    /cluster endpoint, the DUMP control frame on the elastic channel)
+    and the appended chip-queue step.  Static source check like the
+    v3-v14 guards."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 15, (
+        "bench schema must stay >= v15 (straggler observatory block)")
+    for field in ('"straggler"', "straggler_attribution_ok",
+                  "cluster_clean_breaches", "top_gating_rank",
+                  "cluster_killed_breached"):
+        assert field in src, (
+            f"bench.py lost the v15 straggler field {field} "
+            "(see fedml_tpu/obs/cluster.py ISSUE 17)")
+    base = os.path.join(os.path.dirname(__file__), "..")
+    # the observatory module: telemetry plane + ledger + SLO pack +
+    # coordinated dumps
+    cl = open(os.path.join(base, "fedml_tpu", "obs", "cluster.py")).read()
+    for sym in ("def attach_sidecar", "def split_sidecar",
+                "def fold_remote", "def note_barrier",
+                "def straggler_summary", "def cluster_slo_pack",
+                "def cluster_report", "def maybe_coordinated_dump",
+                "round_gating_rank"):
+        assert sym in cl, (
+            f"fedml_tpu/obs/cluster.py lost {sym!r} — the ISSUE-17 "
+            "cluster observatory the v15 straggler block reads")
+    # the channel hooks: hb piggyback, arrival stamps, the DUMP frame
+    mh = open(os.path.join(base, "fedml_tpu", "parallel",
+                           "multihost.py")).read()
+    for sym in ("_piggyback_delta", "note_barrier",
+                "_broadcast_dump_frames", '"dump"'):
+        assert sym in mh, (
+            f"fedml_tpu/parallel/multihost.py lost {sym!r} — the "
+            "ISSUE-17 telemetry/ledger/dump hooks")
+    # the /cluster endpoint + scoped /slo
+    httpd = open(os.path.join(base, "fedml_tpu", "obs",
+                              "httpd.py")).read()
+    assert "/cluster" in httpd and "scope" in httpd, (
+        "fedml_tpu/obs/httpd.py lost the /cluster endpoint or the "
+        "scope field on /slo (ISSUE 17)")
+    # the timeline tool must auto-discover rank dirs + render barriers
+    tt = open(os.path.join(base, "tools", "trace_timeline.py")).read()
+    assert "_expand_sources" in tt and "barrier_ledger" in tt, (
+        "tools/trace_timeline.py lost the per-rank auto-discovery or "
+        "the barrier-ledger lanes (ISSUE 17)")
+    # bench_diff must judge the new fields
+    bd = open(os.path.join(base, "tools", "bench_diff.py")).read()
+    for field in ("straggler_attribution_ok", "cluster_clean_breaches"):
+        assert field in bd, (
+            f"tools/bench_diff.py lost the straggler rule field "
+            f"{field} (the v15 acceptance gate)")
+    # chip queue: the straggler observatory arm appended as 18/18
+    queue = open(os.path.join(base, "scripts",
+                              "run_chip_queue.sh")).read()
+    assert "18/18" in queue and "trace_timeline.py" in queue, (
+        "run_chip_queue.sh lost the 18/18 straggler observatory step "
+        "(ISSUE 17 banks per-rank obs dirs + the merged timeline)")
+    import subprocess
+    r = subprocess.run(["bash", "-n", os.path.join(
+        base, "scripts", "run_chip_queue.sh")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
 
 
 def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
@@ -728,9 +799,9 @@ def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
 
 def test_chip_queue_carries_bench_diff_step():
     """ISSUE 12: the chip queue's judgment pass diffs the fresh bench
-    record against the committed trajectory (step 14/17 since ISSUEs
-    13-16 appended exp_POD, exp_ELASTIC and the compressed-carry
-    arm), and the script stays shell-valid."""
+    record against the committed trajectory (step 14/18 since ISSUEs
+    13-17 appended exp_POD, exp_ELASTIC, the compressed-carry arm and
+    the straggler observatory arm), and the script stays shell-valid."""
     import subprocess
     queue = os.path.join(os.path.dirname(__file__), "..", "scripts",
                          "run_chip_queue.sh")
@@ -738,10 +809,11 @@ def test_chip_queue_carries_bench_diff_step():
     assert "bench_diff.py" in src, (
         "run_chip_queue.sh lost the bench_diff regression step "
         "(ISSUE 12 appends it as the queue's judgment pass)")
-    assert "14/17" in src, (
-        "run_chip_queue.sh lost the 14/17 bench_diff step numbering "
+    assert "14/18" in src, (
+        "run_chip_queue.sh lost the 14/18 bench_diff step numbering "
         "(the judgment pass rides right after the bench artifacts; "
-        "exp_POD is 15, the compressed arm 16, exp_ELASTIC 17)")
+        "exp_POD is 15, the compressed arm 16, exp_ELASTIC 17, the "
+        "straggler observatory arm 18)")
     r = subprocess.run(["bash", "-n", queue], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr

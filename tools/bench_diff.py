@@ -45,7 +45,12 @@ Noise-band sources (don't tighten without re-measuring):
     per (dim, chunk) — tight band with the ISSUE-16 >= 3x gate;
     acc_delta_vs_f32 rides the +-0.04 quality-band convention;
     bitwise_f32_escape_ok is a boolean pin (the f32 escape hatch must
-    stay byte-identical under overlap).
+    stay byte-identical under overlap);
+  * multihost straggler (v15): cluster_clean_breaches carries the
+    zero-breach gate (the clean elastic arm's cluster SLO pack must be
+    green); straggler_attribution_ok is a boolean pin (the killed arm
+    must breach cluster_no_rank_deaths AND name the killed rank);
+    barrier counts / gating stats are informational.
 """
 from __future__ import annotations
 
@@ -57,7 +62,7 @@ import os
 import sys
 from typing import Optional
 
-SCHEMA_MIN, SCHEMA_MAX = 2, 14
+SCHEMA_MIN, SCHEMA_MAX = 2, 15
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +196,14 @@ def prune(doc: dict) -> dict:
             if row.get("carry_allreduce_bytes_per_round") is not None:
                 f[f"carry_bytes_per_round[procs={n}]"] = \
                     row["carry_allreduce_bytes_per_round"]
+        # v15 straggler ledger + cluster SLO verdicts (ISSUE 17)
+        st = m.get("straggler") or {}
+        f["straggler_attribution_ok"] = st.get(
+            "straggler_attribution_ok")
+        f["cluster_clean_breaches"] = st.get("cluster_clean_breaches")
+        f["straggler_killed_barriers"] = st.get("killed_barriers")
+        f["straggler_top_gating_rank"] = st.get("top_gating_rank")
+        f["worst_gate_margin_s"] = st.get("worst_gate_margin_s")
         # v14 compressed carry arm (ISSUE 16)
         cp = m.get("compress") or {}
         f["bitwise_f32_escape_ok"] = cp.get("bitwise_f32_escape_ok")
@@ -344,6 +357,22 @@ RULES: dict[tuple, Rule] = {
         0, note="detection->re-tasked wall; box-load sensitive"),
     ("multihost", "view_changes"): Rule(
         0, note="death + (optional) rejoin admissions"),
+    # -- multihost straggler (ISSUE 17): the clean elastic arm's
+    # cluster SLO pack must stay green (breaches there are real
+    # regressions — the chaos/killed arm breaches BY DESIGN and is
+    # judged by the straggler_attribution_ok boolean pin instead);
+    # barrier counts and gating stats are topology/wall-clock facts —
+    # informational.
+    ("multihost", "cluster_clean_breaches"): Rule(
+        -1, 0.0, gate_max=0.0,
+        note="clean elastic arm's cluster SLO pack must be green"),
+    ("multihost", "straggler_killed_barriers"): Rule(
+        0, note="ledger depth on the killed arm; informational"),
+    ("multihost", "straggler_top_gating_rank"): Rule(
+        0, note="who gated most — attribution, not a rate"),
+    ("multihost", "worst_gate_margin_s"): Rule(
+        0, note="slowest-vs-2nd-slowest arrival gap; box-load "
+                "sensitive"),
     # -- multihost compress (ISSUE 16): the f32 overlap fraction is a
     # wall-clock ratio on a loaded box — informational; the boolean
     # escape-hatch pin rides the boolean gate path.

@@ -25,6 +25,15 @@ the comm layer estimated from piggybacked timestamps
 
 A bare trace.jsonl path works too (spill files included — they have no
 meta line and are taken as already-aligned).
+
+Multi-rank runs need only the PARENT obs dir (ISSUE 17): a directory
+without its own trace.jsonl expands to its `rank*` children — BOTH the
+plain `rank<i>` form and a rejoiner's `rank<i>-pid<pid>` namespace —
+each labeled distinctly in the report so two incarnations of one rank
+stay tellable-apart.  When the coordinator's dir carries a
+barrier_ledger.json (obs/cluster.py), the merged trace gains per-rank
+barrier-wait lanes with the gating rank annotated per barrier, and the
+report a `straggler` block.
 """
 from __future__ import annotations
 
@@ -37,6 +46,47 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from fedml_tpu.obs import timeline  # noqa: E402
+
+
+def _expand_sources(paths: list[str]) -> list[str]:
+    """Auto-discover per-rank obs dirs: a directory expands to its
+    rank*/ children that carry a trace.jsonl (matching both `rank<i>`
+    and the rejoin-namespaced `rank<i>-pid<pid>`).  A parent with its
+    OWN trace.jsonl (e.g. the bench driver exporting into the same
+    FEDML_OBS_DIR its spawned ranks namespace) stays a source too —
+    its spans merge alongside the rank lanes."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            subs = sorted(
+                os.path.join(p, n) for n in os.listdir(p)
+                if n.startswith("rank")
+                and os.path.exists(os.path.join(p, n, "trace.jsonl")))
+            if subs:
+                if os.path.exists(os.path.join(p, "trace.jsonl")):
+                    out.append(p)
+                out.extend(subs)
+                continue
+        out.append(p)
+    return out
+
+
+def _load_ledger(sources: list[str]):
+    """The coordinator's barrier_ledger.json, preferring a rank0* dir
+    (only rank 0 observes arrivals — other dirs won't have one)."""
+    cands = []
+    for s in sources:
+        d = s if os.path.isdir(s) else (os.path.dirname(s) or ".")
+        p = os.path.join(d, "barrier_ledger.json")
+        if os.path.exists(p):
+            pref = 0 if os.path.basename(
+                os.path.normpath(d)).startswith("rank0") else 1
+            cands.append((pref, p))
+    if not cands:
+        return None
+    cands.sort()
+    with open(cands[0][1]) as f:
+        return json.load(f)
 
 
 def _load_source(path: str):
@@ -70,7 +120,8 @@ def main(argv=None) -> int:
                          "<first dir>/critical_path.json)")
     args = ap.parse_args(argv)
 
-    loaded = [_load_source(s) for s in args.sources]
+    sources = _expand_sources(args.sources)
+    loaded = [_load_source(s) for s in sources]
     offsets = timeline.dir_offsets([(m, c) for m, _e, c in loaded])
     merged = timeline.merge_traces(
         (meta, events, off)
@@ -80,21 +131,32 @@ def main(argv=None) -> int:
                          "traced (--obs_dir / FEDML_OBS_DIR)?")
     report = timeline.critical_path(merged)
     report["sources"] = [
-        {"path": s, "pid": m.get("pid"), "events": len(e),
+        {"path": s, "label": os.path.basename(os.path.normpath(s)),
+         "pid": m.get("pid"), "events": len(e),
          "dropped_events": m.get("dropped_events", 0),
          "clock_offset_s": off}
-        for s, (m, e, _c), off in zip(args.sources, loaded, offsets)]
+        for s, (m, e, _c), off in zip(sources, loaded, offsets)]
+    ledger = _load_ledger(sources)
+    if ledger is not None:
+        report["straggler"] = ledger.get("summary")
 
     base = (args.sources[0] if os.path.isdir(args.sources[0])
             else os.path.dirname(args.sources[0]) or ".")
     out = args.out or os.path.join(base, "merged.chrome.json")
     rep = args.report or os.path.join(base, "critical_path.json")
-    timeline.export_chrome(merged, out, report=report)
+    timeline.export_chrome(
+        merged, out, report=report,
+        barriers=None if ledger is None else ledger.get("entries"))
     with open(rep, "w") as f:
         json.dump(report, f, indent=1)
     print(f"merged {len(merged)} events from {len(loaded)} trace(s) "
           f"-> {out}")
     print(f"critical path -> {rep}")
+    if ledger is not None:
+        s = ledger.get("summary", {})
+        print(f"barrier ledger: {s.get('barriers', 0)} barriers, "
+              f"gating counts {s.get('gating_counts', {})} "
+              f"(per-rank lanes in the merged trace)")
     print(timeline.format_report(report))
     return 0
 
