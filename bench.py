@@ -173,7 +173,21 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     the chip-side gate — on the 2-core CI box the serial fold is the
 #     bottleneck and the paired median is ~0.73x, PERF.md); null in
 #     other modes, so v7 readers keep working
-SCHEMA_VERSION = 15
+# v16: + "cluster" block (`python bench.py --mode cluster`, ISSUE 18 —
+#     fedml_tpu/scale/cluster.py, the fused serving cluster): live
+#     connswarm sockets feed registry-sharded lanes on each host of an
+#     elastic multi-host tier, lane partials folding cross-host at
+#     every commit barrier.  Rows sweep host counts (1/2/4 by default,
+#     one multi-target swarm striped across the endpoints):
+#     cluster_updates_per_sec, admission p95 (max over ranks),
+#     ranks_agree (the cross-rank digest pin, live ingest).  The
+#     chaos_everything arm composes ALL the fault layers at once —
+#     connection storm + seeded wire faults + a rank killed mid-run —
+#     and reports survivor_goodput_ratio (>= 0.5 floor),
+#     bitwise_after_death_ok (survivor digests agree), and the full
+#     evictions/sheds/drops ledger; v15 readers that ignore unknown
+#     keys keep working
+SCHEMA_VERSION = 16
 
 
 # the programs block's window opens when main() configures obs (set
@@ -323,7 +337,8 @@ def main() -> None:
     ap = argparse.ArgumentParser("bench")
     ap.add_argument("--mode",
                     choices=("sync", "async", "ingest", "chaos", "attack",
-                             "serve", "connections", "multihost"),
+                             "serve", "connections", "multihost",
+                             "cluster"),
                     default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
@@ -360,7 +375,15 @@ def main() -> None:
                          "each on local meshes and allreduce the flat "
                          "f32 carry over the HostChannel; rounds/sec + "
                          "carry bytes vs process count (1/2/4) plus the "
-                         "1-vs-2-process bitwise pin")
+                         "1-vs-2-process bitwise pin; cluster: the "
+                         "fused serving cluster (ISSUE 18, "
+                         "fedml_tpu/scale/cluster.py) — live connswarm "
+                         "sockets feed registry-sharded lanes on each "
+                         "host of an elastic multi-host tier; "
+                         "committed-updates/sec + p95 admission vs "
+                         "(hosts x connections) at 1/2/4 hosts, plus "
+                         "the chaos-everything arm (storm + wire "
+                         "faults + rank kill at once)")
     ap.add_argument("--ingest_clients", type=int, default=32,
                     help="ingest mode: concurrent uplink clients")
     ap.add_argument("--ingest_backend", default="TCP",
@@ -473,6 +496,35 @@ def main() -> None:
                     help="multihost chaos arm: elastic cluster size "
                          "(rank 1 is killed mid-run; the survivors "
                          "must finish)")
+    ap.add_argument("--cluster_hosts", default="1,2,4",
+                    help="cluster mode: comma-separated host counts "
+                         "(one row each; a multi-target swarm stripes "
+                         "its fleet across the H endpoints)")
+    ap.add_argument("--cluster_connections", type=int, default=32,
+                    help="cluster mode: swarm connections per host")
+    ap.add_argument("--cluster_commits", type=int, default=8,
+                    help="cluster mode: commit windows per arm (first "
+                         "2 are warmup)")
+    ap.add_argument("--cluster_buffer_k", type=int, default=32,
+                    help="cluster mode: uplinks per lane per commit "
+                         "window")
+    ap.add_argument("--cluster_row_dim", type=int, default=256,
+                    help="cluster mode: flat model row dimension")
+    ap.add_argument("--cluster_rate", type=float, default=2000.0,
+                    help="cluster mode: peak offered frames/sec PER "
+                         "HOST — the fleet's aggregate offer scales "
+                         "with the host count (weak scaling); the "
+                         "diurnal profile modulates the instantaneous "
+                         "rate")
+    ap.add_argument("--cluster_population", type=int, default=4096,
+                    help="cluster mode: client-id space, range-"
+                         "partitioned across hosts")
+    ap.add_argument("--cluster_ingest_pool", type=int, default=2,
+                    help="cluster mode: decode-pool workers per host")
+    ap.add_argument("--cluster_seed", type=int, default=0,
+                    help="cluster mode: one seed drives the swarm "
+                         "schedule, the arrival profile, and the chaos "
+                         "injector")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -498,6 +550,7 @@ def main() -> None:
             "serve": None,
             "connections": None,
             "multihost": None,
+            "cluster": None,
             "critical_path": None,
             "slo": None,
             "programs": None,
@@ -524,7 +577,8 @@ def main() -> None:
     # FEDML_OBS_CENSUS=1 opt-in.
     from fedml_tpu.obs import programs as obs_programs
     global _PROGRAMS_T0
-    if args.mode in ("ingest", "chaos", "serve", "connections"):
+    if args.mode in ("ingest", "chaos", "serve", "connections",
+                     "cluster"):
         obs_programs.enable_census(True)
     _PROGRAMS_T0 = obs_programs.snapshot()
     if args.mode == "ingest":
@@ -544,6 +598,9 @@ def main() -> None:
         return
     if args.mode == "multihost":
         _bench_multihost(args)
+        return
+    if args.mode == "cluster":
+        _bench_cluster(args)
         return
     import jax.numpy as jnp
 
@@ -654,6 +711,7 @@ def main() -> None:
         "serve": None,
         "connections": None,
         "multihost": None,
+        "cluster": None,
         "overlap_fraction": round(
             engine.transfer_stats.overlap_fraction(), 4),
         # byte accounting (transfer-compression layer): mean H2D payload
@@ -747,6 +805,7 @@ def _bench_async(cfg, data, trainer) -> None:
         "serve": None,
         "connections": None,
         "multihost": None,
+        "cluster": None,
         # v6: commit-to-commit stage attribution from the scheduler's
         # spans (train waves / commits / eval + wait); null untraced
         "critical_path": _critical_path_doc(),
@@ -838,6 +897,7 @@ def _bench_ingest(args) -> None:
         "serve": None,
         "connections": None,
         "multihost": None,
+        "cluster": None,
         "ingest": {
             "backend": legacy["backend"],
             "n_clients": legacy["n_clients"],
@@ -981,6 +1041,7 @@ def _bench_chaos(args) -> None:
         "serve": None,
         "connections": None,
         "multihost": None,
+        "cluster": None,
         "chaos": {
             "backend": clean["backend"],
             "n_clients": clean["n_clients"],
@@ -1145,6 +1206,7 @@ def _bench_attack(args) -> None:
         "serve": None,
         "connections": None,
         "multihost": None,
+        "cluster": None,
         "attack": {
             "workload": "async_mnist_lr (quality-band shape, K=8, "
                         "conc 16, poly a=0.5)",
@@ -1258,6 +1320,7 @@ def _bench_serve(args) -> None:
         "attack": None,
         "connections": None,
         "multihost": None,
+        "cluster": None,
         "serve": {
             "buffer_k": args.serve_buffer_k,
             "row_dim": args.serve_row_dim,
@@ -1419,6 +1482,7 @@ def _bench_connections(args) -> None:
             "rows": rows,
             "storm_goodput_ratio": head["storm_goodput_ratio"],
         },
+        "cluster": None,
         "critical_path": _critical_path_doc(),
         "slo": _slo_doc(slo_arms),
         "programs": _programs_doc(),
@@ -1838,8 +1902,288 @@ def _bench_multihost(args) -> None:
             "warmup": args.mh_warmup,
             "seed": args.mh_seed,
         },
+        "cluster": None,
         "critical_path": _critical_path_doc(),
         "slo": _slo_doc({"sweep": _slo_close(slo_eng)}),
+        "programs": _programs_doc(),
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
+CLUSTER_WARMUP_COMMITS = 2
+CLUSTER_GOODPUT_FLOOR = 0.5
+
+
+def _bench_cluster(args) -> None:
+    """Fused serving cluster bench (ISSUE 18, fedml_tpu/scale/
+    cluster.py): H spawned hosts each bind a reactor endpoint and
+    serve live-socket uplinks into their registry-shard lanes, folding
+    lane partials cross-host through the ElasticChannel at every
+    commit barrier; ONE connswarm fleet (subprocess, own fd budget)
+    stripes its connections across the H endpoints, pacing uplinks
+    along the PR-10 diurnal profile.  Rows sweep host counts —
+    cluster committed-updates/sec, p95 admission (max over ranks), and
+    ranks_agree (the live-ingest cross-rank digest pin).  The
+    chaos_everything arm composes EVERY fault layer at once:
+    connection storm + reconnect churn + seeded wire faults + rank 1
+    killed mid-run — survivors must keep >= 0.5x the clean row's
+    goodput, agree bitwise after the death, lose no recv threads, and
+    account every shed/evicted/dropped uplink."""
+    import dataclasses
+    import tempfile
+
+    import numpy as np
+
+    from fedml_tpu import obs
+    from fedml_tpu.async_.torture import _swarm_subprocess
+    from fedml_tpu.comm.connswarm import SwarmConfig
+    from fedml_tpu.parallel.multihost import (MultihostLaunchError,
+                                              free_port,
+                                              spawn_cluster_report)
+    from fedml_tpu.scale.arrivals import ArrivalConfig
+    from fedml_tpu.scale.cluster import make_uplink_frame
+
+    hosts_list = sorted(int(h) for h in str(args.cluster_hosts).split(",")
+                        if h.strip())
+    if not hosts_list or hosts_list[0] < 1:
+        raise SystemExit(
+            f"--cluster_hosts must be a comma-separated list of "
+            f"positive host counts, got {args.cluster_hosts!r}")
+    if args.cluster_commits <= CLUSTER_WARMUP_COMMITS:
+        raise SystemExit(
+            f"--cluster_commits ({args.cluster_commits}) must exceed "
+            f"the warmup ({CLUSTER_WARMUP_COMMITS})")
+    rng = np.random.default_rng(args.cluster_seed)
+    frame = make_uplink_frame(
+        rng.standard_normal(args.cluster_row_dim).astype(np.float32),
+        sender=1, weight=1.0, version=0)
+
+    def run_arm(hosts, *, tag, storm=False, chaos=None, die_at=None,
+                expect_ranks=None, commits=None):
+        ports = [free_port() for _ in range(hosts)]
+        # weak scaling: --cluster_rate is PER HOST, so the fleet's
+        # aggregate offer grows with the host count (each row asks
+        # "did adding hosts add committed throughput").  The flash
+        # profile bursts ABOVE that (the push-notification stampede),
+        # it does not scale it down: offered_rate is the profile's
+        # PEAK, so the storm arm's peak is boost x the sustained rate
+        offered = args.cluster_rate * hosts * (3.0 if storm else 1.0)
+        sc = {"population": args.cluster_population,
+              "commits": int(commits or args.cluster_commits),
+              "warmup_commits": CLUSTER_WARMUP_COMMITS,
+              "buffer_k": args.cluster_buffer_k,
+              "row_dim": args.cluster_row_dim,
+              "connections": args.cluster_connections,
+              "ingest_pool": args.cluster_ingest_pool,
+              "window_deadline_s": 5.0, "timeout_s": 600.0,
+              "ports": ports}
+        if chaos:
+            sc["chaos"] = dict(chaos)
+            sc["chaos_seed"] = args.cluster_seed
+        if die_at is not None:
+            sc["die_rank"] = 1
+            sc["die_at_commit"] = die_at
+        cfg = {"serve_cluster": sc, "channel_timeout_s": 300.0,
+               "hb_timeout_s": 1.0, "hb_interval_s": 0.25}
+        arrival = dataclasses.asdict(ArrivalConfig(
+            mode="flash" if storm else "diurnal",
+            rate=args.cluster_rate, period_s=30.0, amplitude=0.5,
+            flash_at_s=2.0, flash_duration_s=5.0, flash_boost=3.0,
+            seed=args.cluster_seed))
+        swarm_cfg = SwarmConfig(
+            n_connections=hosts * args.cluster_connections,
+            offered_rate=offered, storm=storm,
+            churn_lifetime_s=(CONN_CHURN_LIFETIME_S if storm else 0.0),
+            duration_s=600.0, seed=args.cluster_seed,
+            targets=[["127.0.0.1", p] for p in ports],
+            arrival=arrival, burst_cap_s=0.05)
+        # swarm first: the fleet retries refused connects until the
+        # workers' reactors bind, so startup order is not a race
+        sw_finish = _swarm_subprocess(swarm_cfg, frame)
+        path = None
+        try:
+            with tempfile.NamedTemporaryFile(
+                    "w", suffix=".json", delete=False) as f:
+                json.dump(cfg, f)
+                path = f.name
+            outs, rep = spawn_cluster_report(
+                [sys.executable, "-m", "fedml_tpu.parallel.mh_worker",
+                 path], hosts, timeout_s=900.0, elastic=(hosts > 1))
+        finally:
+            sw = sw_finish()
+            if path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        docs = {}
+        for r, out in enumerate(outs):
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    docs[r] = json.loads(line)["serve_cluster"]
+        expect = (set(expect_ranks) if expect_ranks is not None
+                  else set(range(hosts)))
+        if not expect <= set(docs):
+            raise MultihostLaunchError(
+                f"cluster arm {tag!r}: missing rank report(s) "
+                f"{sorted(expect - set(docs))} "
+                f"(ranks: {rep['ranks']})")
+        r0 = docs[min(docs)]
+        p95_ms = max(d["admission_p95_s"] for d in docs.values()) * 1e3
+        print(f"{tag}: {r0['cluster_updates_per_sec']:.1f} cluster "
+              f"updates/s  p95 admission {p95_ms:.1f} ms  swarm sent "
+              f"{sw.get('frames_sent', 0)} frames "
+              f"({sw.get('connects', 0)} connects)", file=sys.stderr)
+        return docs, rep, sw
+
+    def steady_rate(doc, skip):
+        """Sustained committed-updates/sec over the tail of the
+        per-commit walls/wsums ledger — at least the last half of the
+        commits, and never earlier than `skip`.  The early commits are
+        regime transients, excluded by construction: the startup
+        backlog drain (frames that landed while jit warmed up replay
+        at decode speed, not at the offered pace) and, in the chaos
+        arm, the kill + heartbeat-eviction window — a one-time stall
+        that must not masquerade as steady-state goodput loss."""
+        n = len(doc["commit_walls_s"])
+        skip = max(int(skip), n // 2)
+        walls = doc["commit_walls_s"][skip:]
+        wsums = doc["commit_wsums"][skip:]
+        tw = sum(walls)
+        return (sum(wsums) / tw) if tw > 0 else 0.0
+
+    def arm_doc(docs, sw, steady_skip=CLUSTER_WARMUP_COMMITS):
+        digests = [d["committed_digest"] for d in docs.values()]
+        return {
+            "cluster_updates_per_sec": round(
+                docs[min(docs)]["cluster_updates_per_sec"], 4),
+            "steady_updates_per_sec": round(
+                steady_rate(docs[min(docs)], steady_skip), 4),
+            "admission_p50_s": round(max(
+                d["admission_p50_s"] for d in docs.values()), 6),
+            "admission_p95_s": round(max(
+                d["admission_p95_s"] for d in docs.values()), 6),
+            "ranks_agree": len(set(digests)) == 1,
+            "committed_updates": int(sum(
+                d["committed_updates"] for d in docs.values())),
+            "commits": max(d["commits"] for d in docs.values()),
+            "evicted": {k: sum(d["evicted"][k] for d in docs.values())
+                        for k in next(iter(docs.values()))["evicted"]},
+            "uplinks_shed": sum(d["uplinks_shed"]
+                                for d in docs.values()),
+            "shed_reasons": {
+                k: sum(d["shed_reasons"][k] for d in docs.values())
+                for k in next(iter(docs.values()))["shed_reasons"]},
+            "lane_overflow_dropped": sum(
+                d["lane_overflow_dropped"] for d in docs.values()),
+            "deadline_windows": sum(d["deadline_windows"]
+                                    for d in docs.values()),
+            "recv_thread_deaths": sum(d["recv_thread_deaths"]
+                                      for d in docs.values()),
+            "quarantined": sum(d["quarantined"] for d in docs.values()),
+            "open_connections_peak": sum(
+                d["open_connections_peak"] for d in docs.values()),
+            "registry_bytes": sum(d["registry_bytes"]
+                                  for d in docs.values()),
+            "swarm": {"frames_sent": sw.get("frames_sent"),
+                      "connects": sw.get("connects"),
+                      "refused": sw.get("refused"),
+                      "per_target": sw.get("per_target")},
+        }
+
+    rows = []
+    slo_arms: dict = {}
+    clean_by_hosts: dict = {}
+    for hosts in hosts_list:
+        docs, _rep, sw = run_arm(hosts, tag=f"hosts={hosts} clean")
+        clean_by_hosts[hosts] = docs
+        slo_arms[f"h{hosts}_clean"] = docs[min(docs)].get("slo_arm")
+        row = {"hosts": hosts,
+               "connections": hosts * args.cluster_connections,
+               **arm_doc(docs, sw)}
+        rows.append(row)
+
+    # the chaos-everything arm: storm + churn + wire faults + rank
+    # kill, all in the same run, at the widest clean host count >= 2
+    chaos_arm = None
+    hmax = max(hosts_list)
+    if hmax >= 2:
+        # more commits than the clean rows: the one-time eviction
+        # stall (heartbeat timeout + view change) must amortize over
+        # the post-kill steady state, same shape as the multihost
+        # chaos arm's round count
+        chaos_commits = max(12, 2 * args.cluster_commits)
+        die_at = CLUSTER_WARMUP_COMMITS + 1
+        survivors = set(range(hmax)) - {1}
+        docs, rep, sw = run_arm(
+            hmax, tag=f"hosts={hmax} chaos-everything", storm=True,
+            chaos=dict(CONN_CHAOS), die_at=die_at,
+            expect_ranks=survivors, commits=chaos_commits)
+        sdocs = {r: docs[r] for r in survivors if r in docs}
+        digests = [d["committed_digest"] for d in sdocs.values()]
+        # goodput on the STEADY rates: clean tail vs the survivors'
+        # post-eviction tail (commit die_at absorbs the heartbeat
+        # timeout + view change; the floor judges the regime after it)
+        clean_ups = steady_rate(
+            clean_by_hosts[hmax][min(clean_by_hosts[hmax])],
+            CLUSTER_WARMUP_COMMITS)
+        killed_ups = steady_rate(sdocs[min(sdocs)], die_at + 1)
+        slo_arms[f"h{hmax}_chaos_everything"] = \
+            sdocs[min(sdocs)].get("slo_arm")
+        chaos_arm = {
+            "hosts": hmax,
+            "killed_rank": 1,
+            "die_at_commit": die_at,
+            "survivor_goodput_ratio": round(
+                killed_ups / clean_ups, 4) if clean_ups > 0 else None,
+            "bitwise_after_death_ok": len(set(digests)) == 1,
+            "survivor_deaths": sum(
+                1 for r, st in rep["ranks"].items()
+                if int(r) != 1 and st["rc"] != 0),
+            **arm_doc(sdocs, sw, steady_skip=die_at + 1),
+        }
+        print(f"chaos-everything: survivor goodput "
+              f"{chaos_arm['survivor_goodput_ratio']}x  bitwise "
+              f"{chaos_arm['bitwise_after_death_ok']}  sheds "
+              f"{chaos_arm['uplinks_shed']:.0f}", file=sys.stderr)
+
+    head = rows[-1]
+    doc = _stamp({
+        "metric": (f"cluster_{head['hosts']}hosts_"
+                   "committed_updates_per_sec"),
+        "value": head["cluster_updates_per_sec"],
+        "unit": "updates/sec",
+        "vs_baseline": None,
+        "mode": "cluster",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": None,
+        "chaos": None,
+        "attack": None,
+        "serve": None,
+        "connections": None,
+        "multihost": None,
+        "cluster": {
+            "rows": rows,
+            "chaos_everything": chaos_arm,
+            "goodput_floor": CLUSTER_GOODPUT_FLOOR,
+            "commits": args.cluster_commits,
+            "buffer_k": args.cluster_buffer_k,
+            "row_dim": args.cluster_row_dim,
+            "population": args.cluster_population,
+            "connections_per_host": args.cluster_connections,
+            "offered_rate": args.cluster_rate,
+            "ingest_pool": args.cluster_ingest_pool,
+            "chaos_rates": dict(CONN_CHAOS),
+            "seed": args.cluster_seed,
+        },
+        "critical_path": _critical_path_doc(),
+        "slo": _slo_doc(slo_arms),
         "programs": _programs_doc(),
     })
     if obs.enabled():

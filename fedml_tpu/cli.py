@@ -421,6 +421,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "a rank is suspected hung (the SIGSTOP "
                         "detector; detection runs between allgathers, "
                         "not only inside one)")
+    p.add_argument("--cluster_serve", action="store_true",
+                   help="run the fused serving cluster (ISSUE 18) "
+                        "instead of training: this process binds a "
+                        "reactor endpoint on --cluster_port (+rank) "
+                        "and serves live-socket uplinks into its "
+                        "registry-shard lanes, folding lane partials "
+                        "cross-host at each commit barrier.  Composes "
+                        "with --multihost_procs N --elastic (one host "
+                        "per process); drive load with `python -m "
+                        "fedml_tpu.comm.connswarm CFG.json` pointed at "
+                        "the endpoints")
+    p.add_argument("--cluster_port", type=int, default=54300,
+                   help="cluster serving: this host's uplink endpoint "
+                        "port is cluster_port + rank")
+    p.add_argument("--cluster_population", type=int, default=4096,
+                   help="cluster serving: total client-id space, "
+                        "range-partitioned across hosts")
+    p.add_argument("--cluster_commits", type=int, default=8,
+                   help="cluster serving: commit windows to serve")
+    p.add_argument("--cluster_buffer_k", type=int, default=16,
+                   help="cluster serving: uplinks per lane per commit "
+                        "window")
+    p.add_argument("--cluster_row_dim", type=int, default=256,
+                   help="cluster serving: flat model row dimension")
+    p.add_argument("--cluster_connections", type=int, default=64,
+                   help="cluster serving: reactor connection budget "
+                        "per host")
+    p.add_argument("--cluster_ingest_pool", type=int, default=2,
+                   help="cluster serving: decode-pool workers per host")
+    p.add_argument("--cluster_window_s", type=float, default=10.0,
+                   help="cluster serving: commit-window deadline — a "
+                        "lane with no socket traffic contributes what "
+                        "it has when this passes instead of wedging "
+                        "the cluster barrier")
     p.add_argument("--carry_codec", type=str, default="f32",
                    choices=("f32", "int8", "int8_ef"),
                    help="multihost: wire codec for the inter-host carry "
@@ -1111,6 +1145,58 @@ def _strip_arg(argv: list[str], flag: str) -> list[str]:
     return out
 
 
+def _run_cluster_serve_cli(args, mh_ctx) -> int:
+    """One serving host of the fused cluster (ISSUE 18): bind the
+    reactor endpoint at cluster_port + rank, serve live-socket uplinks
+    into this rank's registry-shard lanes, fold partials cross-host at
+    each commit barrier, and print the host report as one JSON line
+    (the same contract mh_worker's serve_cluster route honors)."""
+    import hashlib
+    import json
+
+    from fedml_tpu import obs
+    from fedml_tpu.scale.cluster import run_cluster_serve
+    if args.obs_dir:
+        obs.configure(args.obs_dir)
+    else:
+        obs.configure_from_env()
+    rank, world = (0, 1) if mh_ctx is None else (mh_ctx.rank,
+                                                 mh_ctx.world)
+    channel = None
+    if world > 1:
+        from fedml_tpu.parallel.multihost import ElasticChannel
+        knobs = {k: getattr(args, k) for k in
+                 ("cluster_population", "cluster_commits",
+                  "cluster_buffer_k", "cluster_row_dim",
+                  "cluster_connections", "cluster_window_s")}
+        digest = hashlib.md5(json.dumps(
+            knobs, sort_keys=True).encode()).hexdigest()
+        channel = ElasticChannel(
+            mh_ctx, n_items=world, config_digest=digest,
+            timeout_s=120.0, hb_interval_s=0.25,
+            hb_timeout_s=args.hb_timeout_s)
+    try:
+        report = run_cluster_serve(
+            args.cluster_population,
+            commits=args.cluster_commits,
+            warmup_commits=min(2, args.cluster_commits - 1),
+            buffer_k=args.cluster_buffer_k,
+            row_dim=args.cluster_row_dim,
+            port=args.cluster_port + rank,
+            partition=(rank, world), channel=channel,
+            elastic=world > 1,
+            n_connections=args.cluster_connections,
+            ingest_pool=args.cluster_ingest_pool,
+            window_deadline_s=args.cluster_window_s,
+            slo_window=(rank == 0))
+    finally:
+        if channel is not None:
+            channel.close()
+    print(json.dumps({"rank": rank, "world": world,
+                      "serve_cluster": report}), flush=True)
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
@@ -1142,6 +1228,10 @@ def main(argv: Optional[list[str]] = None) -> int:
             print(f"multihost launch failed: {e}", file=sys.stderr)
             return 1
         return 0
+    if args.cluster_serve:
+        # ISSUE 18: the fused serving cluster — no FedConfig, no
+        # training engines; this process is one serving host
+        return _run_cluster_serve_cli(args, mh_ctx)
     if args.batch_unroll is not None and args.batch_unroll < 1:
         # here, not in build_engine: the --deploy path builds its
         # trainer without build_engine and must get the same clean error

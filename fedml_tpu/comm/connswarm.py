@@ -48,7 +48,16 @@ _INPROGRESS = (errno.EINPROGRESS, errno.EWOULDBLOCK, errno.EAGAIN)
 @dataclasses.dataclass
 class SwarmConfig:
     """Knobs of one swarm run.  The pre-encoded uplink frame is passed
-    as bytes in-process, or via `frame_path` for the subprocess mode."""
+    as bytes in-process, or via `frame_path` for the subprocess mode.
+
+    `targets` (ISSUE 18) stripes ONE fleet across N host endpoints —
+    a list of [host, port] pairs; sender i dials targets[(i-1) % N],
+    and the stats grow a `per_target` block (connects/refused/frames
+    per endpoint).  None keeps the single-endpoint (host, port)
+    behavior byte-for-byte.  `arrival` (an ArrivalConfig asdict)
+    replays the PR-10 diurnal/flash-crowd profile over real sockets:
+    offered_rate becomes the fleet's PEAK and the instantaneous rate
+    follows λ(t)/λ_peak of the configured process."""
     host: str = "127.0.0.1"
     port: int = 53600
     n_connections: int = 256
@@ -61,6 +70,13 @@ class SwarmConfig:
     seed: int = 0
     frame_path: Optional[str] = None
     tick_s: float = 0.01
+    targets: Optional[list] = None   # [[host, port], ...] multi-endpoint
+    arrival: Optional[dict] = None   # ArrivalConfig asdict rate profile
+    # max banked send budget, in seconds of offered load (the
+    # no-post-stall-burst cap).  1.0 = the historical behavior; the
+    # cluster bench sets ~0.05 so a fleet that waited out the serving
+    # hosts' startup paces at λ(t) instead of dumping a burst
+    burst_cap_s: float = 1.0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -72,9 +88,10 @@ class SwarmConfig:
 
 class _CConn:
     __slots__ = ("sock", "fd", "sender", "connected", "pending",
-                 "die_at", "mask")
+                 "die_at", "mask", "target")
 
-    def __init__(self, sock: socket.socket, sender: int):
+    def __init__(self, sock: socket.socket, sender: int,
+                 target: str = ""):
         self.sock = sock
         self.fd = sock.fileno()
         self.sender = sender
@@ -82,6 +99,7 @@ class _CConn:
         self.pending: Optional[memoryview] = None
         self.die_at: Optional[float] = None
         self.mask = 0
+        self.target = target
 
 
 class ConnectionSwarm:
@@ -100,8 +118,32 @@ class ConnectionSwarm:
         #                                             absolute monotonic
         self.stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # one fleet, N endpoints (ISSUE 18): sender i always dials the
+        # SAME target — striping is a pure function of the sender id,
+        # so reconnects land where the seq ledger expects them
+        self._targets = [(str(h), int(p))
+                         for h, p in (cfg.targets
+                                      or [(cfg.host, cfg.port)])]
         self.stats = {"connects": 0, "reconnects": 0, "refused": 0,
-                      "frames_sent": 0, "conn_errors": 0}
+                      "frames_sent": 0, "conn_errors": 0,
+                      "per_target": {
+                          f"{h}:{p}": {"connects": 0, "refused": 0,
+                                       "frames_sent": 0,
+                                       "conn_errors": 0}
+                          for h, p in self._targets}}
+        self._arr = None
+        if cfg.arrival:
+            from fedml_tpu.scale.arrivals import (ArrivalConfig,
+                                                  make_arrivals)
+            self._arr = make_arrivals(ArrivalConfig(**cfg.arrival))
+
+    def _target_of(self, sender: int) -> tuple:
+        return self._targets[(sender - 1) % len(self._targets)]
+
+    def _tstat(self, conn_or_key) -> dict:
+        key = (conn_or_key if isinstance(conn_or_key, str)
+               else conn_or_key.target)
+        return self.stats["per_target"][key]
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ConnectionSwarm":
@@ -152,8 +194,15 @@ class ConnectionSwarm:
                         if self._conns.get(conn.fd) is conn:
                             self._drop(conn, error=True)
                 now = time.monotonic()
-                budget = min(budget + cfg.offered_rate * (now - last),
-                             cfg.offered_rate)       # no post-stall burst
+                # arrival-profile pacing (ISSUE 18): offered_rate is
+                # the fleet's peak; the instantaneous rate follows the
+                # configured diurnal/flash λ(t) shape — real sockets
+                # replaying the PR-10 arrival processes
+                rate = (cfg.offered_rate if self._arr is None
+                        else cfg.offered_rate
+                        * self._arr.rate_fraction(now - t0))
+                budget = min(budget + rate * (now - last),
+                             cfg.offered_rate * cfg.burst_cap_s)
                 last = now
                 tried = 0
                 limit = len(self._send_ring)
@@ -186,21 +235,25 @@ class ConnectionSwarm:
         heapq.heappush(self._events, (time.monotonic() + delay, sender))
 
     def _connect(self, sender: int, now: float) -> None:
+        host, port = self._target_of(sender)
+        tkey = f"{host}:{port}"
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setblocking(False)
         try:
-            rc = s.connect_ex((self.cfg.host, self.cfg.port))
+            rc = s.connect_ex((host, port))
         except OSError:
             s.close()
             self.stats["conn_errors"] += 1
+            self._tstat(tkey)["conn_errors"] += 1
             self._schedule_reconnect(sender)
             return
         if rc not in (0,) and rc not in _INPROGRESS:
             s.close()
             self.stats["refused"] += 1
+            self._tstat(tkey)["refused"] += 1
             self._schedule_reconnect(sender)
             return
-        conn = _CConn(s, sender)
+        conn = _CConn(s, sender, target=tkey)
         if self.cfg.churn_lifetime_s > 0.0:
             conn.die_at = now + float(self._rng.exponential(
                 self.cfg.churn_lifetime_s))
@@ -219,6 +272,7 @@ class ConnectionSwarm:
         conn.mask = selectors.EVENT_WRITE | selectors.EVENT_READ
         self._conns[conn.fd] = conn
         self.stats["connects"] += 1
+        self._tstat(conn)["connects"] += 1
         if self._seq.get(sender, 0) > 0:
             self.stats["reconnects"] += 1
         self._send_ring.append(conn)
@@ -238,6 +292,7 @@ class ConnectionSwarm:
                 # refused/reset mid-handshake: the shed gate at work —
                 # retry after the reconnect delay (the storm's churn)
                 self.stats["refused"] += 1
+                self._tstat(conn)["refused"] += 1
                 sender = conn.sender
                 self._close(conn)
                 self._schedule_reconnect(sender)
@@ -282,6 +337,7 @@ class ConnectionSwarm:
         if n < len(buf):
             conn.pending = memoryview(buf)[n:]
         self.stats["frames_sent"] += 1
+        self._tstat(conn)["frames_sent"] += 1
         self._interest(conn)
         return True
 

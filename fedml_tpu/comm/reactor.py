@@ -803,6 +803,12 @@ class ReactorGroup:
         self._rss_checked = 0.0
         self._rss_over = False
         self._overload_gate: Optional[Callable[[], bool]] = None
+        # per-reason door-shed ledger: ceiling / external gate /
+        # sustained ingest pressure / RSS watermark.  The fused-cluster
+        # report reads this to attribute sheds to LANE pressure (the
+        # registry-fed gate) vs the transport's own watermarks.
+        self.shed_reasons = {"ceiling": 0, "gate": 0, "pressure": 0,
+                             "rss": 0}
         self._listener_paused_until = 0.0
         self._listener_registered = False
         b = backend.backend_name
@@ -942,13 +948,17 @@ class ReactorGroup:
                     return
                 log.warning("%s: accept failed: %s", self.name, e)
                 return
-            if (self._open_inbound >= self.cfg.max_connections
-                    or self._overloaded(now)):
+            why = ("ceiling"
+                   if self._open_inbound >= self.cfg.max_connections
+                   else self._overload_reason(now))
+            if why is not None:
                 # load shedding at the door: reject before the conn
-                # costs a registration — counted, never silent
+                # costs a registration — counted + attributed, never
+                # silent
                 self._m_shed.inc()
+                self.shed_reasons[why] += 1
                 obs.instant("reactor.shed_accept",
-                            open=self._open_inbound)
+                            open=self._open_inbound, reason=why)
                 Reactor._safe_close(s)
                 continue
             try:
@@ -988,26 +998,32 @@ class ReactorGroup:
                 self._pressure_since = None
 
     def _overloaded(self, now: float) -> bool:
+        return self._overload_reason(now) is not None
+
+    def _overload_reason(self, now: float) -> Optional[str]:
+        """Which watermark (if any) says shed: "gate" (the external
+        serving-layer signal — lane/registry pressure), "pressure"
+        (sustained ingest-pool backpressure), or "rss"."""
         gate = self._overload_gate
         if gate is not None:
             try:
                 if gate():
-                    return True
+                    return "gate"
             except Exception:
                 log.exception("%s: overload gate failed", self.name)
         if self.cfg.shed_on_pressure:
             with self._lock:
                 since = self._pressure_since
             if since is not None and now - since >= self.cfg.shed_after_s:
-                return True
+                return "pressure"
         if self.cfg.rss_limit_bytes is not None:
             if now - self._rss_checked > 0.5:
                 from fedml_tpu.scale.serve import rss_bytes
                 self._rss_checked = now
                 self._rss_over = rss_bytes() > self.cfg.rss_limit_bytes
             if self._rss_over:
-                return True
-        return False
+                return "rss"
+        return None
 
     # -- connection accounting -----------------------------------------------
     def _note_inbound_open(self) -> None:

@@ -57,6 +57,18 @@ DEFAULTS = {
     # each block's encoded carry under the remaining blocks' compute
     "carry_codec": "f32", "carry_chunk": None,
     "overlap_exchange": False,
+    # ISSUE 18: serve_cluster (dict | None) routes the worker into the
+    # fused serving cluster instead of the training engines — this
+    # rank binds a reactor on its endpoint port and serves live-socket
+    # uplinks into its registry-shard lanes, folding partials
+    # cross-host at each commit barrier.  Keys (all optional):
+    # population, commits, warmup_commits, buffer_k, row_dim,
+    # connections, ingest_pool, window_deadline_s, timeout_s,
+    # ports [per-rank endpoint list] | base_port (port = base + rank),
+    # chaos {wire-fault dict}, chaos_seed, die_rank/die_at_commit
+    # (crash injection: that rank hard-exits rc=3 after that many
+    # commits — the survivors' next exchange evicts it), slo (bool).
+    "serve_cluster": None,
 }
 
 
@@ -127,6 +139,69 @@ def build_case(cfg: dict):
     return make_engine
 
 
+def _serve_cluster_main(ctx, cfg: dict) -> int:
+    """ISSUE 18: one host of the fused serving cluster.  Builds the
+    elastic channel (world > 1), runs run_cluster_serve on this rank's
+    endpoint port, and prints ONE JSON line — the same contract the
+    training route honors, so spawn_cluster_report parses both.  A
+    rank with crash injection armed exits rc=3 WITHOUT a JSON line
+    (the launcher's blame report names it; the survivors' reports are
+    the evidence)."""
+    import hashlib
+
+    from fedml_tpu.parallel.multihost import ElasticChannel
+    from fedml_tpu.scale.cluster import run_cluster_serve
+
+    sc = dict(cfg["serve_cluster"])
+    channel = None
+    crashed = False
+    if ctx.world > 1:
+        # config digest covers the WHOLE worker config — a skewed rank
+        # is rejected by name at hello, exactly as the training route
+        digest = hashlib.md5(json.dumps(
+            cfg, sort_keys=True).encode()).hexdigest()
+        channel = ElasticChannel(
+            ctx, n_items=ctx.world, config_digest=digest,
+            timeout_s=cfg["channel_timeout_s"],
+            hb_interval_s=cfg["hb_interval_s"],
+            hb_timeout_s=cfg["hb_timeout_s"])
+    ports = sc.get("ports")
+    port = (int(ports[ctx.rank]) if ports
+            else int(sc.get("base_port", 54300)) + ctx.rank)
+    crash_at = (sc.get("die_at_commit")
+                if sc.get("die_rank") == ctx.rank else None)
+    try:
+        report = run_cluster_serve(
+            int(sc.get("population", 4096)),
+            commits=int(sc.get("commits", 8)),
+            warmup_commits=int(sc.get("warmup_commits", 2)),
+            buffer_k=int(sc.get("buffer_k", 16)),
+            row_dim=int(sc.get("row_dim", 256)),
+            port=port, partition=(ctx.rank, ctx.world),
+            channel=channel, elastic=ctx.world > 1,
+            n_connections=int(sc.get("connections", 64)),
+            ingest_pool=int(sc.get("ingest_pool", 2)),
+            window_deadline_s=float(sc.get("window_deadline_s", 10.0)),
+            timeout_s=float(sc.get("timeout_s", 600.0)),
+            chaos=sc.get("chaos"),
+            chaos_seed=int(sc.get("chaos_seed", 0)),
+            crash_at_commit=crash_at,
+            slo_window=bool(sc.get("slo", ctx.rank == 0)))
+        crashed = bool(crash_at is not None
+                       and report.get("elastic", {})
+                                .get("crashed_at_commit") is not None)
+    finally:
+        if channel is not None and not crashed:
+            channel.close()
+    if crashed:
+        print(f"rank {ctx.rank}: injected crash at commit {crash_at}",
+              file=sys.stderr, flush=True)
+        os._exit(3)
+    print(json.dumps({"rank": ctx.rank, "world": ctx.world,
+                      "serve_cluster": report}), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if len(argv) != 1:
@@ -171,6 +246,11 @@ def main(argv=None) -> int:
         if os.environ.get("FEDML_MH_REJOIN") == "1":
             sub = f"rank{ctx.rank}-pid{os.getpid()}"
         obs.configure(os.path.join(obs_root, sub))
+
+    if cfg["serve_cluster"]:
+        # ISSUE 18: the fused serving cluster — no training engines,
+        # no residency modes; the rank serves live sockets instead
+        return _serve_cluster_main(ctx, cfg)
 
     current_mode = {"mode": None}
 

@@ -69,6 +69,17 @@ class ArrivalProcess:
     def rate(self, t: float) -> float:
         raise NotImplementedError
 
+    def rate_fraction(self, t: float) -> float:
+        """λ(t) / λ_peak in [0, 1] — the shape of the arrival process
+        with its absolute rate divided out.  The connection swarm uses
+        this to pace a live-socket fleet along the same diurnal/flash
+        profile the virtual-time serve sim replays: offered_rate is
+        the fleet's PEAK, and the instantaneous rate follows the
+        profile."""
+        if self.peak_rate <= 0.0:
+            return 0.0
+        return max(0.0, min(1.0, self.rate(t) / self.peak_rate))
+
     def slowdown(self, t: float) -> float:
         """How much slower the fleet responds at virtual time t than at
         peak load: λ_peak / λ(t), floored at 1 (peak = nominal).  The

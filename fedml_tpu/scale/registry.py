@@ -277,6 +277,21 @@ class ClientRegistry:
             sh["last_staleness"][loc] = staleness
             sh["last_seen"][loc] = version
 
+    def note_push(self, cid: int, staleness: float,
+                  version: int) -> None:
+        """A PUSH-mode uplink (live-socket serving, scale/cluster.py):
+        the client contributed without a server dispatch, so there is
+        no IN_FLIGHT marker to retire — participation/staleness/
+        last_seen update exactly as note_contribution, status stays
+        untouched."""
+        with self._lock:
+            cid = self._check_scalar(cid)
+            s, loc = divmod(cid, self.shard_size)
+            sh = self._alloc(s)
+            sh["participation"][loc] += 1
+            sh["last_staleness"][loc] = staleness
+            sh["last_seen"][loc] = version
+
     def note_quarantine(self, cid: int) -> bool:
         """Count one admission rejection; returns True when the client
         crossed `quarantine_ban_threshold` and was auto-BANNED (never

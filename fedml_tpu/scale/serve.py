@@ -46,6 +46,36 @@ from fedml_tpu.scale.registry import ClientRegistry
 from fedml_tpu.scale.sampler import StreamingCohortSampler
 
 
+def pack_partial(acc, wsum) -> bytes:
+    """One lane/rank partial on the wire: <f4 wsum then the f32 acc
+    row.  THE one payload layout — run_serve_sim's fold, the fused
+    cluster's fold (scale/cluster.py) and the elastic zero-fill all
+    speak it, so the cross-rank digest pins compare the same bytes."""
+    return (np.float32(wsum).tobytes()
+            + np.asarray(acc, np.float32).tobytes())
+
+
+def zero_partial(row_dim: int) -> bytes:
+    """The deterministic zero payload a not-yet-adopted range folds."""
+    return (np.float32(0.0).tobytes()
+            + np.zeros(row_dim, np.float32).tobytes())
+
+
+def fold_partials(docs, row_dim: int):
+    """Rank/item-ordered sum of (wsum, acc) payloads — THE one
+    cross-rank fold, shared by both transports and by the fused
+    serving cluster.  Caller supplies docs already in item order; the
+    fold itself adds nothing order-dependent."""
+    import jax.numpy as jnp
+    t_wsum = np.float32(0.0)
+    t_acc = np.zeros(row_dim, np.float32)
+    for d in docs:
+        t_wsum = np.float32(
+            t_wsum + np.frombuffer(d, "<f4", count=1)[0])
+        t_acc += np.frombuffer(d, "<f4", offset=4)
+    return jnp.asarray(t_acc), jnp.float32(t_wsum)
+
+
 def rss_bytes() -> int:
     """Resident set size of this process (0 where /proc is absent)."""
     try:
@@ -262,8 +292,7 @@ def run_serve_sim(population: int, *, commits: int = 30,
     gens: dict[int, object] = {}
     retired: list[_ServeLane] = []      # lanes the view moved elsewhere
     adopted_items: list[int] = []
-    zero_payload = (np.float32(0.0).tobytes()
-                    + np.zeros(row_dim, np.float32).tobytes())
+    zero_payload = zero_partial(row_dim)
 
     # the commit math: a tiny flat-row "model" through the REAL PR-6
     # streaming buffer + O(P) commit program
@@ -277,19 +306,10 @@ def run_serve_sim(population: int, *, commits: int = 30,
     crashed_out = False
 
     def _pack(acc, wsum) -> bytes:
-        return (np.float32(wsum).tobytes()
-                + np.asarray(acc, np.float32).tobytes())
+        return pack_partial(acc, wsum)
 
     def _fold(docs):
-        """Rank/item-ordered sum of (wsum, acc) payloads — THE one
-        cross-rank fold, shared by both transports."""
-        t_wsum = np.float32(0.0)
-        t_acc = np.zeros(row_dim, np.float32)
-        for d in docs:
-            t_wsum = np.float32(
-                t_wsum + np.frombuffer(d, "<f4", count=1)[0])
-            t_acc += np.frombuffer(d, "<f4", offset=4)
-        return jnp.asarray(t_acc), jnp.float32(t_wsum)
+        return fold_partials(docs, row_dim)
 
     def all_lanes() -> list:
         return list(lanes.values()) + retired

@@ -50,7 +50,13 @@ Noise-band sources (don't tighten without re-measuring):
     zero-breach gate (the clean elastic arm's cluster SLO pack must be
     green); straggler_attribution_ok is a boolean pin (the killed arm
     must breach cluster_no_rank_deaths AND name the killed rank);
-    barrier counts / gating stats are informational.
+    barrier counts / gating stats are informational;
+  * cluster (v16): steady committed-updates/sec is process-contended
+    (swarm subprocess + H workers on 2 cores) — the 65% GIL band;
+    survivor_goodput_ratio carries the ISSUE-18 >= 0.5 floor,
+    recv_thread_deaths the zero gate, and bitwise_after_death_ok /
+    ranks_agree are boolean pins (the fold must stay a pure function
+    of the block/lane partition no matter what the sockets did).
 """
 from __future__ import annotations
 
@@ -62,7 +68,7 @@ import os
 import sys
 from typing import Optional
 
-SCHEMA_MIN, SCHEMA_MAX = 2, 15
+SCHEMA_MIN, SCHEMA_MAX = 2, 16
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +240,28 @@ def prune(doc: dict) -> dict:
                 leaks += float(a.get("fd_leaked") or 0)
         f["recv_thread_deaths"] = deaths
         f["fd_leaked"] = leaks
+    elif mode == "cluster":
+        # v16 fused serving cluster (ISSUE 18)
+        c = doc.get("cluster") or {}
+        f["headline_updates_per_sec"] = doc.get("value")
+        deaths = 0.0
+        agree = True
+        for row in c.get("rows") or []:
+            h = row.get("hosts")
+            if row.get("steady_updates_per_sec") is not None:
+                f[f"steady_updates_per_sec[hosts={h}]"] = row[
+                    "steady_updates_per_sec"]
+            if row.get("admission_p95_s") is not None:
+                f[f"admission_p95_s[hosts={h}]"] = row["admission_p95_s"]
+            deaths += float(row.get("recv_thread_deaths") or 0)
+            agree = agree and bool(row.get("ranks_agree", True))
+        ce = c.get("chaos_everything") or {}
+        f["survivor_goodput_ratio"] = ce.get("survivor_goodput_ratio")
+        f["bitwise_after_death_ok"] = ce.get("bitwise_after_death_ok")
+        f["survivor_deaths"] = ce.get("survivor_deaths")
+        deaths += float(ce.get("recv_thread_deaths") or 0)
+        f["recv_thread_deaths"] = deaths
+        f["ranks_agree"] = agree
     # v11: clean-arm SLO breaches ride every mode
     b = _slo_breaches(doc.get("slo"))
     if b is not None:
@@ -379,6 +407,25 @@ RULES: dict[tuple, Rule] = {
     ("multihost", "f32_overlap_fraction"): Rule(
         0, note="box-load sensitive; the >0 acceptance rides the "
                 "codec rows"),
+    # -- cluster (ISSUE 18): the fused serving path runs a swarm
+    # subprocess + H spawned workers on the 2-core box — absolute
+    # rates ride the 65% process-contention band; the judgment lives
+    # in the gated chaos-everything ratio, the zero-deaths gate, and
+    # the boolean fold-determinism pins (handled by the boolean gate
+    # path: bitwise_after_death_ok, ranks_agree).
+    ("cluster", "headline_updates_per_sec"): Rule(
+        +1, 0.65, note="swarm + H workers on 2 cores; GIL band"),
+    ("cluster", "survivor_goodput_ratio"): Rule(
+        +1, 0.65, gate_min=0.5,
+        note="ISSUE-18 >=0.5x survivor-goodput floor under the "
+             "chaos-everything arm (storm + wire faults + rank "
+             "kill)"),
+    ("cluster", "survivor_deaths"): Rule(
+        -1, 0.0, gate_max=0.0,
+        note="only the injected kill may die"),
+    ("cluster", "recv_thread_deaths"): Rule(
+        -1, 0.0, gate_max=0.0,
+        note="zero recv-thread deaths across all arms"),
 }
 # pattern rules for the per-count connection fields
 PATTERN_RULES: list[tuple] = [
@@ -408,6 +455,12 @@ PATTERN_RULES: list[tuple] = [
     ("multihost", "overlap_fraction[",
      Rule(0, note="wall-clock ratio, box-load sensitive; "
                   "informational")),
+    # -- cluster per-host-count rows (ISSUE 18)
+    ("cluster", "steady_updates_per_sec[",
+     Rule(+1, 0.65, note="post-warmup tail rate; GIL/loopback band")),
+    ("cluster", "admission_p95_s[",
+     Rule(-1, 0.65, note="socket->buffer admission latency; box-load "
+                         "sensitive")),
 ]
 # v11 slo block: clean arms must stay breach-free in EVERY mode
 SLO_RULE = Rule(-1, 0.0, gate_max=0.0,

@@ -1243,6 +1243,35 @@ def exp_ELASTIC():
                          f"--mh_arms chaos failed (rc={r.returncode})")
 
 
+def exp_CLUSTER():
+    """Fused serving cluster chip-attached (ISSUE 18): `bench.py
+    --mode cluster` — H spawned hosts each binding a reactor endpoint
+    over the host's registry-shard range, a striped connswarm fleet
+    replaying the diurnal/flash arrival processes over real sockets,
+    lane partials folding cross-host through ElasticChannel at every
+    commit barrier.  FEDML_CLUSTER_HOSTS overrides the 1,2,4 sweep;
+    FEDML_CLUSTER_RATE the per-host offered rate.  Gates ride
+    bench_diff v16: chaos-everything survivor goodput >= 0.5x clean,
+    zero recv-thread deaths, bitwise_after_death_ok + ranks_agree
+    boolean pins.  On chips the fold/commit dispatch runs against the
+    chip-attached runtime, so admission p95 prices real decode->device
+    handoff instead of a CPU-contended loopback box."""
+    import subprocess
+    hosts = os.environ.get("FEDML_CLUSTER_HOSTS", "1,2,4")
+    rate = os.environ.get("FEDML_CLUSTER_RATE", "2000")
+    bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "bench.py")
+    r = subprocess.run(
+        [sys.executable, bench, "--mode", "cluster",
+         "--cluster_hosts", hosts, "--cluster_rate", rate],
+        text=True, capture_output=True, timeout=3600)
+    sys.stderr.write(r.stderr)
+    print(r.stdout, flush=True)
+    if r.returncode != 0:
+        raise SystemExit(f"exp_CLUSTER: bench.py --mode cluster "
+                         f"failed (rc={r.returncode})")
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
