@@ -187,7 +187,28 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     bitwise_after_death_ok (survivor digests agree), and the full
 #     evictions/sheds/drops ledger; v15 readers that ignore unknown
 #     keys keep working
-SCHEMA_VERSION = 16
+# v17: the "multihost" block gains the "sparse" arm and the "cluster"
+#     block a "sparse" sub-block (ISSUE 19 — topk/topk_ef carry codecs
+#     in fedml_tpu/parallel/carry_codec.py + the sparse_topk uplink
+#     transport in comm/message.py).  multihost sparse: same paired
+#     2-process protocol as the compress arm, one row per sparse codec
+#     (topk, topk_ef; overlap on, eval on) with the SAME columns —
+#     carry_wire_bytes_per_round (channel-measured),
+#     carry_compression_ratio, wire_reduction_vs_f32 (the ISSUE-19
+#     >= 6x gate rides bench_diff), overlap_fraction, eval_acc +
+#     acc_delta_vs_f32 (quality band; topk is LOSSY where int8 was
+#     near-lossless, so this column carries the judgment), ranks_agree,
+#     and efficiency_at_constant_bytes; plus bitwise_f32_escape_ok
+#     re-pinned on the f32 baseline pair.  cluster sparse: a paired
+#     dense-vs-sparse_topk uplink run at the same host count —
+#     uplink_bytes_per_update (frame bytes on the wire),
+#     uplink_reduction_vs_dense, sparse committed-updates/sec and
+#     throughput_ratio_vs_dense (>= 0.9x on 2-core rides bench_diff),
+#     digests_equal on a <= k-sparse replay (sparse_topk round-trips
+#     <= k-nonzero rows exactly, so dense and sparse ingest commit
+#     identical bits); v16 readers that ignore unknown keys keep
+#     working
+SCHEMA_VERSION = 17
 
 
 # the programs block's window opens when main() configures obs (set
@@ -525,6 +546,12 @@ def main() -> None:
                     help="cluster mode: one seed drives the swarm "
                          "schedule, the arrival profile, and the chaos "
                          "injector")
+    ap.add_argument("--cluster_arms", default="clean",
+                    help="cluster mode extra arms: add 'sparse' for "
+                         "the paired dense-vs-sparse_topk uplink arm "
+                         "(v17, ISSUE 19) — the fleet ships k=dim/16 "
+                         "(index, value) frames and the servers opt "
+                         "into the scatter-fold ingest path")
     args = ap.parse_args()
     # chip-unavailable marker (round-2 outage lesson): emit ONE JSON line
     # with an explicit error field instead of crashing, so the driver
@@ -1524,10 +1551,10 @@ def _bench_multihost(args) -> None:
         raise SystemExit(f"--mh_rounds ({args.mh_rounds}) must exceed "
                          f"--mh_warmup ({args.mh_warmup})")
     arms = {a.strip() for a in str(args.mh_arms).split(",") if a.strip()}
-    bad_arms = arms - {"weak", "bitwise", "chaos", "compress"}
+    bad_arms = arms - {"weak", "bitwise", "chaos", "compress", "sparse"}
     if bad_arms or not arms:
         raise SystemExit(f"--mh_arms must be a non-empty subset of "
-                         f"weak,bitwise,chaos,compress; got "
+                         f"weak,bitwise,chaos,compress,sparse; got "
                          f"{args.mh_arms!r}")
     if args.mh_chaos_procs < 2:
         raise SystemExit(f"--mh_chaos_procs must be >= 2 (someone has "
@@ -1868,6 +1895,113 @@ def _bench_multihost(args) -> None:
             compress = {"error": str(e),
                         "bitwise_f32_escape_ok": False}
 
+    # v17 sparse arm (ISSUE 19): same paired 2-process protocol as the
+    # compress arm, but the codec rows are the SPARSE flavors (topk,
+    # topk_ef; fixed k = dim/16 per block).  The wire bytes are the
+    # channel's measured per-round delta, so wire_reduction_vs_f32 is
+    # the honest bytes-on-the-wire ratio the ISSUE-19 >= 6x gate rides
+    # on (bench_diff v17).  f32 stays the escape hatch: its bitwise
+    # pin is re-asserted here under overlap so a sparse-era regression
+    # in the fold path can't hide behind the compress arm being off.
+    sparse = None
+    if "sparse" in arms:
+        def _wire_sb(docs):
+            return max(docs[r]["carry_wire_sent_bytes_per_round"]
+                       for r in docs)
+
+        try:
+            # topk_ef's reconstruction mirror needs ~topk_ratio rounds
+            # of warm-up before every coordinate has shipped once —
+            # judging convergence at the 10-round default would
+            # measure the transient, not the codec, so the arm floors
+            # its round count well past the warm-up (the chaos arm's
+            # round-floor precedent)
+            sp_rounds = max(8 * 16, args.mh_rounds)
+            ev = {"eval": True}
+            f32_docs, _ = run_arm(2, 2, sp_rounds, ["streaming"],
+                                  extra_cfg=ev)
+            f32_ov_docs, _ = run_arm(
+                2, 2, sp_rounds, ["streaming"],
+                extra_cfg={**ev, "carry_codec": "f32",
+                           "overlap_exchange": True})
+            escape_ok = all(
+                f32_ov_docs[r]["digests"] == f32_docs[0]["digests"]
+                for r in f32_ov_docs)
+            f32_rps = f32_docs[0]["rounds_per_sec"]
+            f32_wire = _wire_sb(f32_docs)
+            f32_acc = f32_docs[0].get("eval", {}).get("streaming")
+            codec_rows = []
+            for codec in ("topk", "topk_ef"):
+                docs, _ = run_arm(
+                    2, 2, sp_rounds, ["streaming"],
+                    extra_cfg={**ev, "carry_codec": codec,
+                               "overlap_exchange": True})
+                d0 = docs[0]
+                wire = _wire_sb(docs)
+                rps = d0["rounds_per_sec"]
+                acc = d0.get("eval", {}).get("streaming")
+                reduction = (round(f32_wire / wire, 4)
+                             if wire > 0 else None)
+                crow = {
+                    "codec": codec,
+                    "rounds_per_sec": round(rps, 4),
+                    "carry_wire_bytes_per_round": round(wire, 1),
+                    "carry_payload_bytes_per_round": round(
+                        d0["carry_payload_bytes_per_round"], 1),
+                    "carry_raw_bytes_per_round": round(
+                        d0["carry_raw_bytes_per_round"], 1),
+                    "carry_compression_ratio": round(
+                        d0["carry_compression_ratio"], 4),
+                    "wire_reduction_vs_f32": reduction,
+                    "overlap_fraction": round(
+                        d0["overlap_fraction"], 4),
+                    "ranks_agree": all(
+                        docs[r]["digests"] == d0["digests"]
+                        for r in docs),
+                    "eval_acc": (round(acc, 4)
+                                 if acc is not None else None),
+                    "acc_delta_vs_f32": (
+                        round(abs(acc - f32_acc), 4)
+                        if acc is not None and f32_acc is not None
+                        else None),
+                    "efficiency_at_constant_bytes": (
+                        round((rps / f32_rps) * reduction, 4)
+                        if f32_rps > 0 and reduction else None),
+                }
+                codec_rows.append(crow)
+                print(f"multihost sparse {codec}: "
+                      f"{crow['carry_wire_bytes_per_round']:.0f} "
+                      f"B/round on the wire "
+                      f"({crow['wire_reduction_vs_f32']}x vs f32), "
+                      f"overlap {crow['overlap_fraction']}, "
+                      f"acc_delta {crow['acc_delta_vs_f32']}",
+                      file=sys.stderr)
+            sparse = {
+                "procs": 2,
+                "rounds": sp_rounds,
+                "topk_ratio": 16,
+                "f32_rounds_per_sec": round(f32_rps, 4),
+                "f32_wire_bytes_per_round": round(f32_wire, 1),
+                "f32_eval_acc": (round(f32_acc, 4)
+                                 if f32_acc is not None else None),
+                "f32_overlap_fraction": round(
+                    f32_ov_docs[0]["overlap_fraction"], 4),
+                "bitwise_f32_escape_ok": bool(escape_ok),
+                "codecs": codec_rows,
+            }
+            print(f"multihost f32 escape hatch under overlap "
+                  f"(sparse arm): "
+                  f"{'OK' if escape_ok else 'MISMATCH'} (overlap "
+                  f"fraction "
+                  f"{sparse['f32_overlap_fraction']})",
+                  file=sys.stderr)
+        except MultihostLaunchError as e:
+            print(f"multihost sparse arm FAILED: {e}",
+                  file=sys.stderr)
+            deaths_total += 1
+            sparse = {"error": str(e),
+                      "bitwise_f32_escape_ok": False}
+
     head = (rows[-1] if rows and "error" not in rows[-1] else
             (base or (rows[-1] if rows else {})))
     doc = _stamp({
@@ -1893,6 +2027,7 @@ def _bench_multihost(args) -> None:
             "chaos": chaos,
             "straggler": straggler,
             "compress": compress,
+            "sparse": sparse,
             "process_deaths": deaths_total,
             "k_per_block": args.mh_k_per_block,
             "clients_per_block": args.mh_clients_per_block,
@@ -1956,13 +2091,23 @@ def _bench_cluster(args) -> None:
         raise SystemExit(
             f"--cluster_commits ({args.cluster_commits}) must exceed "
             f"the warmup ({CLUSTER_WARMUP_COMMITS})")
+    cluster_arms = {a.strip()
+                    for a in str(args.cluster_arms).split(",")
+                    if a.strip()}
+    bad_cluster_arms = cluster_arms - {"clean", "sparse"}
+    if bad_cluster_arms:
+        raise SystemExit(
+            f"--cluster_arms must be a subset of clean,sparse; got "
+            f"{args.cluster_arms!r}")
     rng = np.random.default_rng(args.cluster_seed)
-    frame = make_uplink_frame(
-        rng.standard_normal(args.cluster_row_dim).astype(np.float32),
-        sender=1, weight=1.0, version=0)
+    uplink_row = rng.standard_normal(
+        args.cluster_row_dim).astype(np.float32)
+    frame = make_uplink_frame(uplink_row, sender=1, weight=1.0,
+                              version=0)
 
     def run_arm(hosts, *, tag, storm=False, chaos=None, die_at=None,
-                expect_ranks=None, commits=None):
+                expect_ranks=None, commits=None, uplink_frame=None,
+                sparse_uplink=False):
         ports = [free_port() for _ in range(hosts)]
         # weak scaling: --cluster_rate is PER HOST, so the fleet's
         # aggregate offer grows with the host count (each row asks
@@ -1980,6 +2125,8 @@ def _bench_cluster(args) -> None:
               "ingest_pool": args.cluster_ingest_pool,
               "window_deadline_s": 5.0, "timeout_s": 600.0,
               "ports": ports}
+        if sparse_uplink:
+            sc["sparse_uplink"] = True
         if chaos:
             sc["chaos"] = dict(chaos)
             sc["chaos_seed"] = args.cluster_seed
@@ -2002,7 +2149,8 @@ def _bench_cluster(args) -> None:
             arrival=arrival, burst_cap_s=0.05)
         # swarm first: the fleet retries refused connects until the
         # workers' reactors bind, so startup order is not a race
-        sw_finish = _swarm_subprocess(swarm_cfg, frame)
+        sw_finish = _swarm_subprocess(
+            swarm_cfg, frame if uplink_frame is None else uplink_frame)
         path = None
         try:
             with tempfile.NamedTemporaryFile(
@@ -2150,6 +2298,72 @@ def _bench_cluster(args) -> None:
               f"{chaos_arm['bitwise_after_death_ok']}  sheds "
               f"{chaos_arm['uplinks_shed']:.0f}", file=sys.stderr)
 
+    # v17 sparse uplink arm (ISSUE 19): the paired dense-vs-sparse
+    # run at the widest clean host count.  Same offered rate, same
+    # population, same connections — the ONLY change is the wire: the
+    # fleet ships sparse_topk v2 frames (k = dim/16 pairs) and the
+    # servers opt their lanes into the scatter-fold ingest path
+    # (sparse_uplink=True).  throughput_ratio_vs_dense rides the
+    # ISSUE-19 >= 0.9x gate in bench_diff; uplink_reduction_vs_dense
+    # is honest len(frame) bytes including the envelope.  The
+    # digests_equal pin replays a <=k-sparse row through the sparse
+    # codec in-process — sparse_topk ships exact f32 (index, value)
+    # pairs, so a row with <= k nonzeros must decode bitwise-equal
+    # (truncation only bites when MORE than k coordinates are live;
+    # that lossy case is priced by the multihost sparse arm's
+    # acc_delta, not pinned here).
+    sparse_arm = None
+    if "sparse" in cluster_arms:
+        from fedml_tpu.comm.message import MessageCodec
+        k = max(1, args.cluster_row_dim // 16)
+        sp_row = np.zeros(args.cluster_row_dim, np.float32)
+        sp_idx = rng.choice(args.cluster_row_dim, size=k,
+                            replace=False)
+        sp_row[sp_idx] = rng.standard_normal(k).astype(np.float32)
+        replay = MessageCodec.decode(make_uplink_frame(
+            sp_row, sender=1, weight=1.0, version=0,
+            transport="sparse_topk"))
+        replay_row = np.asarray(replay.get("model_params")["w"])
+        digests_equal = bool(
+            replay_row.dtype == np.float32
+            and np.array_equal(
+                replay_row.view(np.uint32),
+                sp_row.view(np.uint32)))
+        sparse_frame = make_uplink_frame(
+            uplink_row, sender=1, weight=1.0, version=0,
+            transport="sparse_topk")
+        docs, _rep, sw = run_arm(
+            hmax, tag=f"hosts={hmax} sparse",
+            uplink_frame=sparse_frame, sparse_uplink=True)
+        dense_docs = clean_by_hosts[hmax]
+        dense_ups = steady_rate(dense_docs[min(dense_docs)],
+                                CLUSTER_WARMUP_COMMITS)
+        sparse_ups = steady_rate(docs[min(docs)],
+                                 CLUSTER_WARMUP_COMMITS)
+        slo_arms[f"h{hmax}_sparse"] = docs[min(docs)].get("slo_arm")
+        sparse_arm = {
+            "hosts": hmax,
+            "topk_ratio": 16,
+            "k": k,
+            "uplink_bytes_per_update": len(sparse_frame),
+            "dense_uplink_bytes_per_update": len(frame),
+            "uplink_reduction_vs_dense": round(
+                len(frame) / len(sparse_frame), 4),
+            "throughput_ratio_vs_dense": (
+                round(sparse_ups / dense_ups, 4)
+                if dense_ups > 0 else None),
+            "digests_equal": digests_equal,
+            **arm_doc(docs, sw),
+        }
+        print(f"sparse uplink: {sparse_arm['uplink_bytes_per_update']}"
+              f" B/update "
+              f"({sparse_arm['uplink_reduction_vs_dense']}x vs dense "
+              f"{len(frame)} B), throughput ratio "
+              f"{sparse_arm['throughput_ratio_vs_dense']}x, "
+              f"k-sparse replay "
+              f"{'EXACT' if digests_equal else 'MISMATCH'}",
+              file=sys.stderr)
+
     head = rows[-1]
     doc = _stamp({
         "metric": (f"cluster_{head['hosts']}hosts_"
@@ -2171,6 +2385,7 @@ def _bench_cluster(args) -> None:
         "cluster": {
             "rows": rows,
             "chaos_everything": chaos_arm,
+            "sparse": sparse_arm,
             "goodput_floor": CLUSTER_GOODPUT_FLOOR,
             "commits": args.cluster_commits,
             "buffer_k": args.cluster_buffer_k,

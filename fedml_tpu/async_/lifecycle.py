@@ -211,7 +211,8 @@ class AsyncServerManager(ServerManager):
                  staleness_a: float = 0.5, staleness_b: float = 4.0,
                  mix: float = 1.0, deadline_s: Optional[float] = None,
                  streaming: bool = True, ingest_pool: int = 0,
-                 decode_into: bool = True, redispatch: bool = True,
+                 decode_into: bool = True, sparse_uplink: bool = False,
+                 redispatch: bool = True,
                  reliable: bool = False, min_quorum: int = 1,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1, resume: bool = False,
@@ -226,6 +227,13 @@ class AsyncServerManager(ServerManager):
                 "(defense needs streaming=True) — the drain path holds "
                 "the full [K, P] matrix and has the sync-side robust "
                 "aggregators instead")
+        if sparse_uplink and (not streaming or defense is not None):
+            raise ValueError(
+                "sparse_uplink rides the streaming sparse fold and the "
+                "admission screen needs dense rows — sparse_topk frames "
+                "compose with streaming=True and defense=None only "
+                "(defended configs densify via decode_into instead)")
+        self.sparse_uplink = bool(sparse_uplink)
         self.defense = defense
         self.variables = jax.tree.map(np.asarray, init_variables)
         self.total_commits = total_commits
@@ -508,9 +516,20 @@ class AsyncServerManager(ServerManager):
         try:
             t0 = time.perf_counter()
             msg = None
+            pairs = None
             with obs.span("ingest.decode", nbytes=len(payload),
                           into=self.decode_into):
-                if self.decode_into:
+                if self.sparse_uplink:
+                    # sparse fast path (ISSUE 19): pull the (index,
+                    # value) pairs without densifying; dense/mixed
+                    # frames fall through to decode_into unchanged
+                    try:
+                        msg, sidx, svals = MessageCodec.decode_sparse(
+                            payload, self._layout)
+                        pairs = (sidx, svals)
+                    except ValueError:
+                        msg = None            # dense frame / skew
+                if msg is None and self.decode_into:
                     try:
                         msg = MessageCodec.decode_into(payload, row,
                                                        self._layout)
@@ -551,7 +570,8 @@ class AsyncServerManager(ServerManager):
             self._ingest_row(
                 msg.get_sender_id(), row,
                 float(msg.get(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES)),
-                int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+                int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)),
+                sparse=pairs)
             if t_arrive is not None:
                 # admission latency: sink hand-off -> buffer insert
                 # (pool queue + decode + screen + lock), the ISSUE-11
@@ -569,12 +589,15 @@ class AsyncServerManager(ServerManager):
             self.com_manager._notify_ingest_ready()
 
     def _ingest_row(self, sender: int, row: np.ndarray, weight: float,
-                    dispatched: int) -> None:
+                    dispatched: int, *, sparse=None) -> None:
         """The ONE insert path (FSM route and decode pool both land
         here): staleness accounting, buffer fold/insert, commit
         trigger.  Lock acquisition is timed into
         async_lock_wait_seconds — the contention signal of the
-        concurrent-uplink regime."""
+        concurrent-uplink regime.  `sparse` (ISSUE 19) carries the
+        (global-index, value) pairs of a sparse_topk frame; when set,
+        `row` is untouched scratch and the insert rides the jitted
+        sparse scatter fold (AsyncBuffer.add_sparse)."""
         t0 = time.perf_counter()
         self._lock.acquire()
         self._m_lock_wait.inc(time.perf_counter() - t0)
@@ -610,7 +633,11 @@ class AsyncServerManager(ServerManager):
                     return
             else:
                 with obs.span("ingest.fold", sender=sender):
-                    full = self.buffer.add(row, weight, staleness)
+                    if sparse is not None:
+                        full = self.buffer.add_sparse(
+                            sparse[0], sparse[1], weight, staleness)
+                    else:
+                        full = self.buffer.add(row, weight, staleness)
             # shared post-insert bookkeeping: only ADMITTED results
             # count toward the staleness statistics (a quarantined
             # row's staleness returned above)

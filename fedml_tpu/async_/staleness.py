@@ -253,6 +253,38 @@ def make_fold_fn(mode: str = "constant", a: float = 0.5, b: float = 4.0):
         "async_fold", jax.jit(fold, donate_argnums=(0, 1)))
 
 
+def make_sparse_fold_fn(mode: str = "constant", a: float = 0.5,
+                        b: float = 4.0):
+    """Jitted SPARSE twin of the arrival fold (ISSUE 19):
+
+        fold(acc [P], wsum, idx [k], vals [k], weight, staleness)
+            -> (acc + w̃·scatter(idx, vals), wsum + w̃)
+
+    Takes the k (index, value) pairs a sparse_topk frame carries
+    (comm.message.MessageCodec.decode_sparse) — the dense [P] row never
+    materializes on the HOST; it exists only as an in-program scatter
+    feeding the IDENTICAL `acc + w̃·row` expression as make_fold_fn.
+    That expression sharing is load-bearing for bitwise parity: a
+    scatter-ADD of pre-multiplied w̃·vals would round twice where the
+    dense fold's fused multiply-add rounds once, putting sparse commits
+    one ULP off the dense fold of the densified row (measured on this
+    toolchain).  λ is the same in-program power as make_fold_fn.
+    `acc`/`wsum` donated, same as the dense fold.  Compiles once per k
+    (the fixed-ratio wire keeps k constant per template)."""
+    if mode not in STALENESS_MODES:
+        raise ValueError(f"unknown staleness mode {mode!r} "
+                         f"(choose one of {STALENESS_MODES})")
+
+    def fold(acc, wsum, idx, vals, weight, staleness):
+        lam = staleness_weight(mode, staleness, a, b)
+        wt = jnp.asarray(weight, jnp.float32) * lam
+        row = jnp.zeros_like(acc).at[idx].set(vals)
+        return acc + wt * row, wsum + wt
+
+    return obs_programs.instrument(
+        "async_sparse_fold", jax.jit(fold, donate_argnums=(0, 1)))
+
+
 def make_drain_fold_fn(mode: str = "constant", a: float = 0.5,
                        b: float = 4.0):
     """ONE compiled drained twin of the arrival fold: lax.scan the same
@@ -488,6 +520,11 @@ class AsyncBuffer:
             self.rows = None
             self._fold = make_fold_fn(staleness_mode, staleness_a,
                                       staleness_b)
+            # sparse twin (ISSUE 19), built on first sparse arrival so
+            # dense-only buffers never pay the extra jit cache entry
+            self._sparse_fold = None
+            self._staleness_args = (staleness_mode, staleness_a,
+                                    staleness_b)
             if self.buckets > 1:
                 self._accs = [jnp.zeros((p,), jnp.float32)
                               for _ in range(self.buckets)]
@@ -606,6 +643,46 @@ class AsyncBuffer:
                 self.raw_wsum += float(weight)
             else:
                 np.copyto(self.rows[i], row)
+            self.count += 1
+            return self.count >= self.capacity
+
+    def add_sparse(self, idx: np.ndarray, vals: np.ndarray,
+                   weight: float, staleness: float) -> bool:
+        """Insert one SPARSE result (ISSUE 19): scatter-add the k
+        (global row index, value) pairs of a sparse_topk frame into the
+        streaming accumulator via the jitted sparse fold — the dense
+        [P] row never exists on the host.  Streaming B = 1 only: the
+        bucketed robust path and the admission screen are defined over
+        dense rows (norm screens need the whole row), so sparse uplinks
+        compose with neither — route defended/bucketed configs through
+        decode_into + add() instead."""
+        with self._lock:
+            if not self.streaming:
+                raise RuntimeError(
+                    "add_sparse() on a drain-mode AsyncBuffer — sparse "
+                    "arrivals ride the streaming fold")
+            if self.buckets > 1:
+                raise RuntimeError(
+                    "add_sparse() on a bucketed AsyncBuffer — the "
+                    "robust bucket screens need dense rows; decode the "
+                    "frame via decode_into instead")
+            if self.count >= self.capacity:
+                raise RuntimeError("async buffer overflow: commit before add")
+            if self._sparse_fold is None:
+                self._sparse_fold = make_sparse_fold_fn(
+                    *self._staleness_args)
+            i = self.count
+            self.weights[i] = np.float32(weight)
+            self.staleness[i] = np.float32(staleness)
+            self.acc, self.wsum = self._sparse_fold(
+                self.acc, self.wsum,
+                np.ascontiguousarray(idx, np.int64),
+                np.ascontiguousarray(vals, np.float32),
+                np.float32(weight), np.float32(staleness))
+            # same row-recycling sync as add(): jax on CPU may alias
+            # the pair buffers zero-copy and dispatches asynchronously
+            self.wsum.block_until_ready()
+            self.raw_wsum += float(weight)
             self.count += 1
             return self.count >= self.capacity
 

@@ -456,14 +456,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "it has when this passes instead of wedging "
                         "the cluster barrier")
     p.add_argument("--carry_codec", type=str, default="f32",
-                   choices=("f32", "int8", "int8_ef"),
+                   choices=("f32", "int8", "int8_ef", "topk", "topk_ef"),
                    help="multihost: wire codec for the inter-host carry "
-                        "(ISSUE 16). f32 (default) is the bitwise "
+                        "(ISSUE 16/19). f32 (default) is the bitwise "
                         "escape hatch — bytes identical to the PR-13/14 "
                         "tier; int8 is per-chunk affine fixed-point "
                         "(~4x fewer bytes); int8_ef adds per-block "
                         "error-feedback residuals so the SUM over "
-                        "rounds converges to the true sum")
+                        "rounds converges to the true sum; topk ships "
+                        "only the k=dim/16 largest-|v| entries (~7.5x "
+                        "fewer bytes, LOSSY); topk_ef adds the int8_ef "
+                        "residual discipline to top-k so the summed "
+                        "carry drift stays a single round's truncation")
     p.add_argument("--overlap_exchange", action="store_true",
                    help="multihost: ship each block's encoded carry as "
                         "soon as it is computed so the DCN exchange "

@@ -19,6 +19,14 @@ latency at scale).  v2 adds, all OPT-IN per message key:
   affine scale (4x) on the wire, restored to the original dtype on
   decode.  Aggregation-critical payloads simply stay un-opted (exact,
   bitwise round trip);
+* sparse_topk (ISSUE 19): only the k = max(1, n // SPARSE_TOPK_RATIO)
+  largest-|value| entries of a float array ship, as u32 idx[k] ‖ f32
+  val[k] in one u8 wire blob (~8x fewer bytes at the default ratio 16,
+  LOSSY — pair it with client-side error feedback when the sum over
+  rounds matters).  decode() densifies; decode_into() scatters the
+  pairs straight into the preallocated flat row; decode_sparse()
+  returns the (global-index, value) pairs without ever densifying, for
+  the streaming sparse fold (async_/staleness.make_sparse_fold_fn);
 * zlib compression of the header + small-array section;
 * a chunked streaming encoder (`encode_parts`) that hands the frame to
   the socket as a prefix + per-buffer parts instead of materializing
@@ -39,6 +47,16 @@ from typing import Any, Optional
 import numpy as np
 
 from fedml_tpu import obs
+
+# the v2 per-array lossy wire transports this build can encode AND
+# decode — named in the version-skew rejection so an old server tells
+# the operator WHICH codec it is missing instead of dying in a thread
+WIRE_TRANSPORTS = ("bf16", "int8", "sparse_topk")
+
+# ship 1-in-16 entries on the sparse_topk wire (8 B per kept entry):
+# matches the carry tier's DEFAULT_TOPK_RATIO (parallel/carry_codec.py
+# imports from this module, so the constant lives here un-shared)
+SPARSE_TOPK_RATIO = 16
 
 
 class Message:
@@ -73,16 +91,17 @@ class Message:
 
     def set_wire_transport(self, key: str, kind: Optional[str]) -> None:
         """Opt this message key's float arrays into a lossy wire dtype:
-        "bf16" (2x) or "int8" (4x, per-tensor affine scale).  None/"none"
-        clears the opt-in.  Keys never opted in ride exact — keep
-        aggregation-critical payloads (e.g. model averages) that way
-        unless the caller accepts the precision tradeoff."""
+        "bf16" (2x), "int8" (4x, per-tensor affine scale), or
+        "sparse_topk" (~8x, top-k index/value pairs — ISSUE 19).
+        None/"none" clears the opt-in.  Keys never opted in ride exact
+        — keep aggregation-critical payloads (e.g. model averages) that
+        way unless the caller accepts the precision tradeoff."""
         if kind in (None, "none"):
             self.wire_transport.pop(key, None)
             return
-        if kind not in ("bf16", "int8"):
+        if kind not in WIRE_TRANSPORTS:
             raise ValueError(f"unknown wire transport {kind!r} "
-                             "(choose bf16 or int8)")
+                             f"(choose one of {WIRE_TRANSPORTS})")
         self.wire_transport[key] = kind
 
     # -- reference API (message.py:23-61) -----------------------------------
@@ -278,6 +297,25 @@ class MessageCodec:
             m["dtype"] = str(w.dtype)
             m["enc"] = {"kind": "bf16", "orig": str(a.dtype)}
             return w
+        if kind == "sparse_topk":
+            # top-k magnitude pairs: u32 idx[k] ‖ f32 val[k] in one u8
+            # blob.  Index-sorted so the wire form is deterministic.
+            if a.size == 0 or not np.all(np.isfinite(a)):
+                return a
+            flat = np.ascontiguousarray(a, dtype=np.float32).ravel()
+            k = max(1, flat.size // SPARSE_TOPK_RATIO)
+            if k >= flat.size:
+                return a               # nothing to drop; ride exact
+            sel = np.argpartition(np.abs(flat), flat.size - k)[-k:]
+            sel = np.sort(sel).astype("<u4")
+            w = np.frombuffer(
+                sel.tobytes() + flat[sel].astype("<f4").tobytes(),
+                dtype=np.uint8)
+            m["dtype"] = "uint8"
+            m["shape"] = [int(w.size)]
+            m["enc"] = {"kind": "sparse_topk", "orig": str(a.dtype),
+                        "oshape": list(a.shape), "k": int(k)}
+            return w
         # int8 + per-tensor affine: q = round((x - min)/scale) - 128
         if a.size == 0 or not np.all(np.isfinite(a)):
             return a
@@ -291,6 +329,18 @@ class MessageCodec:
         return q
 
     @staticmethod
+    def _sparse_pairs(a: np.ndarray, enc: dict):
+        """(idx u32[k], vals f32[k]) views of one sparse_topk wire blob."""
+        k = int(enc["k"])
+        blob = np.ascontiguousarray(a, dtype=np.uint8)
+        if blob.size != 8 * k:
+            raise ValueError(
+                f"sparse_topk blob is {blob.size} B, k={k} needs {8 * k}")
+        idx = blob[:4 * k].view("<u4")
+        vals = blob[4 * k:].view("<f4")
+        return idx, vals
+
+    @staticmethod
     def _decode_transport(a: np.ndarray, enc: Optional[dict]) -> np.ndarray:
         if not enc:
             return a
@@ -299,8 +349,22 @@ class MessageCodec:
             return a.astype(orig)
         if enc["kind"] == "int8":
             return affine_int8_decode(a, enc["min"], enc["scale"], orig)
-        raise ValueError(f"unknown wire transport encoding "
-                         f"{enc.get('kind')!r}")
+        if enc["kind"] == "sparse_topk":
+            idx, vals = MessageCodec._sparse_pairs(a, enc)
+            oshape = tuple(enc.get("oshape", ()))
+            n = int(np.prod(oshape, dtype=np.int64)) if oshape else 1
+            if idx.size and int(idx.max()) >= n:
+                raise ValueError(
+                    f"sparse_topk index {int(idx.max())} outside "
+                    f"original shape {oshape} (corrupt frame)")
+            dense = np.zeros(n, dtype=np.float32)
+            dense[idx] = vals
+            return dense.reshape(oshape).astype(orig)
+        raise ValueError(
+            f"unknown wire transport encoding {enc.get('kind')!r} — "
+            f"this peer decodes {list(WIRE_TRANSPORTS)}; a newer sender "
+            f"(version skew)? upgrade this server or clear the sender's "
+            f"set_wire_transport opt-in")
 
     # -- encode --------------------------------------------------------------
     @staticmethod
@@ -572,14 +636,52 @@ class MessageCodec:
                         f"decode_into: frame array {path!r} is not in the "
                         f"row layout (model template mismatch)")
                 dst_off, size, shape = ent
-                if count != size or tuple(m["shape"]) != shape:
+                enc = m.get("enc")
+                kind = enc.get("kind") if enc else None
+                if kind not in (None, "bf16", "int8", "sparse_topk"):
+                    # an alien kind must fail as VERSION SKEW, not as
+                    # the shape mismatch its opaque wire blob would
+                    # otherwise trip below
+                    raise ValueError(
+                        f"unknown wire transport encoding {kind!r} — "
+                        f"this peer decodes {list(WIRE_TRANSPORTS)}; a "
+                        f"newer sender (version skew)? upgrade this "
+                        f"server or clear the sender's "
+                        f"set_wire_transport opt-in")
+                sparse = kind == "sparse_topk"
+                # a sparse wire array is a u8 blob — validate the
+                # ORIGINAL (pre-sparsification) shape against the layout
+                wire_shape = (tuple(enc.get("oshape", ()))
+                              if sparse else tuple(m["shape"]))
+                wire_count = (int(np.prod(wire_shape, dtype=np.int64))
+                              if wire_shape else 1)
+                if wire_count != size or wire_shape != shape:
                     raise ValueError(
                         f"decode_into: frame array {path!r} has shape "
-                        f"{tuple(m['shape'])}, layout expects {shape}")
+                        f"{wire_shape}, layout expects {shape}")
                 view = np.frombuffer(src, dtype=dt, count=count, offset=off)
                 dst = out_row[dst_off:dst_off + size]
-                enc = m.get("enc")
-                if enc is None or enc["kind"] == "bf16":
+                if sparse:
+                    # scatter the k (index, value) pairs straight into
+                    # the flat row slot (ISSUE 19) — zero the slot
+                    # first, the dropped entries mean zero
+                    k = int(enc["k"])
+                    if count != 8 * k:
+                        raise ValueError(
+                            f"decode_into: sparse_topk blob for {path!r} "
+                            f"is {count} B, k={k} needs {8 * k}")
+                    idx = np.frombuffer(src, dtype="<u4", count=k,
+                                        offset=off)
+                    vals = np.frombuffer(src, dtype="<f4", count=k,
+                                         offset=off + 4 * k)
+                    if k and int(idx.max()) >= size:
+                        raise ValueError(
+                            f"decode_into: sparse_topk index "
+                            f"{int(idx.max())} outside [{size}] leaf "
+                            f"{path!r} (corrupt frame)")
+                    dst[:] = 0.0
+                    dst[idx] = vals
+                elif enc is None or enc["kind"] == "bf16":
                     # straight memcpy for f32, single-pass cast-into
                     # for f64/bf16/int leaves
                     np.copyto(dst, view, casting="unsafe")
@@ -591,8 +693,12 @@ class MessageCodec:
                               * enc["scale"] + enc["min"],
                               casting="unsafe")
                 else:
-                    raise ValueError(f"unknown wire transport encoding "
-                                     f"{enc.get('kind')!r}")
+                    raise ValueError(
+                        f"unknown wire transport encoding "
+                        f"{enc.get('kind')!r} — this peer decodes "
+                        f"{list(WIRE_TRANSPORTS)}; a newer sender "
+                        f"(version skew)? upgrade this server or clear "
+                        f"the sender's set_wire_transport opt-in")
                 filled += size
             else:
                 a = np.frombuffer(src, dtype=dt, count=count,
@@ -607,3 +713,85 @@ class MessageCodec:
         params = cls._unflatten(header["tree"], buffers)
         params[layout.key] = None
         return Message().init(params)
+
+    @classmethod
+    def decode_sparse(cls, payload: bytes, layout):
+        """Sparse twin of decode_into (ISSUE 19): for a frame whose
+        `layout.key` subtree rides ENTIRELY on the sparse_topk
+        transport, return
+
+            (msg, idx, vals)
+
+        where `idx` (i64) / `vals` (f32) are the concatenated (global
+        row index, value) pairs of every leaf — each leaf's wire
+        indices shifted by its RowLayout offset — and `msg` is the
+        decoded envelope with the layout key set to None.  The caller
+        feeds the pairs straight to the jitted sparse fold
+        (async_/staleness.make_sparse_fold_fn) so streaming
+        aggregation-on-arrival never materializes the dense row on the
+        host.  Raises ValueError if any layout-key leaf is NOT sparse
+        (mixed/dense frame — fall back to decode_into), on template
+        mismatch, and on decode's malformed-frame hardening."""
+        header, small_src, small_off, big_off = cls._frame_header(payload)
+        paths = cls._array_paths(header["tree"])
+        prefix = "/" + layout.key
+        buffers: list = [None] * len(header["arrays"])
+        idx_parts: list = []
+        val_parts: list = []
+        covered = 0
+        for i, m, src, off, dt, count in cls._each_array(
+                header, payload, small_src, small_off, big_off):
+            path = paths.get(i, "")
+            if path == prefix or path.startswith(prefix + "/"):
+                ent = layout.offsets.get(path)
+                if ent is None:
+                    raise ValueError(
+                        f"decode_sparse: frame array {path!r} is not in "
+                        f"the row layout (model template mismatch)")
+                enc = m.get("enc")
+                if not enc or enc.get("kind") != "sparse_topk":
+                    raise ValueError(
+                        f"decode_sparse: frame array {path!r} is not "
+                        f"sparse_topk (mixed frame — use decode_into)")
+                dst_off, size, shape = ent
+                oshape = tuple(enc.get("oshape", ()))
+                ocount = (int(np.prod(oshape, dtype=np.int64))
+                          if oshape else 1)
+                if ocount != size or oshape != shape:
+                    raise ValueError(
+                        f"decode_sparse: frame array {path!r} has shape "
+                        f"{oshape}, layout expects {shape}")
+                k = int(enc["k"])
+                if count != 8 * k:
+                    raise ValueError(
+                        f"decode_sparse: sparse_topk blob for {path!r} "
+                        f"is {count} B, k={k} needs {8 * k}")
+                idx = np.frombuffer(src, dtype="<u4", count=k, offset=off)
+                vals = np.frombuffer(src, dtype="<f4", count=k,
+                                     offset=off + 4 * k)
+                if k and int(idx.max()) >= size:
+                    raise ValueError(
+                        f"decode_sparse: sparse_topk index "
+                        f"{int(idx.max())} outside [{size}] leaf "
+                        f"{path!r} (corrupt frame)")
+                idx_parts.append(idx.astype(np.int64) + dst_off)
+                val_parts.append(np.asarray(vals, dtype=np.float32))
+                covered += size
+            else:
+                a = np.frombuffer(src, dtype=dt, count=count,
+                                  offset=off).reshape(m["shape"])
+                if not m.get("enc"):
+                    a = a.copy()          # metadata arrays stay mutable
+                buffers[i] = cls._decode_transport(a, m.get("enc"))
+        if covered != layout.p:
+            raise ValueError(
+                f"decode_sparse: frame covered {covered} of {layout.p} "
+                f"row elements under {prefix!r} (model template "
+                f"mismatch)")
+        params = cls._unflatten(header["tree"], buffers)
+        params[layout.key] = None
+        gi = (np.concatenate(idx_parts) if idx_parts
+              else np.zeros(0, dtype=np.int64))
+        gv = (np.concatenate(val_parts) if val_parts
+              else np.zeros(0, dtype=np.float32))
+        return Message().init(params), gi, gv

@@ -21,10 +21,42 @@ that 4 B/param for ~1 B/param on the wire:
   accumulator is runner state — it rides ``state_dict()`` /
   ``load_state_dict()`` and checkpoints through orbax as
   ``extra_state`` so crash-resume continues the same error trajectory.
+* ``topk`` — fixed-k magnitude sparsification (ISSUE 19): only the
+  k = max(1, dim // ratio) largest-|value| entries ship, as k u32
+  indices + k f32 values.  ~(4*dim)/(8 + 8k) fewer bytes (7.5x at the
+  default ratio 16) but LOSSY — the dropped mass is gone.  The shipped
+  values are exact f32 (no quantization), so any vector with <= k
+  nonzeros round-trips bitwise.
+* ``topk_ef`` — top-k DELTA encoding against a replicated
+  reconstruction mirror: the carry is a SNAPSHOT stream (each round's
+  vector is a weighted model sum, not an increment), so unlike
+  ``int8_ef`` the error feedback must live in the *difference* domain.
+  The encoder ships the top-k of ``vec - rec`` where ``rec`` is the
+  receiver's integrated reconstruction, and EVERY rank (including the
+  encoder itself) advances ``rec`` by integrating the identical
+  allgathered bytes — the mirror is replicated by construction, never
+  synchronized.  Unsent coordinates have ``|vec - rec|`` below the
+  round's selection threshold, so the reconstruction error is bounded
+  by a SINGLE round's truncation threshold at every round (feeding the
+  raw snapshot through a stream-EF residual instead would accumulate
+  the full unselected model mass every round and diverge).
 
 Wire layout (int8 flavors), per block:
 
     u32 dim ‖ f32 min[n_chunks] ‖ f32 scale[n_chunks] ‖ int8 q[dim]
+
+Wire layout (topk flavors), per block:
+
+    u32 dim ‖ u32 k ‖ u32 idx[k] ‖ f32 val[k]
+
+k is a pure function of dim (fixed ratio), so the equal-length-bytes
+contract the HostChannel allgather requires holds by construction.
+Top-k selection runs as a jitted ``lax.top_k`` over |vec| (cached per
+(dim, k) — no per-element Python); the residual update is a vectorized
+scatter against the round-tripped values.  Sparse codecs expose
+``sparse = True`` plus ``decode_pairs()`` so the runner fold can
+scatter-add (index, value) pairs straight into the flat f32 carry
+without densifying per block.
 
 The payload size is a pure function of (dim, chunk) — load-bearing:
 ``ElasticChannel`` requires uniform item payloads to split collective
@@ -47,11 +79,15 @@ import numpy as np
 
 from fedml_tpu.comm.message import affine_int8_decode, affine_int8_encode
 
-CARRY_CODECS = ("f32", "int8", "int8_ef")
+CARRY_CODECS = ("f32", "int8", "int8_ef", "topk", "topk_ef")
 
 # ~16 KiB of f32 per (min, scale) pair: coarse enough to amortize the
 # 8 B header, fine enough that one outlier only poisons its own chunk
 DEFAULT_CHUNK = 4096
+
+# ship 1-in-16 entries by default: 8 B/kept-entry -> 7.5x fewer wire
+# bytes than f32 at dim >> 1, comfortably past the ISSUE-19 6x gate
+DEFAULT_TOPK_RATIO = 16
 
 
 class CarryCodec:
@@ -150,29 +186,11 @@ class Int8CarryCodec(CarryCodec):
         return affine_int8_decode(q, per_mn, per_sc, np.float32)
 
 
-class Int8EFCarryCodec(Int8CarryCodec):
-    """int8/affine with per-block error-feedback residuals: encode
-    ships q(vec + residual[block]) and keeps the new quantization error
-    for the next round, so the summed carry over rounds tracks the true
-    sum within a single round's quantization error."""
+class _BlockResidualState:
+    """Per-block f64 error-feedback residual state shared by the
+    stateful (`*_ef`) codecs: elastic retention, checkpoint dict."""
 
-    name = "int8_ef"
-
-    def __init__(self, chunk: int = DEFAULT_CHUNK):
-        super().__init__(chunk)
-        self._residual: dict[int, np.ndarray] = {}
-
-    def encode(self, block: int, vec: np.ndarray) -> bytes:
-        vec = np.ascontiguousarray(vec, dtype=np.float32)
-        res = self._residual.get(block)
-        if res is not None and res.size != vec.size:
-            res = None                 # block re-partitioned; start clean
-        # f64 carry+residual so the fed-back error does not itself round
-        fed = (vec.astype(np.float64)
-               + (res if res is not None else 0.0))
-        buf = self._encode_vec(block, fed.astype(np.float32))
-        self._residual[block] = fed - self.decode(buf).astype(np.float64)
-        return buf
+    _residual: dict
 
     def retain_blocks(self, blocks) -> None:
         """Forget residuals for blocks this rank no longer owns
@@ -198,12 +216,199 @@ class Int8EFCarryCodec(Int8CarryCodec):
                           for b, v in res.items()}
 
 
-def make_carry_codec(name: str, *, chunk: int = DEFAULT_CHUNK) -> CarryCodec:
-    """Codec by CLI name (``--carry_codec f32|int8|int8_ef``)."""
+class Int8EFCarryCodec(_BlockResidualState, Int8CarryCodec):
+    """int8/affine with per-block error-feedback residuals: encode
+    ships q(vec + residual[block]) and keeps the new quantization error
+    for the next round, so the summed carry over rounds tracks the true
+    sum within a single round's quantization error."""
+
+    name = "int8_ef"
+
+    def __init__(self, chunk: int = DEFAULT_CHUNK):
+        super().__init__(chunk)
+        self._residual: dict[int, np.ndarray] = {}
+
+    def encode(self, block: int, vec: np.ndarray) -> bytes:
+        vec = np.ascontiguousarray(vec, dtype=np.float32)
+        res = self._residual.get(block)
+        if res is not None and res.size != vec.size:
+            res = None                 # block re-partitioned; start clean
+        # f64 carry+residual so the fed-back error does not itself round
+        fed = (vec.astype(np.float64)
+               + (res if res is not None else 0.0))
+        buf = self._encode_vec(block, fed.astype(np.float32))
+        self._residual[block] = fed - self.decode(buf).astype(np.float64)
+        return buf
+
+
+def _topk_select(dim: int, k: int):
+    """Jitted fixed-k magnitude selection, cached per (dim, k): one
+    `lax.top_k` over |vec| then a gather — no per-element Python.
+    Imported lazily so the codec module stays importable without a
+    working jax runtime (the fold/decode side is pure numpy)."""
+    fn = _topk_select._cache.get((dim, k))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def sel(vec):
+            _, idx = jax.lax.top_k(jnp.abs(vec), k)
+            return idx.astype(jnp.uint32), jnp.take(vec, idx)
+
+        fn = _topk_select._cache[(dim, k)] = sel
+    return fn
+
+
+_topk_select._cache = {}
+
+
+class TopKCarryCodec(CarryCodec):
+    """Fixed-k magnitude top-k sparsification (LOSSY without the `_ef`
+    residual flavor — the dropped (dim - k) mass never ships)."""
+
+    name = "topk"
+    sparse = True
+
+    def __init__(self, chunk: int = DEFAULT_CHUNK,
+                 topk_ratio: int = DEFAULT_TOPK_RATIO):
+        super().__init__(chunk)
+        self.topk_ratio = int(topk_ratio)
+        if self.topk_ratio <= 0:
+            raise ValueError(
+                f"topk ratio must be positive, got {topk_ratio}")
+
+    def k_for(self, dim: int) -> int:
+        """k is a pure function of dim — the equal-length-bytes
+        contract the HostChannel allgather splits by."""
+        dim = int(dim)
+        return 0 if dim == 0 else max(1, dim // self.topk_ratio)
+
+    def encoded_nbytes(self, dim: int) -> int:
+        return 8 + 8 * self.k_for(dim)
+
+    def _encode_vec(self, block: int, vec: np.ndarray) -> bytes:
+        vec = np.ascontiguousarray(vec, dtype=np.float32)
+        if vec.size and not np.all(np.isfinite(vec)):
+            raise ValueError(
+                f"non-finite carry for block {block}: NaN poisons the "
+                f"top-k magnitude ordering — rerun with --carry_codec "
+                f"f32 (the escape hatch) to debug the divergence")
+        k = self.k_for(vec.size)
+        if k == 0:
+            return struct.pack("<II", 0, 0)
+        idx, vals = _topk_select(vec.size, k)(vec)
+        return (struct.pack("<II", vec.size, k)
+                + np.ascontiguousarray(idx, dtype="<u4").tobytes()
+                + np.ascontiguousarray(vals, dtype="<f4").tobytes())
+
+    def encode(self, block: int, vec: np.ndarray) -> bytes:
+        return self._encode_vec(block, vec)
+
+    def decode_pairs(self, buf: bytes):
+        """(dim, idx u32[k], vals f32[k]) without densifying — the
+        runner fold scatter-adds these straight into the flat carry."""
+        dim, k = struct.unpack_from("<II", buf, 0)
+        if len(buf) != self.encoded_nbytes(dim):
+            raise ValueError(
+                f"carry payload is {len(buf)} B but dim={dim} ratio="
+                f"{self.topk_ratio} encodes to {self.encoded_nbytes(dim)}"
+                f" B — mixed-codec cluster?")
+        idx = np.frombuffer(buf, dtype="<u4", count=k, offset=8)
+        vals = np.frombuffer(buf, dtype="<f4", count=k, offset=8 + 4 * k)
+        return dim, idx, vals
+
+    def decode(self, buf: bytes) -> np.ndarray:
+        dim, idx, vals = self.decode_pairs(buf)
+        arr = np.zeros(dim, dtype=np.float32)
+        arr[idx] = vals                # top_k indices are unique
+        return arr
+
+
+class TopKEFCarryCodec(_BlockResidualState, TopKCarryCodec):
+    """top-k DELTA encoding with a replicated reconstruction mirror:
+    ``encode`` ships the k largest-|.| entries of ``vec - rec`` (exact
+    f32 values), ``integrate`` scatter-adds a block's wire pairs into
+    that block's ``rec`` and returns the reconstruction.  Every rank
+    integrates the identical allgathered bytes for EVERY block — the
+    encoder included — so the mirror agrees bitwise across the cluster
+    without ever being synchronized, and a block adopted by a new
+    owner (elastic view change) continues from the very mirror the new
+    owner already holds.  Unsent coordinates have ``|vec - rec|``
+    below the round's selection threshold: the reconstruction error is
+    bounded by a single round's truncation threshold (the stream-EF
+    discipline of ``int8_ef`` would instead re-accumulate the whole
+    unselected snapshot mass every round — the carry is a weighted
+    model SUM, not an increment — and diverge)."""
+
+    name = "topk_ef"
+
+    def __init__(self, chunk: int = DEFAULT_CHUNK,
+                 topk_ratio: int = DEFAULT_TOPK_RATIO):
+        super().__init__(chunk, topk_ratio)
+        # block -> f32 reconstruction mirror (the "residual" state key
+        # is kept for the checkpoint extra_state convention: here the
+        # state IS the reconstruction, error = vec - rec implicitly)
+        self._residual: dict[int, np.ndarray] = {}
+
+    def _rec(self, block: int, dim: int) -> np.ndarray:
+        rec = self._residual.get(block)
+        if rec is None or rec.size != dim:
+            # unseen or re-partitioned block: the mirror restarts at
+            # zero ON EVERY RANK at once (all ranks see the same block
+            # partition), so agreement holds through the reset
+            rec = np.zeros(dim, dtype=np.float32)
+            self._residual[block] = rec
+        return rec
+
+    def encode(self, block: int, vec: np.ndarray) -> bytes:
+        vec = np.ascontiguousarray(vec, dtype=np.float32)
+        # NO state update here: the mirror advances only in
+        # integrate(), on the allgathered bytes, identically on every
+        # rank — the encoder's own integrate() of its own frame is
+        # what keeps its mirror honest
+        return self._encode_vec(block, vec - self._rec(block, vec.size))
+
+    def integrate(self, block: int, buf: bytes) -> np.ndarray:
+        """Advance block's reconstruction by one wire frame and return
+        it (f32, the runner fold's input).  Scatter-add is well-defined
+        — top-k indices are unique — and pure f32, so every rank's
+        mirror stays byte-identical given identical wire bytes."""
+        dim, idx, vals = self.decode_pairs(buf)
+        rec = self._rec(block, dim)
+        rec[idx] += vals
+        return rec
+
+    def retain_blocks(self, blocks) -> None:
+        """Keep EVERY block's mirror (override of the encoder-state
+        convention): rec is replicated DECODE state — every rank
+        integrates every block — so an ownership change must not drop
+        it; the new owner encodes deltas against the same mirror the
+        old owner's frames built."""
+
+    def state_dict(self) -> dict:
+        return {"residual": {str(b): np.asarray(v, dtype=np.float32)
+                             for b, v in sorted(self._residual.items())}}
+
+    def load_state_dict(self, state: dict) -> None:
+        if not state:
+            self._residual = {}
+            return
+        res = state.get("residual", state)
+        self._residual = {int(b): np.ascontiguousarray(v, np.float32)
+                          for b, v in res.items()}
+
+
+def make_carry_codec(name: str, *, chunk: int = DEFAULT_CHUNK,
+                     topk_ratio: int = DEFAULT_TOPK_RATIO) -> CarryCodec:
+    """Codec by CLI name (``--carry_codec f32|int8|int8_ef|topk|topk_ef``)."""
     try:
         cls = {"f32": CarryCodec, "int8": Int8CarryCodec,
-               "int8_ef": Int8EFCarryCodec}[name]
+               "int8_ef": Int8EFCarryCodec, "topk": TopKCarryCodec,
+               "topk_ef": TopKEFCarryCodec}[name]
     except KeyError:
         raise ValueError(f"unknown carry codec {name!r}; "
                          f"expected one of {CARRY_CODECS}") from None
+    if name in ("topk", "topk_ef"):
+        return cls(chunk=chunk, topk_ratio=topk_ratio)
     return cls(chunk=chunk)

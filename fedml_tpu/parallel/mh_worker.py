@@ -67,7 +67,9 @@ DEFAULTS = {
     # ports [per-rank endpoint list] | base_port (port = base + rank),
     # chaos {wire-fault dict}, chaos_seed, die_rank/die_at_commit
     # (crash injection: that rank hard-exits rc=3 after that many
-    # commits — the survivors' next exchange evicts it), slo (bool).
+    # commits — the survivors' next exchange evicts it), slo (bool),
+    # sparse_uplink (bool — ISSUE 19: accept sparse_topk frames via
+    # the decode_sparse -> jitted scatter-fold path).
     "serve_cluster": None,
 }
 
@@ -181,6 +183,7 @@ def _serve_cluster_main(ctx, cfg: dict) -> int:
             channel=channel, elastic=ctx.world > 1,
             n_connections=int(sc.get("connections", 64)),
             ingest_pool=int(sc.get("ingest_pool", 2)),
+            sparse_uplink=bool(sc.get("sparse_uplink", False)),
             window_deadline_s=float(sc.get("window_deadline_s", 10.0)),
             timeout_s=float(sc.get("timeout_s", 600.0)),
             chaos=sc.get("chaos"),

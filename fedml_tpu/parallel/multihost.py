@@ -1090,6 +1090,28 @@ def fold_block_partials(parts: dict[int, np.ndarray],
     return total
 
 
+def fold_sparse_partials(pairs: dict[int, tuple], n_blocks: int,
+                         dim: int) -> np.ndarray:
+    """Sparse twin of ``fold_block_partials`` (ISSUE 19): scatter-add
+    each block's (idx, vals) pairs into the flat f32 carry IN GLOBAL
+    BLOCK ORDER, never densifying a per-block vector.  Per element the
+    additions arrive in exactly the block order the dense left-fold
+    uses, so replica agreement holds for the same reason: every host
+    folds identical wire bytes with identical ops."""
+    missing = [b for b in range(n_blocks) if b not in pairs]
+    if missing:
+        raise DeadRankError(
+            f"two-level fold: block partial(s) {missing} missing from "
+            f"the allgather (owning rank dead mid-round?)")
+    total = np.zeros(int(dim), dtype=np.float32)
+    for b in range(n_blocks):
+        idx, vals = pairs[b]
+        # top-k indices are unique within a block, so fancy-index +=
+        # is a well-defined scatter-add
+        total[idx] += np.asarray(vals, dtype=np.float32)
+    return total
+
+
 # ---------------------------------------------------------------------------
 # elastic membership (ISSUE 14) — epoch-numbered views, heartbeats,
 # deterministic block re-adoption, rejoin
@@ -2362,9 +2384,33 @@ class MultihostRunner:
                     f"({bpp} blocks x {enb} B {self.codec.name} "
                     f"carry) — config skew or a truncated frame")
             for j in range(bpp):
-                all_parts[r * bpp + j] = self.codec.decode(
-                    doc[j * enb:(j + 1) * enb])
-        return fold_block_partials(all_parts, self.n_blocks)
+                all_parts[r * bpp + j] = doc[j * enb:(j + 1) * enb]
+        return self._decode_fold(all_parts)
+
+    def _decode_fold(self, bufs: dict) -> np.ndarray:
+        """Decode per-block wire payloads and fold: dense codecs decode
+        then left-fold; sparse codecs scatter-add (idx, vals) pairs in
+        the SAME global block order (ISSUE 19) without densifying a
+        per-block vector.  The f32 path is untouched — the bitwise
+        anchors ride fold_block_partials exactly as before."""
+        if getattr(self.codec, "sparse", False):
+            if hasattr(self.codec, "integrate"):
+                # stateful sparse (topk_ef): every rank advances every
+                # block's reconstruction mirror on the same wire bytes
+                # — the delta frames integrate into dense per-block
+                # reconstructions, then the dense left-fold keeps the
+                # block-order contract
+                return fold_block_partials(
+                    {int(b): self.codec.integrate(int(b), bytes(v))
+                     for b, v in bufs.items()}, self.n_blocks)
+            pairs, dim = {}, 0
+            for b, v in bufs.items():
+                dim, idx, vals = self.codec.decode_pairs(bytes(v))
+                pairs[int(b)] = (idx, vals)
+            return fold_sparse_partials(pairs, self.n_blocks, dim)
+        return fold_block_partials(
+            {int(b): self.codec.decode(bytes(v))
+             for b, v in bufs.items()}, self.n_blocks)
 
     def carry_state(self) -> dict:
         """The codec's residual state (error-feedback accumulators):
@@ -2716,7 +2762,14 @@ class ElasticRunner(MultihostRunner):
         Cluster-internal trust boundary: this rides the same
         coordinator sockets as every carry frame."""
         tree = jax.tree.map(np.asarray, (variables, server_state))
-        return pickle.dumps({"round": int(resume_round), "state": tree},
+        # stateful-codec state rides the snapshot (ISSUE 19): topk_ef's
+        # reconstruction mirror is replicated decode state — a rejoiner
+        # folding future rounds from a zero mirror would disagree with
+        # every survivor.  (int8_ef residuals are encoder-local; the
+        # rejoiner's retain_blocks() drops the coordinator's copies, so
+        # shipping them preserves the restart-at-zero convention.)
+        return pickle.dumps({"round": int(resume_round), "state": tree,
+                             "carry": self.carry_state()},
                             protocol=4)
 
     # -- the elastic loop ----------------------------------------------------
@@ -2755,6 +2808,10 @@ class ElasticRunner(MultihostRunner):
             variables, server_state = payload["state"]
             variables = eng._prepare_variables(variables)
             server_state = eng._prepare_server_state(server_state)
+            # install the coordinator's codec state BEFORE the first
+            # fold: a stateful sparse codec's reconstruction mirror
+            # must match the survivors' bit-for-bit (ISSUE 19)
+            self.load_carry_state(payload.get("carry"))
             start_round = int(payload["round"])
         else:
             if variables is None:
@@ -2822,10 +2879,7 @@ class ElasticRunner(MultihostRunner):
                         self.overlap_waits.append(wait)
                         self.exchange_walls.append(wait)
                     self._finish_round_bytes()
-                    total = fold_block_partials(
-                        {b: self.codec.decode(bytes(v))
-                         for b, v in all_parts.items()},
-                        self.n_blocks)
+                    total = self._decode_fold(all_parts)
                     variables, server_state, m = eng._twolevel_commit(
                         variables, server_state,
                         jax.numpy.asarray(total), agg_rng)

@@ -120,6 +120,7 @@ class ClusterServeManager(AsyncServerManager):
                  buffer_k: int = 16, port: int = 54300,
                  n_connections: int = 256, ingest_pool: int = 2,
                  backlog_cap: Optional[int] = None,
+                 sparse_uplink: bool = False,
                  reactor_config=None):
         import os as _os
         from fedml_tpu.comm.reactor import ReactorConfig
@@ -144,7 +145,8 @@ class ClusterServeManager(AsyncServerManager):
         super().__init__(
             template, 1 << 62, buffer_k, 0, n_connections + 1, "TCP",
             staleness_mode="constant", mix=1.0, streaming=True,
-            ingest_pool=ingest_pool, decode_into=True, redispatch=False,
+            ingest_pool=ingest_pool, decode_into=True,
+            sparse_uplink=sparse_uplink, redispatch=False,
             ip_config={0: "127.0.0.1"}, base_port=port,
             force_python_tcp=True, reactor=True,
             reactor_config=reactor_config)
@@ -213,7 +215,7 @@ class ClusterServeManager(AsyncServerManager):
 
     # -- THE insert path (decode pool + FSM route both land here) ------------
     def _ingest_row(self, sender: int, row: np.ndarray, weight: float,
-                    dispatched: int) -> None:
+                    dispatched: int, *, sparse=None) -> None:
         t0 = time.perf_counter()
         self._lock.acquire()
         self._m_lock_wait.inc(time.perf_counter() - t0)
@@ -247,11 +249,15 @@ class ClusterServeManager(AsyncServerManager):
                 # row is a borrowed scratch buffer (recycled by the
                 # decode pool once we return) — parking needs a copy;
                 # the direct fold below does not, AsyncBuffer.add
-                # blocks until the fold consumed it
-                lane.backlog.append((row.copy(), float(weight),
+                # blocks until the fold consumed it.  Sparse pairs are
+                # fresh arrays (decode_sparse concatenates), so they
+                # park as-is under the same 4-tuple shape.
+                lane.backlog.append((sparse if sparse is not None
+                                     else row.copy(), float(weight),
                                      staleness, int(sender)))
             else:
-                self._admit_locked(lane, row, weight, staleness, sender)
+                self._admit_locked(lane, sparse if sparse is not None
+                                   else row, weight, staleness, sender)
             if lane.full():
                 self._window_cv.notify_all()
         finally:
@@ -260,7 +266,12 @@ class ClusterServeManager(AsyncServerManager):
     def _admit_locked(self, lane: ClusterLane, row, weight: float,
                       staleness: float, sender: int) -> None:
         with obs.span("ingest.fold", sender=sender):
-            lane.buffer.add(row, weight, staleness)
+            if isinstance(row, tuple):
+                # (idx, vals) pairs from a sparse_topk frame (ISSUE
+                # 19): the jitted scatter fold, never a dense row
+                lane.buffer.add_sparse(row[0], row[1], weight, staleness)
+            else:
+                lane.buffer.add(row, weight, staleness)
         lane.admitted += 1
         self.staleness_seen.append(staleness)
         self._m_staleness.observe(staleness)
@@ -316,16 +327,22 @@ class ClusterServeManager(AsyncServerManager):
 # ---------------------------------------------------------------------------
 
 def make_uplink_frame(row: np.ndarray, *, sender: int = 1,
-                      weight: float = 1.0, version: int = 0) -> bytes:
+                      weight: float = 1.0, version: int = 0,
+                      transport: Optional[str] = None) -> bytes:
     """One pre-encoded C2S result frame carrying a flat f32 row under
     the cluster template {"w": row}.  weight rides NUM_SAMPLES; the
     cluster runs constant staleness weights, so the version echo is
-    weight-neutral."""
+    weight-neutral.  `transport` opts the row into a lossy v2 wire
+    dtype ("bf16" | "int8" | "sparse_topk" — ISSUE 19); None keeps the
+    exact v1 frame."""
     msg = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, sender, 0)
     msg.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS,
                    {"w": np.asarray(row, np.float32)})
     msg.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, float(weight))
     msg.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, int(version))
+    if transport is not None:
+        msg.set_wire_transport(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                               transport)
     propagate.stamp(msg, sender)
     return MessageCodec.encode(msg)
 
@@ -365,6 +382,7 @@ def run_cluster_serve(population: int, *, commits: int,
                       window_deadline_s: float = 20.0,
                       timeout_s: float = 600.0,
                       backlog_cap: Optional[int] = None,
+                      sparse_uplink: bool = False,
                       reactor_config=None, chaos: Optional[dict] = None,
                       chaos_seed: int = 0,
                       crash_at_commit: Optional[int] = None,
@@ -406,7 +424,7 @@ def run_cluster_serve(population: int, *, commits: int,
         row_dim, population=population, cluster_rank=rank, world=world,
         buffer_k=buffer_k, port=port, n_connections=n_connections,
         ingest_pool=ingest_pool, backlog_cap=backlog_cap,
-        reactor_config=reactor_config)
+        sparse_uplink=sparse_uplink, reactor_config=reactor_config)
     if chaos:
         mgr.com_manager.install_chaos(
             ChaosPolicy(ChaosConfig(seed=chaos_seed, **chaos)))
@@ -588,6 +606,7 @@ def run_cluster_serve(population: int, *, commits: int,
         "rss_bytes": rss_bytes(),
         "wall_s": float(wall),
         "chaos_injected": bool(chaos),
+        "sparse_uplink": bool(sparse_uplink),
     }
     if elastic:
         report["elastic"] = {

@@ -436,6 +436,45 @@ def test_streaming_commit_matches_drained_commit_bitwise(mode, n_real):
     np.testing.assert_array_equal(np.asarray(fwsum), np.asarray(dwsum))
 
 
+def test_async_buffer_add_sparse_matches_dense_add_bitwise():
+    """ISSUE 19: add_sparse folds the k (index, value) pairs through
+    the jitted sparse twin — the accumulator and wsum stay BITWISE the
+    dense add() of the densified rows (the sparse fold scatters into
+    an in-program zero row and reuses the dense fold's exact
+    multiply-add expression), and the guards route misuse to
+    RuntimeError instead of a silent wrong fold."""
+    from fedml_tpu.async_.staleness import AsyncBuffer
+
+    K, P, k = 5, 64, 4
+    rs = np.random.RandomState(2)
+    dense = AsyncBuffer(K, P, streaming=True,
+                        staleness_mode="polynomial", staleness_a=0.5)
+    sparse = AsyncBuffer(K, P, streaming=True,
+                         staleness_mode="polynomial", staleness_a=0.5)
+    for i in range(K):
+        idx = np.sort(rs.choice(P, k, replace=False)).astype(np.int64)
+        vals = rs.randn(k).astype(np.float32)
+        row = np.zeros(P, np.float32)
+        row[idx] = vals
+        full_d = dense.add(row, 1.0 + i, float(i))
+        full_s = sparse.add_sparse(idx, vals, 1.0 + i, float(i))
+        assert full_d == full_s
+    da, dw = dense.take_stream()[:2]
+    sa, sw = sparse.take_stream()[:2]
+    np.testing.assert_array_equal(np.asarray(da), np.asarray(sa))
+    np.testing.assert_array_equal(np.asarray(dw), np.asarray(sw))
+    # guards: drain mode and bucketed buffers have no sparse fold
+    import pytest as _pytest
+    drain = AsyncBuffer(2, P)
+    with _pytest.raises(RuntimeError, match="drain-mode"):
+        drain.add_sparse(np.zeros(1, np.int64),
+                         np.zeros(1, np.float32), 1.0, 0.0)
+    bucketed = AsyncBuffer(4, P, streaming=True, buckets=2)
+    with _pytest.raises(RuntimeError, match="bucket"):
+        bucketed.add_sparse(np.zeros(1, np.int64),
+                            np.zeros(1, np.float32), 1.0, 0.0)
+
+
 def test_async_buffer_thread_safe_adds_and_snapshots():
     """ISSUE-6 satellite: AsyncBuffer is internally thread-safe — 8
     threads racing adds against state() snapshots never tear a

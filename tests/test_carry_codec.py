@@ -1,4 +1,4 @@
-"""Carry wire codec pins (ISSUE 16 — parallel/carry_codec.py).
+"""Carry wire codec pins (ISSUE 16 + 19 — parallel/carry_codec.py).
 
 The compressed inter-host tier's correctness contract, pinned in
 process:
@@ -14,7 +14,13 @@ process:
 * error feedback makes the SUM over rounds converge (single-round
   error bound, not O(rounds)), and its residual accumulator
   round-trips through orbax as FedCheckpointManager extra_state so
-  crash-resume continues the same error trajectory.
+  crash-resume continues the same error trajectory;
+* topk (ISSUE 19) ships k = max(1, dim // ratio) exact-f32 (index,
+  value) pairs at a payload size that is a pure function of dim, is
+  bitwise-lossless on <= k-sparse vectors, and topk_ef bounds the
+  summed-carry drift to the FINAL round's selection threshold (the
+  residual can never hold a coordinate larger than the smallest
+  shipped magnitude of the round that left it behind).
 """
 import numpy as np
 import pytest
@@ -22,6 +28,8 @@ import pytest
 from fedml_tpu.parallel.carry_codec import (CARRY_CODECS, CarryCodec,
                                             Int8CarryCodec,
                                             Int8EFCarryCodec,
+                                            TopKCarryCodec,
+                                            TopKEFCarryCodec,
                                             make_carry_codec)
 
 
@@ -171,3 +179,167 @@ def test_make_carry_codec_registry():
         make_carry_codec("zstd")
     with pytest.raises(ValueError, match="positive"):
         Int8CarryCodec(chunk=0)
+    with pytest.raises(ValueError, match="positive"):
+        TopKCarryCodec(topk_ratio=0)
+    assert make_carry_codec("topk", topk_ratio=8).topk_ratio == 8
+
+
+# -- ISSUE 19: top-k sparse carry codecs ------------------------------------
+
+@pytest.mark.parametrize("dim", [1, 15, 16, 100, 256])
+def test_topk_uniform_size_and_selection(dim):
+    """Payload size is a pure function of dim (the ElasticChannel
+    uniform-item contract), the kept pairs are the k largest-|value|
+    entries shipped as EXACT f32, and decode_pairs round-trips what
+    decode densifies."""
+    c = TopKCarryCodec(topk_ratio=16)
+    v = _vec(dim, seed=dim)
+    buf = c.encode(0, v)
+    k = c.k_for(dim)
+    assert k == max(1, dim // 16)
+    assert len(buf) == c.encoded_nbytes(dim) == 8 + 8 * k
+    assert len(c.encode(1, _vec(dim, seed=dim + 1))) == len(buf)
+    d, idx, vals = c.decode_pairs(buf)
+    assert d == dim and idx.size == vals.size == k
+    # the selected set IS the top-k by magnitude, values exact f32
+    want = set(np.argsort(np.abs(v))[-k:])
+    assert set(int(i) for i in idx) == want
+    np.testing.assert_array_equal(vals, v[idx])
+    dense = c.decode(buf)
+    ref = np.zeros(dim, np.float32)
+    ref[idx] = vals
+    assert dense.tobytes() == ref.tobytes()
+
+
+def test_topk_sparse_input_roundtrips_bitwise():
+    """Shipped values are exact f32 (no quantization), so any vector
+    with <= k nonzeros round-trips BITWISE — the premise of the
+    cluster bench's digests_equal replay pin."""
+    c = TopKCarryCodec(topk_ratio=16)
+    dim = 256
+    v = np.zeros(dim, np.float32)
+    keep = np.random.default_rng(3).choice(dim, c.k_for(dim),
+                                           replace=False)
+    v[keep] = _vec(keep.size, seed=4)
+    out = c.decode(c.encode(0, v))
+    assert out.tobytes() == v.tobytes()
+    # and the wire is ~7.5x smaller than f32 — past the ISSUE-19 6x gate
+    assert 4 * dim / c.encoded_nbytes(dim) > 6.0
+
+
+def test_topk_nonfinite_and_mixed_codec_errors():
+    c = TopKCarryCodec()
+    bad = _vec(32)
+    bad[7] = np.inf
+    with pytest.raises(ValueError, match="carry_codec"):
+        c.encode(0, bad)
+    with pytest.raises(ValueError, match="mixed-codec"):
+        c.decode_pairs(c.encode(0, _vec(32)) + b"x")
+
+
+def _snapshot_stream(dim, rounds, seed=0, drift=0.05):
+    """A slowly-evolving snapshot stream (the carry's real shape: each
+    round's vector is a weighted model SUM, consecutive rounds differ
+    by learning-rate-sized deltas, not independent draws)."""
+    rng = np.random.default_rng(seed)
+    v = (3.0 * rng.standard_normal(dim)).astype(np.float32)
+    out = []
+    for _ in range(rounds):
+        v = (v + drift * rng.standard_normal(dim)).astype(np.float32)
+        out.append(v.copy())
+    return out
+
+
+def test_topk_ef_reconstruction_bounded_by_round_threshold():
+    """The ISSUE-19 EF pin: after integrating round r's frame, the
+    reconstruction mirror tracks the true snapshot within a SINGLE
+    round's truncation threshold per coordinate — every unsent
+    coordinate's |vec - rec| lost the top-k selection, so it is at
+    most the smallest magnitude that shipped.  Plain topk's snapshot
+    scatter drops 15/16 of the vector every round and never recovers.
+    (Warm-up excluded: the mirror starts at zero and needs ~ratio
+    rounds to first touch every coordinate.)"""
+    rounds, dim = 48, 256
+    plain, ef = TopKCarryCodec(), TopKEFCarryCodec()
+    stream = _snapshot_stream(dim, rounds)
+    plain_err = ef_err = tau = None
+    for r, v in enumerate(stream):
+        plain_err = np.abs(
+            plain.decode(plain.encode(0, v)).astype(np.float64)
+            - v.astype(np.float64)).max()
+        buf = ef.encode(0, v)
+        _, _, vals = ef.decode_pairs(buf)
+        tau = float(np.abs(vals).min())   # this round's threshold
+        rec = ef.integrate(0, buf)
+        ef_err = np.abs(rec.astype(np.float64)
+                        - v.astype(np.float64)).max()
+        if r >= 2 * ef.topk_ratio:        # past warm-up
+            assert ef_err <= tau + 1e-5, (
+                f"round {r}: reconstruction error {ef_err:.4g} exceeds "
+                f"the round's selection threshold {tau:.4g}")
+    assert ef_err < plain_err / 10, (
+        f"delta-EF must beat plain topk's snapshot loss by an order "
+        f"of magnitude: ef={ef_err:.4g} plain={plain_err:.4g}")
+
+
+def test_topk_ef_encoder_decoder_mirror_agreement():
+    """The replication contract: encode() never mutates state; the
+    mirror advances only in integrate(), so a second rank integrating
+    the same wire bytes holds a byte-identical mirror and a mid-round
+    ownership change (new owner encodes the next frame) continues the
+    same delta trajectory."""
+    a, b = TopKEFCarryCodec(), TopKEFCarryCodec()
+    stream = _snapshot_stream(96, 6, seed=3)
+    for v in stream[:4]:
+        buf = a.encode(0, v)
+        assert buf == a.encode(0, v), "encode() must be state-free"
+        ra, rb = a.integrate(0, buf), b.integrate(0, buf)
+        np.testing.assert_array_equal(ra.view(np.uint32),
+                                      rb.view(np.uint32))
+    # ownership moves to b: its mirror was built purely from the wire,
+    # yet the frame it encodes equals what a would have sent
+    assert b.encode(0, stream[4]) == a.encode(0, stream[4])
+    # retain_blocks keeps EVERY block's mirror (decode state is
+    # replicated, not owner-local like int8_ef's residual)
+    a.retain_blocks([])
+    assert sorted(a.state_dict()["residual"]) == ["0"]
+    # a re-partitioned block (size change) restarts the mirror clean
+    # instead of scattering against a stale-dim reconstruction
+    v32 = _vec(32, seed=11)
+    assert a.encode(0, v32) == TopKEFCarryCodec().encode(0, v32)
+    ef2 = TopKEFCarryCodec()
+    ef2.integrate(0, ef2.encode(0, v32))
+    assert ef2.state_dict()["residual"]["0"].size == 32
+
+
+def test_topk_ef_checkpoint_roundtrip_orbax(tmp_path):
+    """Crash-resume continues the SAME reconstruction trajectory — the
+    mirror rides extra_state like int8_ef's residual, and a restored
+    codec encodes and integrates bit-identically to the uninterrupted
+    one."""
+    from fedml_tpu.utils.checkpoint import FedCheckpointManager
+
+    ef = TopKEFCarryCodec()
+    streams = {b: _snapshot_stream(128, 6, seed=b) for b in (0, 1)}
+    for r in range(3):
+        for b in (0, 1):
+            ef.integrate(b, ef.encode(b, streams[b][r]))
+    ck = FedCheckpointManager(str(tmp_path / "topk_ck"))
+    variables = {"w": np.zeros(2, np.float32)}
+    ck.save(3, variables, (), extra_state=ef.state_dict())
+    step, _, _, extra = ck.restore(variables, (),
+                                   extra_template=ef.state_dict())
+    ck.close()
+    assert step == 3
+    resumed = TopKEFCarryCodec()
+    resumed.load_state_dict(extra)
+    for r in range(3, 6):
+        for b in (0, 1):
+            v = streams[b][r]
+            buf = resumed.encode(b, v)
+            assert buf == ef.encode(b, v), (
+                f"round {r} block {b}: resumed topk_ef codec diverged "
+                f"from the uninterrupted reconstruction trajectory")
+            np.testing.assert_array_equal(
+                resumed.integrate(b, buf).view(np.uint32),
+                ef.integrate(b, buf).view(np.uint32))

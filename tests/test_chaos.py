@@ -316,6 +316,144 @@ def test_dup_storm_accumulator_bitwise_equals_clean():
         np.testing.assert_array_equal(a, b)
 
 
+# -- ISSUE 19: sparse uplink ingest + version-skew quarantine ----------------
+
+def _sparse_tree(template, seed):
+    """A params tree where every leaf has <= k = size // 16 nonzeros —
+    sparse_topk ships exact f32 pairs, so these trees survive the
+    sparse wire BITWISE (the parity pin's premise)."""
+    import jax
+    rs = np.random.RandomState(seed)
+
+    def leaf(a):
+        flat = np.zeros(a.size, np.float32)
+        k = max(1, a.size // 16)
+        sel = rs.choice(a.size, k, replace=False)
+        flat[sel] = rs.randn(k).astype(np.float32)
+        return flat.reshape(a.shape)
+    return jax.tree.map(leaf, template)
+
+
+def test_sparse_uplink_commit_bitwise_equals_dense():
+    """The ISSUE-19 ingest parity pin: a sparse_uplink server folding
+    sparse_topk frames through decode_sparse + the jitted scatter fold
+    commits BITWISE the same variables as a dense server folding the
+    same (<= k-sparse) rows through decode_into + the dense fold —
+    scatter-adding the k pairs is the same float program as adding a
+    dense row whose other entries are +0.0."""
+    import jax
+    from fedml_tpu.async_.lifecycle import AsyncMessage, AsyncServerManager
+    from fedml_tpu.async_.torture import make_template
+
+    template = make_template(512)
+    K = 4
+    trees = [_sparse_tree(template, seed=r) for r in range(1, K + 1)]
+
+    def run(sparse: bool):
+        server = AsyncServerManager(
+            template, 1, K, 0, K + 1, "INPROC",
+            staleness_mode="constant", mix=1.0, streaming=True,
+            redispatch=False, ingest_pool=1, sparse_uplink=sparse,
+            router=InProcRouter())
+        server.run_async()
+        try:
+            for r, tree in enumerate(trees, start=1):
+                m = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, r, 0)
+                m.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, tree)
+                m.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES,
+                             float(r))
+                m.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, 0)
+                if sparse:
+                    m.set_wire_transport(
+                        AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                        "sparse_topk")
+                server.com_manager._deliver_frame(
+                    MessageCodec.encode(m), reply=lambda w: None)
+            assert server.done.wait(timeout=30), "commit never fired"
+            return jax.tree.map(np.asarray, server.variables)
+        finally:
+            server.finish()
+
+    dense_vars = run(sparse=False)
+    sparse_vars = run(sparse=True)
+    import jax
+    for a, b in zip(jax.tree.leaves(dense_vars),
+                    jax.tree.leaves(sparse_vars)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sparse_uplink_requires_streaming_no_defense():
+    """Ctor validation: sparse uplinks ride the streaming sparse fold
+    and the admission screen needs dense rows — both misconfigs raise
+    up front instead of dying per-frame in the pool."""
+    from fedml_tpu.async_.lifecycle import AsyncServerManager
+    from fedml_tpu.async_.torture import make_template
+
+    with pytest.raises(ValueError, match="sparse_uplink"):
+        AsyncServerManager(make_template(64), 1, 4, 0, 2, "INPROC",
+                           streaming=False, sparse_uplink=True,
+                           router=InProcRouter())
+    from fedml_tpu.async_.defense import DefenseConfig
+    with pytest.raises(ValueError, match="sparse"):
+        AsyncServerManager(make_template(64), 1, 4, 0, 2, "INPROC",
+                           streaming=True, sparse_uplink=True,
+                           defense=DefenseConfig(),
+                           router=InProcRouter())
+
+
+def test_alien_transport_frame_quarantined_pool_survives():
+    """The ISSUE-19 rejection satellite end-to-end: a frame carrying a
+    wire-transport kind this server doesn't decode (a NEWER sender —
+    version skew) lands in comm_frames_quarantined_total via the
+    decode pool and the pool worker SURVIVES — the same K dense frames
+    afterward still commit.  Pre-pin, the alien frame would raise
+    through decode_into's shape check as a confusing template
+    mismatch, or kill the worker."""
+    import jax
+    from fedml_tpu.async_.lifecycle import AsyncMessage, AsyncServerManager
+    from fedml_tpu.async_.torture import make_template
+
+    template = make_template(512)
+    K = 2
+    server = AsyncServerManager(
+        template, 1, K, 0, K + 1, "INPROC",
+        staleness_mode="constant", mix=1.0, streaming=True,
+        redispatch=False, ingest_pool=1, router=InProcRouter())
+    server.run_async()
+    try:
+        tree = _sparse_tree(template, seed=3)
+        m = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, 1, 0)
+        m.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, tree)
+        m.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+        m.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, 0)
+        m.set_wire_transport(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                             "sparse_topk")
+        alien = MessageCodec.encode(m).replace(b"sparse_topk",
+                                               b"sparse_topX")
+        quar0 = obs.counter("comm_frames_quarantined_total").value
+        server.com_manager._deliver_frame(alien, reply=lambda w: None)
+        deadline = time.monotonic() + 10
+        while (obs.counter("comm_frames_quarantined_total").value
+               == quar0 and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert obs.counter(
+            "comm_frames_quarantined_total").value == quar0 + 1
+        assert server.buffer.count == 0       # nothing folded
+        # the pool worker is alive: dense traffic still commits
+        for r in range(1, K + 1):
+            md = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, r, 0)
+            md.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, tree)
+            md.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+            md.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, 0)
+            server.com_manager._deliver_frame(
+                MessageCodec.encode(md), reply=lambda w: None)
+        assert server.done.wait(timeout=30), (
+            "decode pool died on the alien frame — dense frames after "
+            "the quarantine never committed")
+    finally:
+        server.finish()
+
+
 # -- quorum-degraded commits under partition ---------------------------------
 
 def test_quorum_gates_deadline_commit():

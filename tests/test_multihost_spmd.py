@@ -437,6 +437,37 @@ def test_fold_block_partials_is_ordered_left_fold():
         fold_block_partials({0: parts[0], 1: parts[1], 3: parts[3]}, 4)
 
 
+def test_fold_sparse_partials_matches_dense_fold_bitwise():
+    """ISSUE 19: the sparse scatter-fold over (index, value) pairs is
+    BITWISE the dense left fold over the densified blocks — adding the
+    pairs in global block order is the same float program as adding
+    dense vectors whose non-selected entries are +0.0 (x + 0.0 == x
+    bitwise for every x the fold can produce).  So the sparse tier
+    changes wire bytes, never replica agreement, and a missing block
+    still names itself."""
+    from fedml_tpu.parallel.carry_codec import TopKCarryCodec
+    from fedml_tpu.parallel.multihost import (DeadRankError,
+                                              fold_block_partials,
+                                              fold_sparse_partials)
+    c = TopKCarryCodec(topk_ratio=16)
+    rs = np.random.RandomState(1)
+    dim, n_blocks = 96, 4
+    bufs = {b: c.encode(b, rs.randn(dim).astype(np.float32))
+            for b in range(n_blocks)}
+    pairs = {}
+    dense = {}
+    for b, buf in bufs.items():
+        _, idx, vals = c.decode_pairs(buf)
+        pairs[b] = (idx, vals)
+        dense[b] = c.decode(buf)
+    got = fold_sparse_partials(pairs, n_blocks, dim)
+    want = fold_block_partials(dense, n_blocks)
+    assert got.tobytes() == want.tobytes()
+    with pytest.raises(DeadRankError, match=r"\[1\]"):
+        fold_sparse_partials({0: pairs[0], 2: pairs[2], 3: pairs[3]},
+                             n_blocks, dim)
+
+
 def test_hierarchical_host_mesh_virtual_silo_warns(caplog):
     """ISSUE-13 satellite: single-process make_hierarchical_host_mesh
     with silos>1 builds VIRTUAL silo rows sharing this host — still the
@@ -808,6 +839,41 @@ def test_elastic_rejoin_snapshot_and_stale_digest_rejected():
     epochs = [e["epoch"] for e in out["events"]]
     assert epochs == sorted(epochs) and len(epochs) >= 2
     assert any("rejoined" in e for e in out["events"])
+
+
+def test_rejoin_snapshot_carries_topk_ef_mirror():
+    """ISSUE 19 elastic seam: the rejoin catch-up snapshot ships the
+    codec's carry state, and the install path rebuilds a codec whose
+    reconstruction mirror is byte-identical to the coordinator's — a
+    rejoiner folding future topk_ef rounds from a zero mirror would
+    disagree with every survivor."""
+    import pickle
+    from fedml_tpu.parallel.multihost import ElasticRunner
+    from fedml_tpu.parallel.carry_codec import TopKEFCarryCodec
+
+    coord = object.__new__(ElasticRunner)
+    coord.codec = TopKEFCarryCodec()
+    rng = np.random.default_rng(7)
+    vec = (3.0 * rng.standard_normal(96)).astype(np.float32)
+    for r in range(5):
+        vec = (vec + 0.05 * rng.standard_normal(96)).astype(np.float32)
+        for b in (0, 1):
+            coord.codec.integrate(b, coord.codec.encode(b, vec))
+    blob = ElasticRunner._snapshot_blob(
+        coord, 5, {"w": np.zeros(2, np.float32)}, ())
+    payload = pickle.loads(blob)
+    assert "carry" in payload, (
+        "the rejoin snapshot must carry the stateful codec's mirror")
+    rejoiner = object.__new__(ElasticRunner)
+    rejoiner.codec = TopKEFCarryCodec()
+    rejoiner.load_carry_state(payload["carry"])
+    nxt = (vec + 0.05 * rng.standard_normal(96)).astype(np.float32)
+    for b in (0, 1):
+        buf = coord.codec.encode(b, nxt)
+        assert rejoiner.codec.encode(b, nxt) == buf
+        np.testing.assert_array_equal(
+            rejoiner.codec.integrate(b, buf).view(np.uint32),
+            coord.codec.integrate(b, buf).view(np.uint32))
 
 
 def test_dial_backoff_late_listener_and_named_failure():

@@ -326,6 +326,118 @@ def test_codec_decode_into_hardening():
                                  np.zeros((layout.p,), np.float32), layout)
 
 
+# -- ISSUE 19: sparse_topk uplink transport ---------------------------------
+
+def test_codec_sparse_topk_transport_shrinks_and_selects():
+    """sparse_topk ships k = size // 16 exact-f32 (index, value) pairs
+    per leaf: the frame shrinks ~4x at dim >> envelope, decode
+    densifies to EXACTLY the top-k entries (values bitwise — no
+    quantization), and a <= k-sparse row round-trips bitwise (the
+    cluster bench's digests_equal replay pin)."""
+    rs = np.random.RandomState(0)
+    w = rs.randn(4096).astype(np.float32)
+    msg = Message(1, 0, 1)
+    msg.add_params("model_params", {"w": w})
+    msg.set_wire_transport("model_params", "sparse_topk")
+    frame = MessageCodec.encode(msg)
+    assert frame[:4] == b"FML2"
+    k = 4096 // 16
+    assert len(frame) < 8 * k + 2048      # pairs + envelope slack
+    got = MessageCodec.decode(frame).get("model_params")["w"]
+    assert got.dtype == np.float32 and got.shape == w.shape
+    keep = np.argsort(np.abs(w))[-k:]
+    ref = np.zeros_like(w)
+    ref[keep] = w[keep]
+    np.testing.assert_array_equal(got, ref)
+    # <= k-sparse input: bitwise exact through the sparse wire
+    sp = np.zeros(4096, np.float32)
+    sp[keep] = w[keep]
+    msg2 = Message(1, 0, 1)
+    msg2.add_params("model_params", {"w": sp})
+    msg2.set_wire_transport("model_params", "sparse_topk")
+    out = MessageCodec.decode(MessageCodec.encode(msg2)).get(
+        "model_params")["w"]
+    assert out.tobytes() == sp.tobytes()
+
+
+def test_codec_sparse_decode_into_scatter_matches_decode():
+    """decode_into on a sparse frame scatters the (index, value) pairs
+    into the preallocated flat row — BITWISE what
+    flatten_vars_row(decode(payload)) densifies, zeros included."""
+    from fedml_tpu.async_.staleness import RowLayout, flatten_vars_row
+
+    tree = _layout_tree(11)
+    layout = RowLayout(tree, "model_params")
+    payload = MessageCodec.encode(_result_msg(
+        tree, wire_transport={"model_params": "sparse_topk"}))
+    row = np.full((layout.p,), np.nan, np.float32)
+    out = MessageCodec.decode_into(payload, row, layout)
+    ref = flatten_vars_row(
+        MessageCodec.decode(payload).get("model_params"))
+    np.testing.assert_array_equal(row, ref)
+    assert out.get("model_params") is None
+    assert out.get("num_samples") == 17.0
+
+
+def test_codec_decode_sparse_pairs_reconstruct_row():
+    """decode_sparse returns the concatenated (global index, value)
+    pairs across every layout leaf — scattered into a zero row they
+    reproduce the densified decode bitwise, and the envelope params
+    still decode (the layout key comes back None)."""
+    from fedml_tpu.async_.staleness import RowLayout, flatten_vars_row
+
+    tree = _layout_tree(12)
+    layout = RowLayout(tree, "model_params")
+    payload = MessageCodec.encode(_result_msg(
+        tree, wire_transport={"model_params": "sparse_topk"}))
+    msg, idx, vals = MessageCodec.decode_sparse(payload, layout)
+    assert idx.dtype == np.int64 and vals.dtype == np.float32
+    assert idx.size == vals.size
+    got = np.zeros((layout.p,), np.float32)
+    got[idx] = vals
+    ref = flatten_vars_row(
+        MessageCodec.decode(payload).get("model_params"))
+    np.testing.assert_array_equal(got, ref)
+    assert msg.get("model_params") is None
+    assert msg.get("num_samples") == 17.0
+    assert msg.get_sender_id() == 3
+    # a dense frame is NOT silently densified — named ValueError so the
+    # ingest path falls back to decode_into
+    dense = MessageCodec.encode(_result_msg(tree))
+    with pytest.raises(ValueError, match="mixed frame|not sparse"):
+        MessageCodec.decode_sparse(dense, layout)
+
+
+def test_codec_unknown_transport_names_version_skew():
+    """The ISSUE-19 rejection satellite at the codec layer: a frame
+    carrying an enc kind this peer doesn't know raises a ValueError
+    NAMING the alien kind, the transports this build decodes, and the
+    version-skew remedy — on decode, decode_into, and decode_sparse
+    alike (the ingest pool turns this into a quarantine, never a
+    worker death)."""
+    from fedml_tpu.async_.staleness import RowLayout
+
+    tree = _layout_tree(13)
+    layout = RowLayout(tree, "model_params")
+    payload = MessageCodec.encode(_result_msg(
+        tree, wire_transport={"model_params": "sparse_topk"}))
+    alien = payload.replace(b"sparse_topk", b"sparse_topX")
+    for call in (
+            lambda: MessageCodec.decode(alien),
+            lambda: MessageCodec.decode_into(
+                alien, np.zeros((layout.p,), np.float32), layout)):
+        with pytest.raises(ValueError) as ei:
+            call()
+        s = str(ei.value)
+        assert "sparse_topX" in s and "version skew" in s, s
+        assert "sparse_topk" in s     # the known-transports list
+    # the sender-side opt-in refuses unknown transports up front
+    m = Message(1, 0, 1)
+    m.add_params("w", np.zeros(4, np.float32))
+    with pytest.raises(ValueError, match="transport"):
+        m.set_wire_transport("w", "zstd")
+
+
 # -- ISSUE 7: obs-off frames stay byte-identical to the untraced build -------
 
 def _frame_variants(seed=0):

@@ -1250,21 +1250,28 @@ def exp_CLUSTER():
     replaying the diurnal/flash arrival processes over real sockets,
     lane partials folding cross-host through ElasticChannel at every
     commit barrier.  FEDML_CLUSTER_HOSTS overrides the 1,2,4 sweep;
-    FEDML_CLUSTER_RATE the per-host offered rate.  Gates ride
-    bench_diff v16: chaos-everything survivor goodput >= 0.5x clean,
-    zero recv-thread deaths, bitwise_after_death_ok + ranks_agree
-    boolean pins.  On chips the fold/commit dispatch runs against the
-    chip-attached runtime, so admission p95 prices real decode->device
-    handoff instead of a CPU-contended loopback box."""
+    FEDML_CLUSTER_RATE the per-host offered rate;
+    FEDML_CLUSTER_ARMS widens the arm set (e.g.
+    `FEDML_CLUSTER_ARMS=clean,sparse` adds the ISSUE-19 sparse-uplink
+    A/B).  Gates ride bench_diff v16+: chaos-everything survivor
+    goodput >= 0.5x clean, zero recv-thread deaths,
+    bitwise_after_death_ok + ranks_agree boolean pins; the sparse arm
+    adds the v17 >= 0.9x committed-updates/sec gate.  On chips the
+    fold/commit dispatch runs against the chip-attached runtime, so
+    admission p95 prices real decode->device handoff instead of a
+    CPU-contended loopback box."""
     import subprocess
     hosts = os.environ.get("FEDML_CLUSTER_HOSTS", "1,2,4")
     rate = os.environ.get("FEDML_CLUSTER_RATE", "2000")
     bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "..", "bench.py")
+    cmd = [sys.executable, bench, "--mode", "cluster",
+           "--cluster_hosts", hosts, "--cluster_rate", rate]
+    arms = os.environ.get("FEDML_CLUSTER_ARMS")
+    if arms:
+        cmd += ["--cluster_arms", arms]
     r = subprocess.run(
-        [sys.executable, bench, "--mode", "cluster",
-         "--cluster_hosts", hosts, "--cluster_rate", rate],
-        text=True, capture_output=True, timeout=3600)
+        cmd, text=True, capture_output=True, timeout=3600)
     sys.stderr.write(r.stderr)
     print(r.stdout, flush=True)
     if r.returncode != 0:
