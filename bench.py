@@ -208,7 +208,27 @@ ESTIMATED_REFERENCE_ROUNDS_PER_SEC = 2.0
 #     <= k-nonzero rows exactly, so dense and sparse ingest commit
 #     identical bits); v16 readers that ignore unknown keys keep
 #     working
-SCHEMA_VERSION = 17
+# v18: + "secure" block (`python bench.py --mode secure`, ISSUE 20 —
+#     fedml_tpu/secure/secagg.py, the pairwise-mask data plane): the
+#     privacy-tax table on the live async messaging FSM (MNIST-LR,
+#     full-cohort barrier) — plain vs masked committed-updates/sec
+#     (privacy_tax_ratio), plain/secure/dp accuracy (the end-to-end
+#     private mode's quality cost), the masks_cancel_bitwise_ok
+#     protocol pin (full-cohort masked field sum == plain fixed-point
+#     sum, exact integers), measured encoded-frame uplink bytes
+#     (plain f32 pytree frame vs masked u32 words at the same model —
+#     uplink_bytes_ratio; masked words are incompressible by design,
+#     so codec-v2 compression buys nothing), below_threshold_commits
+#     (MUST be 0 on the
+#     clean arms — masks only fail to cancel when survivors dip under
+#     the reconstruction threshold), and the two masked-byzantine
+#     arms: in-field boost (fits the quantizer range -> sails through,
+#     because the admission screen reads plaintext rows and is BLINDED
+#     under masks) vs overflow boost (the client-side quantizer range
+#     refusal — the ONE norm-bound enforcement masking cannot blind —
+#     drops the uplink and dropout recovery carries the round); v17
+#     readers that ignore unknown keys keep working
+SCHEMA_VERSION = 18
 
 
 # the programs block's window opens when main() configures obs (set
@@ -359,7 +379,7 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=("sync", "async", "ingest", "chaos", "attack",
                              "serve", "connections", "multihost",
-                             "cluster"),
+                             "cluster", "secure"),
                     default="sync",
                     help="sync: the north-star resident-cohort rounds/sec "
                          "bench; async: the buffered staleness-aware "
@@ -404,7 +424,13 @@ def main() -> None:
                          "committed-updates/sec + p95 admission vs "
                          "(hosts x connections) at 1/2/4 hosts, plus "
                          "the chaos-everything arm (storm + wire "
-                         "faults + rank kill at once)")
+                         "faults + rank kill at once); secure: the "
+                         "pairwise-mask privacy-tax bench (ISSUE 20, "
+                         "fedml_tpu/secure/) — plain vs masked "
+                         "committed-updates/sec on the live async FSM, "
+                         "plain/secure/dp accuracy, the masks-cancel "
+                         "bitwise pin, and the masked-byzantine pair "
+                         "(blinded screen vs quantizer range refusal)")
     ap.add_argument("--ingest_clients", type=int, default=32,
                     help="ingest mode: concurrent uplink clients")
     ap.add_argument("--ingest_backend", default="TCP",
@@ -546,6 +572,16 @@ def main() -> None:
                     help="cluster mode: one seed drives the swarm "
                          "schedule, the arrival profile, and the chaos "
                          "injector")
+    ap.add_argument("--secure_commits", type=int, default=12,
+                    help="secure mode: commits per clean arm (the "
+                         "byzantine arms run half — the overflow arm "
+                         "pays a real deadline wait per commit)")
+    ap.add_argument("--secure_cohort", type=int, default=8,
+                    help="secure mode: round cohort (= buffer_k; masks "
+                         "cancel over the FULL cohort)")
+    ap.add_argument("--secure_seed", type=int, default=0,
+                    help="secure mode: one seed drives the keyring, "
+                         "the DP noise, and the byzantine set")
     ap.add_argument("--cluster_arms", default="clean",
                     help="cluster mode extra arms: add 'sparse' for "
                          "the paired dense-vs-sparse_topk uplink arm "
@@ -578,6 +614,7 @@ def main() -> None:
             "connections": None,
             "multihost": None,
             "cluster": None,
+            "secure": None,
             "critical_path": None,
             "slo": None,
             "programs": None,
@@ -628,6 +665,9 @@ def main() -> None:
         return
     if args.mode == "cluster":
         _bench_cluster(args)
+        return
+    if args.mode == "secure":
+        _bench_secure(args)
         return
     import jax.numpy as jnp
 
@@ -1274,6 +1314,226 @@ def _bench_attack(args) -> None:
     print(json.dumps(doc))
 
 
+# secure-mode shape (ISSUE 20): the clean arms share one workload
+# (async MNIST-LR, full-cohort barrier, INPROC, no lifecycle latency)
+# so the plain/secure pair isolates the DATA PLANE — quantize + mask +
+# field fold + unmask vs flatten + f32 fold.  Byzantine arms run the
+# same workload with a boost adversary at two magnitudes: one inside
+# the quantizer range (blinded-screen demonstration) and one past it
+# (the range refusal that survives masking).
+SECURE_BYZ_FRAC = 0.25
+SECURE_BYZ_BOOST_INFIELD = 50.0
+SECURE_BYZ_BOOST_OVERFLOW = 1e9
+SECURE_OVERFLOW_DEADLINE_S = 0.5
+
+
+def _bench_secure(args) -> None:
+    """Privacy-tax bench for the pairwise-mask data plane (ISSUE 20,
+    fedml_tpu/secure/): plain vs masked committed-updates/sec on the
+    live async messaging FSM plus the end-to-end private mode's
+    accuracy cost, the masks-cancel bitwise protocol pin, and the
+    masked-byzantine pair.  Gates (tools/bench_diff.py v18): the tax
+    ratio stays above the floor, zero below-threshold commits on the
+    clean arms, and the bitwise pin holds."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu import obs
+    from fedml_tpu.async_ import AttackConfig
+    from fedml_tpu.async_.lifecycle import run_async_messaging
+    from fedml_tpu.core import mpc
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.loaders import load_data
+    from fedml_tpu.models import create_model
+    from fedml_tpu.secure import SecAggConfig, SecureAggregator
+    from fedml_tpu.utils.config import FedConfig
+
+    cohort = args.secure_cohort
+    data = load_data("mnist", client_num_in_total=cohort, batch_size=10,
+                     synthetic_scale=0.2, seed=0)
+
+    def arm(tag, commits, secure=None, attack=None, deadline=None):
+        cfg = FedConfig(client_num_in_total=cohort,
+                        client_num_per_round=cohort, comm_round=commits,
+                        epochs=1, batch_size=10, lr=0.03,
+                        frequency_of_the_test=10_000)
+        trainer = ClientTrainer(create_model("lr", output_dim=10),
+                                lr=cfg.lr)
+        slo_eng = _slo_window()
+        t0 = time.perf_counter()
+        variables, server = run_async_messaging(
+            trainer, data, cfg, buffer_k=cohort, worker_num=cohort,
+            total_commits=commits, secure=secure, attack=attack,
+            deadline_s=deadline)
+        wall = time.perf_counter() - t0
+        sums = jax.jit(trainer.evaluate)(
+            variables, jax.tree.map(jnp.asarray, data.test_global))
+        cnt = max(float(sums["count"]), 1.0)
+        row = {"arm": tag,
+               "commits": server.version,
+               "updates_per_sec": round(server.updates_committed / wall,
+                                        4),
+               "test_acc": round(float(sums["correct"]) / cnt, 4),
+               "slo_arm": _slo_close(slo_eng)}
+        if secure is not None:
+            rep = server._secure.report()
+            row.update(
+                below_threshold_commits=server.secure_below_threshold,
+                recovered_rounds=rep["recovered_rounds"],
+                rejected_uplinks=int(
+                    obs.counter("secagg_rejected_uplinks_total").value))
+        print(f"{tag}: {row['updates_per_sec']:.1f} updates/s  "
+              f"acc {row['test_acc']:.3f}", file=sys.stderr)
+        return row
+
+    def _sec_cfg(**kw):
+        return SecAggConfig(seed=args.secure_seed, **kw)
+
+    commits = args.secure_commits
+    plain = arm("plain", commits)
+    sec = arm("secure", commits, secure=_sec_cfg())
+    dp = arm("secure_dp", commits,
+             secure=_sec_cfg(dp_clip=3.0, dp_noise=1e-3))
+    byz_kw = dict(frac=SECURE_BYZ_FRAC, seed=args.secure_seed)
+    rej0 = int(obs.counter("secagg_rejected_uplinks_total").value)
+    infield = arm(
+        "byz_infield", max(commits // 2, 2),
+        secure=_sec_cfg(),
+        attack=AttackConfig(mode="boost",
+                            boost=SECURE_BYZ_BOOST_INFIELD, **byz_kw))
+    overflow = arm(
+        "byz_overflow", max(commits // 2, 2),
+        secure=_sec_cfg(),
+        attack=AttackConfig(mode="boost",
+                            boost=SECURE_BYZ_BOOST_OVERFLOW, **byz_kw),
+        deadline=SECURE_OVERFLOW_DEADLINE_S)
+    # the counter is process-global: attribute the deltas per arm
+    overflow["rejected_uplinks"] -= infield["rejected_uplinks"]
+    infield["rejected_uplinks"] -= rej0
+
+    tax = (sec["updates_per_sec"] / plain["updates_per_sec"]
+           if plain["updates_per_sec"] > 0 else None)
+    print(f"privacy tax: plain {plain['updates_per_sec']:.1f} -> "
+          f"masked {sec['updates_per_sec']:.1f} updates/s "
+          f"(ratio {f'{tax:.2f}' if tax is not None else 'n/a'})",
+          file=sys.stderr)
+
+    # masks-cancel protocol pin, pure integers outside the FSM: a
+    # full-cohort masked field sum must equal the plain fixed-point
+    # sum BITWISE — masks cancel exactly or not at all
+    pin_cfg = _sec_cfg()
+    pin_dim, pin_ids = 64, list(range(1, 6))
+    pin = SecureAggregator(pin_cfg, pin_ids, pin_dim)
+    rs = np.random.RandomState(args.secure_seed + 5)
+    p = pin_cfg.prime
+    expected = np.zeros(pin_dim + 1, np.int64)
+    for c in pin_ids:
+        pin.escrow(c)
+        flat = rs.randn(pin_dim) * 0.1
+        w = float(rs.randint(1, 50))
+        q = np.empty(pin_dim + 1, np.int64)
+        q[:pin_dim] = mpc.quantize(flat * w, pin_cfg.scale, p)
+        q[pin_dim] = mpc.quantize(np.array([w]), pin_cfg.scale, p)[0]
+        expected = (expected + q) % p
+        pin.fold(c, pin.client_row(c, 0, flat, w))
+    words, _included = pin.field_sum(0, pin.arrived)
+    masks_cancel = bool(np.array_equal(np.asarray(words) % p, expected))
+    print(f"masks cancel bitwise: {masks_cancel}", file=sys.stderr)
+
+    # uplink bytes, measured on REAL encoded frames (the INPROC runs
+    # above never serialize): one plain-path uplink (f32 pytree +
+    # plaintext sample count) vs one masked uplink (u32 field words,
+    # dim+1 — the weight rides as the masked trailing word) through
+    # MessageCodec.encode, framed exactly as lifecycle.py ships them
+    from fedml_tpu.async_.staleness import flat_dim
+    from fedml_tpu.comm.message import Message, MessageCodec
+    bytes_vars = ClientTrainer(create_model("lr", output_dim=10),
+                               lr=0.03).init(
+        jax.random.PRNGKey(0), jnp.asarray(data.client_shards["x"][0, 0]))
+    dim = flat_dim(bytes_vars)
+    m_plain = Message(4, 1, 0)
+    m_plain.add_params("model_params",
+                       jax.tree.map(np.asarray, bytes_vars))
+    m_plain.add_params("num_samples", 50.0)
+    m_plain.add_params("version", 0)
+    plain_bytes = len(MessageCodec.encode(m_plain))
+    m_sec = Message(4, 1, 0)
+    m_sec.add_params("model_params",
+                     rs.randint(0, p, dim + 1).astype(np.uint32))
+    m_sec.add_params("num_samples", 1.0)
+    m_sec.add_params("secagg", {"round": 0})
+    m_sec.add_params("version", 0)
+    m_sec.set_wire_transport("model_params", "secagg",
+                             scale=pin_cfg.scale, p=p)
+    sec_bytes = len(MessageCodec.encode(m_sec))
+    print(f"uplink frame: plain {plain_bytes} B -> masked {sec_bytes} B "
+          f"(dim {dim}; masked words are incompressible by design)",
+          file=sys.stderr)
+
+    doc = _stamp({
+        "metric": "secure_agg_mnist_lr_privacy_tax_ratio",
+        "value": round(tax, 4) if tax is not None else None,
+        "unit": "ratio",
+        "vs_baseline": None,
+        "mode": "secure",
+        "overlap_fraction": None,
+        "h2d_bytes_per_round": None,
+        "rounds": [],
+        "async": None,
+        "ingest": None,
+        "chaos": None,
+        "attack": None,
+        "serve": None,
+        "connections": None,
+        "multihost": None,
+        "cluster": None,
+        "secure": {
+            "workload": f"async_mnist_lr (INPROC, cohort {cohort}, "
+                        "full-cohort barrier, no lifecycle latency)",
+            "cohort": cohort,
+            "threshold": pin_cfg.resolve_threshold(cohort),
+            "scale": pin_cfg.scale,
+            "seed": args.secure_seed,
+            "privacy_tax_ratio": (round(tax, 4)
+                                  if tax is not None else None),
+            "plain_updates_per_sec": plain["updates_per_sec"],
+            "secure_updates_per_sec": sec["updates_per_sec"],
+            "plain_uplink_bytes": plain_bytes,
+            "secure_uplink_bytes": sec_bytes,
+            "uplink_bytes_ratio": round(sec_bytes / plain_bytes, 4),
+            "flat_dim": dim,
+            "plain_acc": plain["test_acc"],
+            "secure_acc": sec["test_acc"],
+            "dp_acc": dp["test_acc"],
+            "acc_delta_secure_vs_plain": round(
+                sec["test_acc"] - plain["test_acc"], 4),
+            "masks_cancel_bitwise_ok": masks_cancel,
+            "below_threshold_commits_clean": (
+                sec["below_threshold_commits"]
+                + dp["below_threshold_commits"]),
+            "byzantine": {
+                "frac": SECURE_BYZ_FRAC,
+                # admission screening reads plaintext rows and is
+                # BLINDED under masks: the in-field boost commits
+                # unimpeded (its damage shows in test_acc); the only
+                # surviving enforcement is the client-side quantizer
+                # range refusal, which the overflow boost trips
+                "infield": infield,
+                "overflow": overflow,
+            },
+            "arms": [plain, sec, dp, infield, overflow],
+        },
+        "critical_path": _critical_path_doc(),
+        "slo": _slo_doc({r["arm"]: r.pop("slo_arm")
+                         for r in (plain, sec, dp, infield, overflow)}),
+        "programs": _programs_doc(),
+    })
+    if obs.enabled():
+        obs.export()
+        doc["obs"] = obs.rollup()
+    print(json.dumps(doc))
+
+
 # serve-mode shape (ISSUE 10): one virtual-time serve-loop arm per
 # simulated population, same buffer/arrival/sampler config across arms,
 # so the table isolates POPULATION — the north star's heavy-traffic
@@ -1510,6 +1770,7 @@ def _bench_connections(args) -> None:
             "storm_goodput_ratio": head["storm_goodput_ratio"],
         },
         "cluster": None,
+        "secure": None,
         "critical_path": _critical_path_doc(),
         "slo": _slo_doc(slo_arms),
         "programs": _programs_doc(),
@@ -2038,6 +2299,7 @@ def _bench_multihost(args) -> None:
             "seed": args.mh_seed,
         },
         "cluster": None,
+        "secure": None,
         "critical_path": _critical_path_doc(),
         "slo": _slo_doc({"sweep": _slo_close(slo_eng)}),
         "programs": _programs_doc(),
@@ -2382,6 +2644,7 @@ def _bench_cluster(args) -> None:
         "serve": None,
         "connections": None,
         "multihost": None,
+        "secure": None,
         "cluster": {
             "rows": rows,
             "chaos_everything": chaos_arm,

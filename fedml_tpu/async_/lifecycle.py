@@ -50,6 +50,8 @@ from fedml_tpu.async_.staleness import (AsyncBuffer, RowLayout, flat_dim,
                                         unflatten_rows)
 from fedml_tpu.scale.registry import BANNED as _REG_BANNED
 from fedml_tpu.scale.registry import ClientRegistry
+from fedml_tpu.secure.secagg import (SecAggBelowThreshold, SecAggConfig,
+                                     SecureAggregator)
 
 log = logging.getLogger(__name__)
 Pytree = Any
@@ -147,6 +149,11 @@ class AsyncMessage:
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_VERSION = "model_version"
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    # ISSUE 20: marker param of a masked secagg uplink ({"round": v}) —
+    # explicit so a NON-secure server quarantines masked words by name
+    # instead of folding uint32 garbage, and a secure server rejects
+    # plain uplinks symmetrically
+    MSG_ARG_KEY_SECAGG = "secagg"
 
 
 class AsyncServerManager(ServerManager):
@@ -216,11 +223,45 @@ class AsyncServerManager(ServerManager):
                  reliable: bool = False, min_quorum: int = 1,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1, resume: bool = False,
-                 defense: Optional[DefenseConfig] = None, **kw):
+                 defense: Optional[DefenseConfig] = None,
+                 secure=None, **kw):
         super().__init__(rank, size, backend, **kw)
         import jax
         if reliable:
             self.com_manager.enable_reliability()
+        if secure is not None:
+            # ISSUE 20: a secure round is a cohort barrier — pairwise
+            # masks cancel only within ONE round's full pair set, so
+            # the free-running staleness machinery cannot apply
+            if not streaming:
+                raise ValueError(
+                    "secure aggregation rides the jitted field fold "
+                    "(secagg needs streaming=True) — the drain path "
+                    "holds plaintext rows, the exact thing masking "
+                    "removes")
+            if defense is not None:
+                raise ValueError(
+                    "the admission screen reads PLAINTEXT rows and is "
+                    "blinded by pairwise masks — --secure_agg composes "
+                    "with defense=None only; the private mode's DP "
+                    "rides the CLIENT side (SecAggConfig.dp_clip/"
+                    "dp_noise), and only the quantizer's norm-bound "
+                    "enforcement survives masking")
+            if sparse_uplink:
+                raise ValueError(
+                    "sparse_topk drops coordinates per client, so "
+                    "pairwise masks could never cancel — secagg and "
+                    "sparse_uplink are mutually exclusive")
+            if staleness_mode != "constant":
+                raise ValueError(
+                    f"secagg forces staleness_mode='constant': a masked "
+                    f"uplink is only foldable at the round it was "
+                    f"dispatched for (got {staleness_mode!r})")
+            if buffer_k != size - 1:
+                raise ValueError(
+                    f"secagg commits on the FULL cohort (or its deadline "
+                    f"survivor set): buffer_k must equal the cohort size "
+                    f"{size - 1}, got {buffer_k}")
         if defense is not None and not streaming:
             raise ValueError(
                 "the admission pipeline rides the streaming fold "
@@ -235,6 +276,21 @@ class AsyncServerManager(ServerManager):
                 "(defended configs densify via decode_into instead)")
         self.sparse_uplink = bool(sparse_uplink)
         self.defense = defense
+        # ISSUE 20: the secure-aggregation seam — a shared
+        # SecureAggregator instance (INPROC: the clients hold the same
+        # object) or a SecAggConfig this server expands itself
+        # (multi-process: every rank rebuilds the keyring from the
+        # seed).  Secure round state is NOT checkpointed: masks are
+        # round-keyed, so a restarted server re-dispatches at the
+        # restored version and stragglers from the dead round
+        # quarantine on the version mismatch.
+        self._secure: Optional[SecureAggregator] = None
+        if isinstance(secure, SecAggConfig):
+            self._secure = SecureAggregator(
+                secure, range(1, size), flat_dim(init_variables))
+        elif secure is not None:
+            self._secure = secure
+        self.secure_below_threshold = 0       # named round failures
         self.variables = jax.tree.map(np.asarray, init_variables)
         self.total_commits = total_commits
         self.buffer_k = buffer_k
@@ -469,6 +525,11 @@ class AsyncServerManager(ServerManager):
         msg.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, self.variables)
         msg.add_params(AsyncMessage.MSG_ARG_KEY_CLIENT_INDEX, rank - 1)
         msg.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, self.version)
+        if self._secure is not None:
+            # escrow the client's key shares AT DISPATCH (ISSUE 20): if
+            # this client dies mid-round, the surviving threshold set
+            # already holds what the unmask barrier needs
+            self._secure.escrow(rank)
         if self.registry.contains(rank):
             self.registry.note_dispatch_one(rank, self.version)
         self.send_message(msg)
@@ -480,8 +541,35 @@ class AsyncServerManager(ServerManager):
 
     def _handle_result(self, msg: Message) -> None:
         """FSM route (ingest_pool=0): the backend decoded the frame
-        inline; flatten and fold/insert."""
+        inline; flatten and fold/insert.  Secure mode routes masked
+        uplinks to the field fold; the marker param keeps the two
+        worlds from silently folding each other's rows."""
         t0 = time.perf_counter()
+        marker = msg.get(AsyncMessage.MSG_ARG_KEY_SECAGG)
+        if self._secure is not None:
+            if marker is None:
+                self.com_manager._m_quarantined.inc()
+                log.warning(
+                    "secure server: PLAIN uplink from rank %d quarantined "
+                    "(client not running --secure_agg? config skew)",
+                    msg.get_sender_id())
+                return
+            words = np.ascontiguousarray(
+                msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS), np.uint32)
+            self._ingest_secure(
+                msg.get_sender_id(), words,
+                int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+            self._m_admission.observe(time.perf_counter() - t0)
+            return
+        if marker is not None:
+            # masked words reached a plain server: uint32 garbage to
+            # every fold — quarantine BY NAME, never ingest
+            self.com_manager._m_quarantined.inc()
+            log.warning(
+                "plain server: MASKED secagg uplink from rank %d "
+                "quarantined (server missing --secure_agg? config skew)",
+                msg.get_sender_id())
+            return
         row = flatten_vars_row(msg.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS))
         self._ingest_row(
             msg.get_sender_id(), row,
@@ -515,6 +603,51 @@ class AsyncServerManager(ServerManager):
         row = self._scratch.get()
         try:
             t0 = time.perf_counter()
+            if self._secure is not None:
+                # ISSUE 20: masked uplinks decode through the secagg
+                # twin (raw u32 words, no dequantization possible);
+                # anything else is control traffic or a plain uplink —
+                # the latter quarantines by name, never folds
+                with obs.span("ingest.decode", nbytes=len(payload),
+                              into=False):
+                    try:
+                        msg, words, _enc = MessageCodec.decode_secagg(
+                            payload,
+                            AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                            self._secure.words)
+                    except ValueError:
+                        msg = None
+                    if msg is None:
+                        try:
+                            full = MessageCodec.decode(payload,
+                                                       copy="never")
+                        except Exception as e:
+                            self.com_manager._m_quarantined.inc()
+                            log.warning(
+                                "ingest pool: undecodable frame (%d "
+                                "bytes) quarantined: %s", len(payload), e)
+                            return
+                        if (full.get_type()
+                                != AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT):
+                            self.com_manager._note_frame(full)
+                            self.com_manager._on_message(full)
+                            return
+                        self.com_manager._m_quarantined.inc()
+                        log.warning(
+                            "secure server: PLAIN uplink from rank %d "
+                            "quarantined (client not running "
+                            "--secure_agg? config skew)",
+                            full.get_sender_id())
+                        return
+                self._m_decode.observe(time.perf_counter() - t0)
+                self.com_manager._note_frame(msg)
+                self._ingest_secure(
+                    msg.get_sender_id(), words,
+                    int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+                if t_arrive is not None:
+                    self._m_admission.observe(
+                        time.perf_counter() - t_arrive)
+                return
             msg = None
             pairs = None
             with obs.span("ingest.decode", nbytes=len(payload),
@@ -557,6 +690,17 @@ class AsyncServerManager(ServerManager):
                         # control traffic: hand to the FSM dispatch loop
                         self.com_manager._note_frame(full)
                         self.com_manager._on_message(full)
+                        return
+                    if full.get(AsyncMessage.MSG_ARG_KEY_SECAGG) is not None:
+                        # masked words on a plain server: quarantine BY
+                        # NAME (ISSUE 20) — folding u32 residues as f32
+                        # would silently poison the accumulator
+                        self.com_manager._m_quarantined.inc()
+                        log.warning(
+                            "plain server: MASKED secagg uplink from "
+                            "rank %d quarantined (server missing "
+                            "--secure_agg? config skew)",
+                            full.get_sender_id())
                         return
                     np.copyto(row, flatten_vars_row(
                         full.get(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS)))
@@ -660,6 +804,54 @@ class AsyncServerManager(ServerManager):
         if last:
             self.stop_all()
 
+    def _ingest_secure(self, sender: int, words: np.ndarray,
+                       dispatched: int) -> None:
+        """Secure twin of _ingest_row (ISSUE 20): the round-version
+        check, the jitted mask-and-fold, and the cohort-full commit
+        trigger.  The VERSION is the secure round index AND the mask
+        PRG counter — an uplink masked for any other round can never
+        cancel against this one's pair set, so a version mismatch
+        quarantines by name and redispatches at the current round
+        instead of folding unerasable mask noise."""
+        t0 = time.perf_counter()
+        self._lock.acquire()
+        self._m_lock_wait.inc(time.perf_counter() - t0)
+        last = False
+        try:
+            if self.done.is_set():
+                return                      # late straggler after shutdown
+            known = self.registry.contains(sender)
+            if dispatched != self.version:
+                self.com_manager._m_quarantined.inc()
+                log.warning(
+                    "secure round %d: stale masked uplink from rank %d "
+                    "(masked for round %d) quarantined — masks are "
+                    "round-keyed and cannot cancel across rounds",
+                    self.version, sender, dispatched)
+                if known:
+                    self.registry.note_return(sender)
+                if self.redispatch:
+                    self._redispatch_locked([sender])
+                return
+            with obs.span("ingest.fold", sender=sender, secure=True):
+                n = self._secure.fold(sender, words)
+            self.staleness_seen.append(0.0)
+            self._m_staleness.observe(0.0)
+            self._m_occupancy.set(n)
+            if known:
+                self.registry.note_return(sender)
+                self.registry.note_contribution(sender, 0.0, self.version)
+            if n < self.buffer_k:
+                # cohort barrier: contributors WAIT for the round to
+                # close (no mid-round redispatch — a re-dispatch at the
+                # same version would just replace this row)
+                return
+            last = self._commit_locked(deadline_fired=False)
+        finally:
+            self._lock.release()
+        if last:
+            self.stop_all()
+
     def _arm_watchdog(self, armed_version: int) -> None:
         """Deadline heartbeat: armed at start and re-armed after every
         commit (and after an empty-buffer retry sweep), so progress
@@ -676,7 +868,9 @@ class AsyncServerManager(ServerManager):
             self._watchdog = None
             if self.done.is_set() or self.version != armed_version:
                 return                      # committed normally meanwhile
-            if self.buffer.count < self.min_quorum:
+            arrived = (self._secure.count if self._secure is not None
+                       else self.buffer.count)
+            if arrived < self.min_quorum:
                 # not enough arrived a whole deadline long (empty, or
                 # below the partition quorum): presume the outstanding
                 # dispatches crashed/partitioned, retry them all (the
@@ -706,9 +900,42 @@ class AsyncServerManager(ServerManager):
             self._watchdog = None
         with obs.span("async.commit", version=self.version,
                       streaming=self.streaming,
-                      n_results=self.buffer.count,
+                      n_results=(self._secure.count
+                                 if self._secure is not None
+                                 else self.buffer.count),
                       deadline=deadline_fired):
-            if self.streaming and self.defense is not None:
+            if self._secure is not None:
+                # ISSUE 20: the unmask barrier.  Survivors = who
+                # answered THIS round; a deadline commit with absent
+                # cohort members reconstructs their masks from the
+                # escrowed shares (the elastic dropout recovery), and a
+                # below-threshold set fails the round BY NAME — the
+                # arrived rows are kept, the missing ranks are
+                # redispatched at the SAME round, and the next deadline
+                # (or late arrivals) retries the barrier.
+                survivors = self._secure.arrived
+                try:
+                    acc_np, wsum, included = self._secure.commit(
+                        self.version, survivors)
+                except SecAggBelowThreshold as e:
+                    self.secure_below_threshold += 1
+                    log.warning(
+                        "secure round %d did not commit: %s",
+                        self.version, e)
+                    if self.redispatch:
+                        self._redispatch_locked(
+                            [int(r) for r
+                             in self.registry.outstanding_ids()])
+                    if self.deadline_s is not None:
+                        self._arm_watchdog(self.version)
+                    return False
+                n_real = len(included)
+                self._m_occupancy.set(0)
+                new_vars, _stats = self._commit(
+                    jax.tree.map(jnp.asarray, self.variables),
+                    jnp.asarray(acc_np), jnp.float32(wsum),
+                    jnp.float32(self.mix))
+            elif self.streaming and self.defense is not None:
                 # bucketed robust streaming commit (ISSUE 9): O(B·P)
                 accs, wsums, _w, _s, n_real, _raw = \
                     self.buffer.take_stream_buckets()
@@ -847,10 +1074,17 @@ class AsyncClientManager(ClientManager):
                  backend: str = "INPROC",
                  lifecycle: Optional[ClientLifecycle] = None,
                  reliable: bool = False,
-                 adversary: Optional[AdversarySim] = None, **kw):
+                 adversary: Optional[AdversarySim] = None,
+                 secure: Optional[SecureAggregator] = None, **kw):
         super().__init__(rank, size, backend, **kw)
         import jax
         self.adversary = adversary
+        # ISSUE 20: this client's view of the secure data plane —
+        # client_row only reads the (deterministic, seed-derived)
+        # keyring, so INPROC ranks can share the server's instance and
+        # multi-process ranks rebuild an identical one from the config
+        self._secure = secure
+        self.secagg_rejected = 0       # uplinks the quantizer refused
         if reliable:
             # enveloped uplinks: a server restart mid-upload is carried
             # by the endpoint's backoff resend instead of an exception
@@ -915,10 +1149,39 @@ class AsyncClientManager(ClientManager):
                 client_idx, upload, variables,
                 int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
         out = Message(AsyncMessage.MSG_TYPE_C2S_ASYNC_RESULT, self.rank, 0)
-        out.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, upload)
-        out.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
-        out.add_params(AsyncMessage.MSG_ARG_KEY_VERSION,
-                       int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION)))
+        ver = int(msg.get(AsyncMessage.MSG_ARG_KEY_VERSION))
+        if self._secure is not None:
+            # ISSUE 20: quantize + pairwise-mask the weighted flat row
+            # (DP clip+noise first when the private mode is on).  The
+            # sample weight rides as the row's masked trailing word, so
+            # NUM_SAMPLES ships a constant 1.0 — per-client sample
+            # counts never cross the wire in the clear.  A row the
+            # quantizer refuses (fixed-point field overflow — the one
+            # screen masking cannot blind) is DROPPED, not sent: the
+            # server's deadline path treats this client as dead.
+            try:
+                masked = self._secure.client_row(
+                    self.rank, ver,
+                    np.asarray(flatten_vars_row(upload), np.float64),
+                    float(n))
+            except ValueError as e:
+                self.secagg_rejected += 1
+                obs.counter("secagg_rejected_uplinks_total").inc()
+                log.warning(
+                    "secagg client %d: uplink for round %d refused at "
+                    "quantization (norm-bound enforcement): %s",
+                    self.rank, ver, e)
+                return
+            out.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, masked)
+            out.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+            out.add_params(AsyncMessage.MSG_ARG_KEY_SECAGG, {"round": ver})
+            out.set_wire_transport(
+                AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, "secagg",
+                scale=self._secure.cfg.scale, p=self._secure.cfg.prime)
+        else:
+            out.add_params(AsyncMessage.MSG_ARG_KEY_MODEL_PARAMS, upload)
+            out.add_params(AsyncMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        out.add_params(AsyncMessage.MSG_ARG_KEY_VERSION, ver)
         if self.done.is_set() or self._closed:
             return      # STOP landed during the latency sleep / train
         if obs.enabled():
@@ -957,6 +1220,7 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
                         chaos=None, min_quorum: int = 1,
                         attack: Optional[AttackConfig] = None,
                         defense: Optional[DefenseConfig] = None,
+                        secure: Optional[SecAggConfig] = None,
                         timeout_s: float = 600.0, **backend_kw):
     """Launch the async server + one lifecycle-simulated client per rank
     (threads for INPROC; for TCP/GRPC run one rank per process and call
@@ -998,19 +1262,26 @@ def run_async_messaging(trainer, data, cfg, *, buffer_k: int,
         data = apply_data_attack(data, attack, adversary)
     init_vars = trainer.init(jax.random.PRNGKey(cfg.seed),
                              jnp.asarray(data.client_shards["x"][0, 0]))
+    secagg = None
+    if secure is not None:
+        # one shared SecureAggregator: the server folds/unmasks, the
+        # clients only read the keyring (deterministic from the seed,
+        # so multi-process ranks could rebuild it identically)
+        secagg = SecureAggregator(secure, range(1, size),
+                                  flat_dim(init_vars))
     server = AsyncServerManager(
         init_vars, total_commits, buffer_k, 0, size, backend,
         staleness_mode=staleness_mode, staleness_a=staleness_a,
         staleness_b=staleness_b, mix=mix, deadline_s=deadline_s,
         streaming=streaming, ingest_pool=ingest_pool,
         decode_into=decode_into, reliable=reliable,
-        min_quorum=min_quorum, defense=defense, **kw)
+        min_quorum=min_quorum, defense=defense, secure=secagg, **kw)
     if chaos is not None:
         server.com_manager.install_chaos(chaos)
     clients = [AsyncClientManager(trainer, data, cfg.epochs, r, size,
                                   backend, lifecycle=lifecycle,
                                   reliable=reliable, adversary=adversary,
-                                  **kw)
+                                  secure=secagg, **kw)
                for r in range(1, size)]
     threads = [c.run_async() for c in clients] + [server.run_async()]
     server.send_start()

@@ -285,6 +285,34 @@ def make_sparse_fold_fn(mode: str = "constant", a: float = 0.5,
         "async_sparse_fold", jax.jit(fold, donate_argnums=(0, 1)))
 
 
+def make_field_fold_fn(prime: int):
+    """Jitted INTEGER-FIELD twin of the arrival fold (ISSUE 20) — the
+    secure-aggregation data plane's mask-and-fold:
+
+        fold(acc [W] u32, row [W] u32) -> (acc + row) mod prime
+
+    Rides the same flat-row shape as make_fold_fn (a secagg row is the
+    flatten_vars_row layout, fixed-point quantized, plus one trailing
+    masked weight word), so the server's aggregation-on-arrival
+    structure and O(P) commit survive masking unchanged — the field sum
+    collapses to an (acc, wsum) pair at the unmask barrier and feeds
+    make_stream_commit_fn as-is.
+
+    Arithmetic safety: both operands are field residues < prime
+    ≤ 2^31−1, so the u32 sum peaks below 2^32−1 — no wraparound before
+    the mod.  The fold is exact integer math end to end, which is what
+    makes the masked cohort aggregate BITWISE equal to the plain
+    fixed-point sum (the ISSUE-20 anchor pin, tests/test_secagg.py).
+    `acc` is donated: the running field accumulator updates in place."""
+    p = np.uint32(prime)
+
+    def fold(acc, row):
+        return jnp.mod(acc + row, p)
+
+    return obs_programs.instrument(
+        "secagg_fold", jax.jit(fold, donate_argnums=(0,)))
+
+
 def make_drain_fold_fn(mode: str = "constant", a: float = 0.5,
                        b: float = 4.0):
     """ONE compiled drained twin of the arrival fold: lax.scan the same

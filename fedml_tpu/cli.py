@@ -323,6 +323,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "sigma z*S/m added inside the jitted commit")
     p.add_argument("--defense_seed", type=int, default=0,
                    help="bucket-assignment seed")
+    # secure aggregation (ISSUE 20, fedml_tpu/secure/): pairwise-mask
+    # uplinks over the live messaging FSMs — the server only ever sees
+    # masked field words; masks cancel exactly in the cohort sum and
+    # dropout recovery reconstructs a dead client's masks from
+    # escrowed key shares.  PERF.md "Secure aggregation".
+    p.add_argument("--secure_agg", action="store_true",
+                   help="pairwise-mask secure aggregation on the "
+                        "messaging paths (sync FSM, or the live async "
+                        "server with --async); combine with "
+                        "--defense_dp_clip/--defense_dp_noise for the "
+                        "end-to-end private mode (client-side clip+"
+                        "noise BEFORE masking)")
+    p.add_argument("--secure_threshold", type=int, default=0,
+                   help="minimum surviving clients to unmask a round "
+                        "(also the key-share reconstruction threshold); "
+                        "0 = cohort majority")
+    p.add_argument("--secure_scale", type=int, default=2 ** 16,
+                   help="fixed-point quantization scale (field words = "
+                        "round(x*scale) mod p); the usable range is "
+                        "±(p-1)/(2*scale)")
+    p.add_argument("--secure_seed", type=int, default=0,
+                   help="keyring seed: every rank derives the same DH "
+                        "key material + escrowed shares from it "
+                        "(simulation-grade trust model — see "
+                        "fedml_tpu/secure/secagg.py)")
     # TPU-native replacements for mpirun/hostfile/gpu_mapping
     p.add_argument("--streaming", action="store_true",
                    help="host-resident client stack; upload only each "
@@ -697,6 +722,24 @@ def _defense_config(args):
         seed=args.defense_seed)
 
 
+def _secure_config(args):
+    """--secure_agg flags -> SecAggConfig (None when secure mode is off).
+
+    The private mode composes through the DEFENSE DP flags on purpose:
+    --defense_dp_clip/--defense_dp_noise become CLIENT-side clip+noise
+    applied before masking (the server never sees a per-client row, so
+    server-side DP is impossible under masks)."""
+    if not getattr(args, "secure_agg", False):
+        return None
+    from fedml_tpu.secure import SecAggConfig
+    return SecAggConfig(
+        threshold=args.secure_threshold,
+        scale=args.secure_scale,
+        seed=args.secure_seed,
+        dp_clip=args.defense_dp_clip,
+        dp_noise=args.defense_dp_noise)
+
+
 def _arrival_config(args):
     """--arrival_* flags -> ArrivalConfig (None when mode is 'none')."""
     if getattr(args, "arrival_process", "none") == "none":
@@ -1026,6 +1069,81 @@ def build_engine(args, cfg: FedConfig, data):
     raise ValueError(f"unknown algorithm {algo!r}")
 
 
+def _run_secure(args, cfg: FedConfig, logger) -> int:
+    """--secure_agg: run a messaging FSM with the pairwise-mask data
+    plane (fedml_tpu/secure/).  Secure mode only exists on the LIVE
+    engines — the sync fedavg_messaging FSM and the async lifecycle
+    server — because the vmap dispatch-wave engine has no per-client
+    wire to mask.  `--async --secure_agg` keeps the lifecycle simulator
+    (latency/dropout) but forces the cohort barrier: masks only cancel
+    over the full round cohort, so partial buffers are unmasked at the
+    commit barrier via share reconstruction, never committed early."""
+    import jax
+    import jax.numpy as jnp
+
+    log = logging.getLogger(__name__)
+    sec = _secure_config(args)
+    if (args.defense_screen or args.defense_norm_bound is not None
+            or args.defense_buckets > 1 or args.defense_trim_k > 0
+            or args.defense_combine != "trimmed_mean"):
+        log.warning(
+            "--defense_screen/--defense_norm_bound/--defense_buckets/"
+            "--defense_trim_k/--defense_combine are blinded under "
+            "--secure_agg: the server only ever sees masked field words, "
+            "so plaintext admission screening cannot run.  The surviving "
+            "enforcement is the client-side quantizer range refusal "
+            "(PERF.md 'Secure aggregation')")
+
+    data = _load(cfg)
+    trainer = _trainer(cfg, data)
+
+    if getattr(args, "async_mode", False):
+        if args.async_buffer_k is not None:
+            log.warning(
+                "--async_buffer_k is ignored under --secure_agg (the "
+                "masked fold is a cohort barrier: buffer_k == cohort)")
+        from fedml_tpu.async_ import LifecycleConfig
+        from fedml_tpu.async_.lifecycle import run_async_messaging
+        lc = LifecycleConfig(
+            latency=args.async_latency,
+            latency_scale=args.async_latency_scale,
+            latency_sigma=args.async_latency_sigma,
+            pareto_alpha=args.async_pareto_alpha,
+            heterogeneity=args.async_heterogeneity,
+            dropout_prob=args.async_dropout_prob,
+            rejoin_prob=args.async_rejoin_prob,
+            rejoin_delay_s=args.async_rejoin_delay_s,
+            seed=(args.async_seed if args.async_seed is not None
+                  else cfg.seed))
+        variables, server = run_async_messaging(
+            trainer, data, cfg,
+            buffer_k=cfg.client_num_per_round,
+            worker_num=cfg.client_num_per_round,
+            total_commits=cfg.comm_round,
+            deadline_s=args.async_round_deadline_s,
+            mix=args.async_mix,
+            lifecycle_cfg=lc,
+            secure=sec)
+        extra = {"rounds": server.version,
+                 "secure_below_threshold": server.secure_below_threshold,
+                 **{f"secagg_{k}": v
+                    for k, v in server._secure.report().items()}}
+    else:
+        from fedml_tpu.comm.fedavg_messaging import run_messaging_fedavg
+        variables = run_messaging_fedavg(
+            trainer, data, cfg, worker_num=cfg.client_num_per_round,
+            secure=sec)
+        extra = {"rounds": cfg.comm_round}
+
+    eval_fn = jax.jit(trainer.evaluate)
+    sums = eval_fn(jax.tree.map(jnp.asarray, variables),
+                   jax.tree.map(jnp.asarray, data.test_global))
+    cnt = max(float(sums["count"]), 1.0)
+    logger.log({"test_acc": float(sums["correct"]) / cnt,
+                "test_loss": float(sums["loss_sum"]) / cnt, **extra})
+    return 0
+
+
 def _run_deployment(args, cfg: FedConfig, logger) -> int:
     """One deployment rank over real sockets (reference run_fedavg_grpc.sh /
     run_fedavg_trpc.sh: N OS processes, rank 0 = server).  Both roles load
@@ -1082,12 +1200,25 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
 
     from fedml_tpu.utils.context import graceful_abort
 
+    # deployed secure mode: every rank rebuilds the SAME SecureAggregator
+    # from --secure_seed (keyring + escrow are deterministic), so the
+    # masked protocol needs no extra key-exchange round trips on the wire
+    secagg = None
+    sec_cfg = _secure_config(args)
+    if sec_cfg is not None:
+        from fedml_tpu.async_.staleness import flat_dim
+        from fedml_tpu.secure import SecureAggregator
+        iv = trainer.init(jax.random.PRNGKey(cfg.seed),
+                          jnp.asarray(data.client_shards["x"][0, 0]))
+        secagg = SecureAggregator(sec_cfg, range(1, size), flat_dim(iv))
+
     if args.deploy == "server":
         init_vars = trainer.init(
             jax.random.PRNGKey(cfg.seed),
             jnp.asarray(data.client_shards["x"][0, 0]))
         agg = FedAvgAggregator(init_vars, size - 1,
-                               cfg.client_num_in_total, size - 1)
+                               cfg.client_num_in_total, size - 1,
+                               secure=secagg)
         server = FedAvgServerManager(
             agg, cfg.comm_round, 0, size, args.comm_backend,
             model_transport=(None if args.wire_transport == "none"
@@ -1114,7 +1245,8 @@ def _run_deployment(args, cfg: FedConfig, logger) -> int:
     client = FedAvgClientManager(trainer, data, cfg.epochs, args.rank, size,
                                  args.comm_backend,
                                  total_rounds=cfg.comm_round,
-                                 wire_compress=args.wire_compress, **kw)
+                                 wire_compress=args.wire_compress,
+                                 secure=secagg, **kw)
     _harden(client)
     with graceful_abort(client):
         client.run()        # blocks until total_rounds uploads are done
@@ -1309,6 +1441,13 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     if args.deploy:
         rc = _run_deployment(args, cfg, logger)
+        logger.finish()
+        _finish_obs()
+        _notify_sweep(args)
+        return rc
+
+    if args.secure_agg:
+        rc = _run_secure(args, cfg, logger)
         logger.finish()
         _finish_obs()
         _notify_sweep(args)

@@ -29,6 +29,7 @@ from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.core.pytree import tree_weighted_mean
 from fedml_tpu.core.sampling import ClientSampler
+from fedml_tpu.secure.secagg import SecAggBelowThreshold
 
 log = logging.getLogger(__name__)
 Pytree = Any
@@ -46,6 +47,10 @@ class MyMessage:
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_LOCAL_LOSS = "local_loss"
     MSG_ARG_KEY_ROUND = "round_idx"
+    # ISSUE 20: masked-uplink marker — same contract as the async
+    # protocol's key (a secure server rejects plain uploads by name,
+    # a plain server rejects masked ones)
+    MSG_ARG_KEY_SECAGG = "secagg"
 
 
 def _to_numpy(tree: Pytree) -> Pytree:
@@ -55,10 +60,18 @@ def _to_numpy(tree: Pytree) -> Pytree:
 class FedAvgAggregator:
     """Server-side round state (FedAVGAggregator.py:24-108): receive slots,
     all-received barrier, sample-weighted average, deterministic per-round
-    client sampling (np.random.seed(round_idx), :90-98)."""
+    client sampling (np.random.seed(round_idx), :90-98).
+
+    `secure` (ISSUE 20) swaps the plaintext slots for the secure data
+    plane's SecureAggregator: uploads arrive as masked field rows and
+    fold on arrival; aggregate() runs the unmask barrier (with dropout
+    reconstruction for absent ranks under a straggler timeout) instead
+    of the plaintext tree_weighted_mean.  Slot index i is rank i+1 —
+    the same cohort ids the async path and the keyring use."""
 
     def __init__(self, init_variables: Pytree, worker_num: int,
-                 client_num_in_total: int, client_num_per_round: int):
+                 client_num_in_total: int, client_num_per_round: int,
+                 secure=None):
         self.variables = _to_numpy(init_variables)
         self.worker_num = worker_num
         self.sampler = ClientSampler(client_num_in_total, client_num_per_round)
@@ -66,30 +79,57 @@ class FedAvgAggregator:
         self.sample_num_dict: dict[int, float] = {}
         self.flag_client_model_uploaded = [False] * worker_num
         self._lock = threading.Lock()
+        self.secure = secure
+        self.secure_below_threshold = 0
+        if secure is not None:
+            for r in range(1, worker_num + 1):
+                secure.escrow(r)        # shares escrowed before round 0
 
     def add_local_trained_result(self, index: int, variables: Pytree,
                                  sample_num: float) -> bool:
         with self._lock:
-            self.model_dict[index] = variables
-            self.sample_num_dict[index] = sample_num
+            if self.secure is not None:
+                # masked row: fold into the field accumulator, never
+                # store plaintext (there is none to store)
+                self.secure.fold(index + 1,
+                                 np.ascontiguousarray(variables, np.uint32))
+            else:
+                self.model_dict[index] = variables
+                self.sample_num_dict[index] = sample_num
             self.flag_client_model_uploaded[index] = True
             return all(self.flag_client_model_uploaded)
 
-    def aggregate(self) -> Pytree:
+    def aggregate(self, round_idx: int = 0) -> Pytree:
         """Aggregate over every slot that uploaded this round.  With the
         all-received barrier that is all of them; under a straggler
         timeout it is the received subset (sample-weighted, so absent
-        clients simply drop out of the mean)."""
+        clients simply drop out of the mean).
+
+        Secure mode: the received subset IS the survivor set — the
+        unmask barrier subtracts the absent ranks' reconstructed masks
+        (round_idx is the mask PRG counter, so the caller must pass its
+        true round).  Raises SecAggBelowThreshold by name when too few
+        survived; the round state is kept so late uploads can still
+        close the round."""
         with self._lock:
             got = [i for i in range(self.worker_num)
                    if self.flag_client_model_uploaded[i]]
-            stacked = jax.tree.map(
-                lambda *xs: np.stack(xs),
-                *[self.model_dict[i] for i in got])
-            w = np.asarray([self.sample_num_dict[i] for i in got],
-                           np.float32)
-            self.variables = _to_numpy(
-                tree_weighted_mean(stacked, jnp.asarray(w)))
+            if self.secure is not None:
+                acc, wsum, _inc = self.secure.commit(
+                    int(round_idx), [i + 1 for i in got])
+                mean = jnp.asarray(acc, jnp.float32) / jnp.float32(wsum)
+                from fedml_tpu.async_.staleness import unflatten_rows
+                self.variables = _to_numpy(jax.tree.map(
+                    lambda a: a[0],
+                    unflatten_rows(mean[None, :], self.variables)))
+            else:
+                stacked = jax.tree.map(
+                    lambda *xs: np.stack(xs),
+                    *[self.model_dict[i] for i in got])
+                w = np.asarray([self.sample_num_dict[i] for i in got],
+                               np.float32)
+                self.variables = _to_numpy(
+                    tree_weighted_mean(stacked, jnp.asarray(w)))
             self.flag_client_model_uploaded = [False] * self.worker_num
             self.model_dict.clear()
             self.sample_num_dict.clear()
@@ -162,6 +202,17 @@ class FedAvgServerManager(ServerManager):
     def _handle_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
         upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND)
+        marker = msg.get(MyMessage.MSG_ARG_KEY_SECAGG)
+        secure = self.aggregator.secure is not None
+        if secure != (marker is not None):
+            # ISSUE 20: plain uplink to a secure server (or masked
+            # words to a plain one) — quarantine BY NAME, never fold
+            log.warning(
+                "%s server: %s uplink from rank %d quarantined "
+                "(--secure_agg config skew between server and client)",
+                "secure" if secure else "plain",
+                "PLAIN" if secure else "MASKED", sender)
+            return
         with self._round_lock:
             if (upload_round is not None
                     and int(upload_round) != self.round_idx):
@@ -210,7 +261,20 @@ class FedAvgServerManager(ServerManager):
         # the async path's async.commit spans
         with obs.span("fsm.aggregate", round=self.round_idx,
                       node="server"):
-            self.aggregator.aggregate()
+            try:
+                self.aggregator.aggregate(self.round_idx)
+            except SecAggBelowThreshold as e:
+                # ISSUE 20: the round fails BY NAME — keep it open (the
+                # arrived folds survive), re-arm the straggler watchdog,
+                # and wait for late uploads to clear the threshold;
+                # committing would bake unerasable mask noise into the
+                # model
+                self.aggregator.secure_below_threshold += 1
+                log.warning("secure round %d did not aggregate: %s",
+                            self.round_idx, e)
+                if self.straggler_timeout is not None:
+                    self._arm_watchdog(self.round_idx)
+                return False
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.aggregator.variables)
         self.round_idx += 1
@@ -231,7 +295,7 @@ class FedAvgClientManager(ClientManager):
 
     def __init__(self, trainer, data, epochs: int, rank: int, size: int,
                  backend: str = "INPROC", total_rounds: Optional[int] = None,
-                 wire_compress: bool = False, **kw):
+                 wire_compress: bool = False, secure=None, **kw):
         """total_rounds: in multi-PROCESS deployments the client must stop
         itself — it counts model syncs (the server sends exactly one per
         round, reference FedAvgClientManager.py:60-66) and finishes after
@@ -244,6 +308,10 @@ class FedAvgClientManager(ClientManager):
         frame head (lossless)."""
         super().__init__(rank, size, backend, **kw)
         self.wire_compress = wire_compress
+        # ISSUE 20: the client's view of the secure data plane (masking
+        # only — reads the seed-derived keyring, holds no server state)
+        self.secure = secure
+        self.secagg_rejected = 0
         self.trainer = trainer
         self.data = data
         self.epochs = epochs
@@ -279,8 +347,40 @@ class FedAvgClientManager(ClientManager):
             n.block_until_ready()
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
                       self.rank, 0)
-        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, _to_numpy(new_vars))
-        out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        if self.secure is not None:
+            # ISSUE 20: quantize + pairwise-mask the weighted flat row;
+            # the sample weight rides as the masked trailing word, so
+            # NUM_SAMPLES ships a constant 1.0 and per-client sample
+            # counts stay private.  A quantizer refusal (fixed-point
+            # field overflow — the one bound masking cannot blind)
+            # drops the uplink: the straggler timeout carries the round.
+            from fedml_tpu.async_.staleness import flatten_vars_row
+            try:
+                masked = self.secure.client_row(
+                    self.rank, int(round_idx or 0),
+                    np.asarray(flatten_vars_row(_to_numpy(new_vars)),
+                               np.float64),
+                    float(n))
+            except ValueError as e:
+                self.secagg_rejected += 1
+                obs.counter("secagg_rejected_uplinks_total").inc()
+                log.warning(
+                    "secagg client %d: round %d uplink refused at "
+                    "quantization (norm-bound enforcement): %s",
+                    self.rank, int(round_idx or 0), e)
+                self.rounds_seen += 1
+                return
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, masked)
+            out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+            out.add_params(MyMessage.MSG_ARG_KEY_SECAGG,
+                           {"round": int(round_idx or 0)})
+            out.set_wire_transport(
+                MyMessage.MSG_ARG_KEY_MODEL_PARAMS, "secagg",
+                scale=self.secure.cfg.scale, p=self.secure.cfg.prime)
+        else:
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                           _to_numpy(new_vars))
+            out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
         out.add_params(MyMessage.MSG_ARG_KEY_LOCAL_LOSS, float(loss))
         if round_idx is not None:       # echo for stale-upload rejection
             out.add_params(MyMessage.MSG_ARG_KEY_ROUND, int(round_idx))
@@ -305,6 +405,7 @@ def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",
     straggler_timeout = backend_kw.pop("straggler_timeout", None)
     model_transport = backend_kw.pop("model_transport", None)
     wire_compress = backend_kw.pop("wire_compress", False)
+    secure_cfg = backend_kw.pop("secure", None)
     router = backend_kw.pop("router", None)
     if backend.upper() == "INPROC" and router is None:
         router = InProcRouter()
@@ -314,15 +415,24 @@ def run_messaging_fedavg(trainer, data, cfg, backend: str = "INPROC",
 
     init_vars = trainer.init(jax.random.PRNGKey(cfg.seed),
                              jnp.asarray(data.client_shards["x"][0, 0]))
+    secagg = None
+    if secure_cfg is not None:
+        # one shared SecureAggregator (ISSUE 20): the aggregator folds/
+        # unmasks, the clients only read the seed-derived keyring
+        from fedml_tpu.async_.staleness import flat_dim
+        from fedml_tpu.secure.secagg import SecureAggregator
+        secagg = SecureAggregator(secure_cfg, range(1, size),
+                                  flat_dim(_to_numpy(init_vars)))
     agg = FedAvgAggregator(init_vars, worker_num,
-                           cfg.client_num_in_total, worker_num)
+                           cfg.client_num_in_total, worker_num,
+                           secure=secagg)
     server = FedAvgServerManager(agg, cfg.comm_round, 0, size, backend,
                                  straggler_timeout=straggler_timeout,
                                  model_transport=model_transport,
                                  wire_compress=wire_compress, **kw)
     clients = [FedAvgClientManager(trainer, data, cfg.epochs, r, size,
                                    backend, wire_compress=wire_compress,
-                                   **kw)
+                                   secure=secagg, **kw)
                for r in range(1, size)]
     threads = [c.run_async() for c in clients] + [server.run_async()]
     server.send_init_msg()

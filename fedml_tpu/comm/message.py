@@ -50,8 +50,12 @@ from fedml_tpu import obs
 
 # the v2 per-array lossy wire transports this build can encode AND
 # decode — named in the version-skew rejection so an old server tells
-# the operator WHICH codec it is missing instead of dying in a thread
-WIRE_TRANSPORTS = ("bf16", "int8", "sparse_topk")
+# the operator WHICH codec it is missing instead of dying in a thread.
+# "secagg" is special: not lossy but OPAQUE — masked fixed-point field
+# words (ISSUE 20) that only the secure commit barrier can turn back
+# into floats, so plain decode hands the raw words through and
+# decode_into refuses them by name.
+WIRE_TRANSPORTS = ("bf16", "int8", "sparse_topk", "secagg")
 
 # ship 1-in-16 entries on the sparse_topk wire (8 B per kept entry):
 # matches the carry tier's DEFAULT_TOPK_RATIO (parallel/carry_codec.py
@@ -82,6 +86,7 @@ class Message:
         # by MessageCodec.encode_parts.  Default empty/off = v1 frame,
         # bitwise-exact arrays.
         self.wire_transport: dict[str, str] = {}
+        self.wire_transport_meta: dict[str, dict] = {}
         self.wire_compress: bool = False
         self.msg_params: dict[str, Any] = {
             Message.MSG_ARG_KEY_TYPE: type,
@@ -89,20 +94,34 @@ class Message:
             Message.MSG_ARG_KEY_RECEIVER: receiver_id,
         }
 
-    def set_wire_transport(self, key: str, kind: Optional[str]) -> None:
+    def set_wire_transport(self, key: str, kind: Optional[str],
+                           **meta) -> None:
         """Opt this message key's float arrays into a lossy wire dtype:
         "bf16" (2x), "int8" (4x, per-tensor affine scale), or
         "sparse_topk" (~8x, top-k index/value pairs — ISSUE 19).
         None/"none" clears the opt-in.  Keys never opted in ride exact
         — keep aggregation-critical payloads (e.g. model averages) that
-        way unless the caller accepts the precision tradeoff."""
+        way unless the caller accepts the precision tradeoff.
+
+        "secagg" (ISSUE 20) marks the key's array as MASKED fixed-point
+        field words; it requires `scale=` and `p=` meta kwargs because
+        the codec cannot recover the quantization parameters from
+        masked words — they ride in the frame's enc header (the affine
+        header shape) so the unmask barrier is self-describing."""
         if kind in (None, "none"):
             self.wire_transport.pop(key, None)
+            self.wire_transport_meta.pop(key, None)
             return
         if kind not in WIRE_TRANSPORTS:
             raise ValueError(f"unknown wire transport {kind!r} "
                              f"(choose one of {WIRE_TRANSPORTS})")
+        if kind == "secagg" and not {"scale", "p"} <= set(meta):
+            raise ValueError(
+                "secagg transport needs scale= and p= meta (the codec "
+                "cannot infer quantization parameters from masked words)")
         self.wire_transport[key] = kind
+        if meta:
+            self.wire_transport_meta[key] = dict(meta)
 
     # -- reference API (message.py:23-61) -----------------------------------
     def init(self, msg_params):
@@ -284,10 +303,27 @@ class MessageCodec:
         return None
 
     @staticmethod
-    def _encode_transport(a: np.ndarray, kind: str, m: dict) -> np.ndarray:
+    def _encode_transport(a: np.ndarray, kind: str, m: dict,
+                          extra: Optional[dict] = None) -> np.ndarray:
         """Lossy wire encoding of one float array; updates its meta
         record in place.  Non-float (and non-finite int8 candidates)
         stay exact — a silent fallback beats a corrupt quantization."""
+        if kind == "secagg":
+            # masked field words (uint32 residues mod p, ISSUE 20): the
+            # payload is already its own wire form — pass through and
+            # stamp the self-describing enc header.  This branch MUST
+            # precede the float guard: the array is integer by design.
+            if not extra or not {"scale", "p"} <= set(extra):
+                raise ValueError(
+                    "secagg transport needs scale=/p= meta from "
+                    "set_wire_transport (unrecoverable from masked words)")
+            w = np.ascontiguousarray(a, np.uint32)
+            m["dtype"] = "uint32"
+            m["shape"] = list(w.shape)
+            m["enc"] = {"kind": "secagg", "orig": str(a.dtype),
+                        "oshape": list(a.shape),
+                        "scale": int(extra["scale"]), "p": int(extra["p"])}
+            return w
         if not np.issubdtype(a.dtype, np.floating):
             return a
         if kind == "bf16":
@@ -349,6 +385,14 @@ class MessageCodec:
             return a.astype(orig)
         if enc["kind"] == "int8":
             return affine_int8_decode(a, enc["min"], enc["scale"], orig)
+        if enc["kind"] == "secagg":
+            # masked fixed-point words CANNOT be dequantized per-array —
+            # the pairwise masks only cancel in the cohort SUM.  Hand
+            # the raw u32 residues through (fresh, mutable copy to keep
+            # decode's leaf contract); the secure server unmasks at the
+            # commit barrier (fedml_tpu/secure), every other consumer
+            # quarantines the uplink by its secagg marker.
+            return np.array(a, dtype=np.uint32)
         if enc["kind"] == "sparse_topk":
             idx, vals = MessageCodec._sparse_pairs(a, enc)
             oshape = tuple(enc.get("oshape", ()))
@@ -396,10 +440,12 @@ class MessageCodec:
         compress = (not force_v1) and getattr(msg, "wire_compress", False)
 
         if transport:
+            tmeta = getattr(msg, "wire_transport_meta", {})
             for i, (a, m, p) in enumerate(zip(arrays, meta, paths)):
                 kind = cls._transport_kind(p, transport)
                 if kind is not None:
-                    arrays[i] = cls._encode_transport(a, kind, m)
+                    arrays[i] = cls._encode_transport(
+                        a, kind, m, cls._transport_kind(p, tmeta))
 
         if not transport and not compress:       # plain v1 frame
             header = json.dumps({"tree": tree, "arrays": meta}).encode()
@@ -630,14 +676,18 @@ class MessageCodec:
                 header, payload, small_src, small_off, big_off):
             path = paths.get(i, "")
             if path == prefix or path.startswith(prefix + "/"):
-                ent = layout.offsets.get(path)
-                if ent is None:
-                    raise ValueError(
-                        f"decode_into: frame array {path!r} is not in the "
-                        f"row layout (model template mismatch)")
-                dst_off, size, shape = ent
                 enc = m.get("enc")
                 kind = enc.get("kind") if enc else None
+                if kind == "secagg":
+                    # masked field words can never fill a float row —
+                    # fail by NAME so a non-secure server reads this as
+                    # config/version skew, not a template mismatch
+                    raise ValueError(
+                        f"masked secagg frame under {path!r}: "
+                        f"decode_into cannot dequantize masked field "
+                        f"words — secure uplinks route through "
+                        f"MessageCodec.decode_secagg on a --secure_agg "
+                        f"server (sender/server config or version skew)")
                 if kind not in (None, "bf16", "int8", "sparse_topk"):
                     # an alien kind must fail as VERSION SKEW, not as
                     # the shape mismatch its opaque wire blob would
@@ -648,6 +698,12 @@ class MessageCodec:
                         f"newer sender (version skew)? upgrade this "
                         f"server or clear the sender's "
                         f"set_wire_transport opt-in")
+                ent = layout.offsets.get(path)
+                if ent is None:
+                    raise ValueError(
+                        f"decode_into: frame array {path!r} is not in the "
+                        f"row layout (model template mismatch)")
+                dst_off, size, shape = ent
                 sparse = kind == "sparse_topk"
                 # a sparse wire array is a u8 blob — validate the
                 # ORIGINAL (pre-sparsification) shape against the layout
@@ -795,3 +851,61 @@ class MessageCodec:
         gv = (np.concatenate(val_parts) if val_parts
               else np.zeros(0, dtype=np.float32))
         return Message().init(params), gi, gv
+
+    @classmethod
+    def decode_secagg(cls, payload: bytes, key: str, n_words: int):
+        """Masked twin of decode_into (ISSUE 20): for a frame whose
+        `key` param is ONE transport=secagg array, return
+
+            (msg, words, enc)
+
+        where `words` is the masked row as a fresh u32 [n_words] copy
+        (ready for the jitted field fold), `enc` its self-describing
+        header ({"kind","orig","oshape","scale","p"}), and `msg` the
+        decoded envelope with `key` set to None.  Raises ValueError if
+        the key's array is NOT a secagg frame (plain uplink — the
+        caller falls back to decode_into/decode), if the word count
+        disagrees with the server's row (model template mismatch), and
+        on decode's malformed-frame hardening."""
+        header, small_src, small_off, big_off = cls._frame_header(payload)
+        paths = cls._array_paths(header["tree"])
+        prefix = "/" + key
+        buffers: list = [None] * len(header["arrays"])
+        words = None
+        enc_out = None
+        for i, m, src, off, dt, count in cls._each_array(
+                header, payload, small_src, small_off, big_off):
+            path = paths.get(i, "")
+            if path == prefix or path.startswith(prefix + "/"):
+                enc = m.get("enc")
+                if not enc or enc.get("kind") != "secagg":
+                    raise ValueError(
+                        f"decode_secagg: frame array {path!r} is not a "
+                        f"secagg frame (plain uplink — fall back to "
+                        f"decode_into/decode)")
+                if words is not None:
+                    raise ValueError(
+                        f"decode_secagg: multiple arrays under "
+                        f"{prefix!r} — a secagg uplink is ONE flat row")
+                if count != int(n_words):
+                    raise ValueError(
+                        f"decode_secagg: masked row has {count} field "
+                        f"words, server layout expects {n_words} "
+                        f"(model template mismatch)")
+                words = np.frombuffer(
+                    src, dtype=dt, count=count,
+                    offset=off).astype(np.uint32, copy=True)
+                enc_out = dict(enc)
+            else:
+                a = np.frombuffer(src, dtype=dt, count=count,
+                                  offset=off).reshape(m["shape"])
+                if not m.get("enc"):
+                    a = a.copy()          # metadata arrays stay mutable
+                buffers[i] = cls._decode_transport(a, m.get("enc"))
+        if words is None:
+            raise ValueError(
+                f"decode_secagg: no secagg array under {prefix!r} "
+                f"(plain uplink — fall back to decode_into/decode)")
+        params = cls._unflatten(header["tree"], buffers)
+        params[key] = None
+        return Message().init(params), words, enc_out

@@ -38,8 +38,27 @@ def modmat(A: np.ndarray, B: np.ndarray, p: int) -> np.ndarray:
 
 def quantize(x: np.ndarray, scale: int = 2 ** 16,
              p: int = DEFAULT_PRIME) -> np.ndarray:
-    """float → field: round(x·scale) mod p, negatives wrap to [p/2, p)."""
+    """float → field: round(x·scale) mod p, negatives wrap to [p/2, p).
+
+    Field-overflow bound: the signed fixed-point magnitude |round(x·scale)|
+    must stay ≤ (p−1)//2 — the field's signed half-range — or the value
+    would alias across the negative/positive boundary (a large positive
+    reading back as negative and vice versa) and every downstream sum
+    would be silently garbage.  Out-of-range values raise a named
+    ValueError instead of wrapping; both signs are pinned at the boundary
+    in tests/test_mpc.py.  With the default scale 2^16 and p = 2^31−1 the
+    usable float range is ±16383.999; aggregate sums share the same bound,
+    so K summands must jointly satisfy K·max|x|·scale ≤ (p−1)//2."""
     q = np.round(np.asarray(x, np.float64) * scale).astype(np.int64)
+    bound = (p - 1) // 2
+    if q.size and int(np.max(np.abs(q))) > bound:
+        bad = float(np.max(np.abs(np.asarray(x, np.float64))))
+        raise ValueError(
+            f"fixed-point field overflow: |x|·scale reaches "
+            f"{int(np.max(np.abs(q)))} > (p-1)//2 = {bound} "
+            f"(max |x| = {bad:g}, scale = {scale}) — the value would "
+            f"alias across the sign boundary after mod p; reduce the "
+            f"scale or clip the input")
     return _mod(q, p)
 
 

@@ -1,0 +1,14 @@
+"""Secure-aggregation data plane (ISSUE 20).
+
+`core/mpc.py` holds the finite-field control plane (BGW shares, LCC,
+DH key agreement, fixed-point quantization); this package is the data
+plane that wires those primitives into the federation's aggregation
+path: pairwise-mask uplinks, elastic dropout recovery at the commit
+barrier, and the `transport=secagg` wire frames.
+"""
+from fedml_tpu.secure.secagg import (SecAggBelowThreshold, SecAggConfig,
+                                     SecAggKeyring, SecureAggregator,
+                                     pairwise_mask)
+
+__all__ = ["SecAggBelowThreshold", "SecAggConfig", "SecAggKeyring",
+           "SecureAggregator", "pairwise_mask"]

@@ -92,49 +92,49 @@ if ! timeout 180 python -c "import jax; assert jax.devices()[0].platform in ('tp
   echo "chip unavailable; aborting queue"; exit 1
 fi
 
-echo "== 1/20 bench.py"
+echo "== 1/21 bench.py"
 timeout 1500 python bench.py 2>"$OUT/bench.err" | tee "$OUT/bench.json"
 
-echo "== 2/20 nwp_convergence (600 rounds, vocab 10004 — must match the"
+echo "== 2/21 nwp_convergence (600 rounds, vocab 10004 — must match the"
 echo "   600-round band pinned in test_quality_regression.py)"
 timeout 3600 python tools/nwp_convergence.py 600 \
     --out benchmarks/nwp_convergence_r5.json 2>"$OUT/nwp.err" \
     | tee "$OUT/nwp.log"
 
-echo "== 3/20 profile_bench C4096B (block-streamed 4096 clients)"
+echo "== 3/21 profile_bench C4096B (block-streamed 4096 clients)"
 timeout 5400 python tools/profile_bench.py C4096B 2>&1 | tee "$OUT/c4096b.log"
 
-echo "== 4/20 profile_bench OS256 OSB256 (order-stat timing)"
+echo "== 4/21 profile_bench OS256 OSB256 (order-stat timing)"
 timeout 3600 python tools/profile_bench.py OS256 OSB256 2>&1 | tee "$OUT/os.log"
 
-echo "== 5/20 profile_bench DN128 (donate on/off + restructured carry A/B)"
+echo "== 5/21 profile_bench DN128 (donate on/off + restructured carry A/B)"
 timeout 1800 python tools/profile_bench.py DN128 2>&1 | tee "$OUT/dn128.log"
 
-echo "== 6/20 profile_bench PF512 SD512 (prefetch + stack-dtype A/Bs)"
+echo "== 6/21 profile_bench PF512 SD512 (prefetch + stack-dtype A/Bs)"
 timeout 3600 python tools/profile_bench.py PF512 SD512 2>&1 | tee "$OUT/pfsd.log"
 
-echo "== 7/20 profile_bench ASYNC (async federation K=8 vs K=32 A/B)"
+echo "== 7/21 profile_bench ASYNC (async federation K=8 vs K=32 A/B)"
 timeout 3600 python tools/profile_bench.py ASYNC 2>&1 | tee "$OUT/async.log"
 
-echo "== 8/20 profile_bench INGEST (uplink ingestion legacy-vs-streaming A/B)"
+echo "== 8/21 profile_bench INGEST (uplink ingestion legacy-vs-streaming A/B)"
 timeout 1800 python tools/profile_bench.py INGEST 2>&1 | tee "$OUT/ingest.log"
 
-echo "== 9/20 profile_bench TRACE (traced-vs-untraced ingest overhead gate)"
+echo "== 9/21 profile_bench TRACE (traced-vs-untraced ingest overhead gate)"
 timeout 1200 python tools/profile_bench.py TRACE 2>&1 | tee "$OUT/trace.log"
 
-echo "== 10/20 profile_bench CHAOS (chaos goodput under seeded wire faults)"
+echo "== 10/21 profile_bench CHAOS (chaos goodput under seeded wire faults)"
 timeout 1800 python tools/profile_bench.py CHAOS 2>&1 | tee "$OUT/chaos.log"
 
-echo "== 11/20 profile_bench ATTACK (adversarial attack x defense matrix)"
+echo "== 11/21 profile_bench ATTACK (adversarial attack x defense matrix)"
 timeout 3600 python tools/profile_bench.py ATTACK 2>&1 | tee "$OUT/attack.log"
 
-echo "== 12/20 profile_bench SERVE (million-client serving spine)"
+echo "== 12/21 profile_bench SERVE (million-client serving spine)"
 timeout 1800 python tools/profile_bench.py SERVE 2>&1 | tee "$OUT/serve.log"
 
-echo "== 13/20 profile_bench CONN (live-connection reactor A/B)"
+echo "== 13/21 profile_bench CONN (live-connection reactor A/B)"
 timeout 1800 python tools/profile_bench.py CONN 2>&1 | tee "$OUT/conn.log"
 
-echo "== 14/20 bench_diff (cross-run regression verdicts, ISSUE 12)"
+echo "== 14/21 bench_diff (cross-run regression verdicts, ISSUE 12)"
 # judge the fresh chip record against the committed trajectory: named
 # regression/improvement verdicts with the encoded noise bands; a
 # nonzero exit flags the queue log, it does not abort banked artifacts.
@@ -145,13 +145,13 @@ echo "== 14/20 bench_diff (cross-run regression verdicts, ISSUE 12)"
     2>&1 | tee "$OUT/bench_diff.log" ) \
     || echo "bench_diff: REGRESSIONS NAMED ABOVE (see $OUT/bench_diff.json)"
 
-echo "== 15/20 profile_bench POD (multi-host weak-scaling sweep, ISSUE 13)"
+echo "== 15/21 profile_bench POD (multi-host weak-scaling sweep, ISSUE 13)"
 # exp_POD = bench.py --mode multihost on the pod slice: per-process
 # local-chip training + DCN carry allreduce; FEDML_POD_PROCS overrides
 # the 1,2,4 process sweep when the slice has more hosts
 timeout 1800 python tools/profile_bench.py POD 2>&1 | tee "$OUT/pod.log"
 
-echo "== 16/20 profile_bench POD compress (compressed-carry arm, ISSUE 16)"
+echo "== 16/21 profile_bench POD compress (compressed-carry arm, ISSUE 16)"
 # the compressed-carry arm under exp_POD, isolated so its bytes column
 # is priced on real DCN frames: f32 escape hatch bitwise under overlap,
 # int8/int8_ef wire reduction (>= 3x gate rides bench_diff), overlap
@@ -159,13 +159,13 @@ echo "== 16/20 profile_bench POD compress (compressed-carry arm, ISSUE 16)"
 FEDML_POD_ARMS=compress timeout 1800 python tools/profile_bench.py POD \
     2>&1 | tee "$OUT/pod_compress.log"
 
-echo "== 17/20 profile_bench ELASTIC (elastic-chaos survivor arm, ISSUE 14)"
+echo "== 17/21 profile_bench ELASTIC (elastic-chaos survivor arm, ISSUE 14)"
 # exp_ELASTIC = bench.py --mode multihost --mh_arms chaos: the elastic
 # 3-process kill-a-rank arm chip-attached — survivor goodput, view-
 # change latency on real DCN detection paths, bitwise_after_death_ok
 timeout 1800 python tools/profile_bench.py ELASTIC 2>&1 | tee "$OUT/elastic.log"
 
-echo "== 18/20 profile_bench ELASTIC straggler (cluster observatory, ISSUE 17)"
+echo "== 18/21 profile_bench ELASTIC straggler (cluster observatory, ISSUE 17)"
 # the same elastic chaos arm with the observatory ON: per-rank obs dirs
 # under $OUT/obs_elastic (rank0/rank1/... + a rejoiner's rank1-pid*),
 # rank 0's barrier ledger pricing real DCN arrival skew, cluster SLO
@@ -181,7 +181,7 @@ timeout 300 python tools/trace_timeline.py "$OUT/obs_elastic" \
     | tee "$OUT/straggler_timeline.log" \
     || echo "trace_timeline: no per-rank traces banked (obs dirs empty?)"
 
-echo "== 19/20 profile_bench CLUSTER (fused serving cluster, ISSUE 18)"
+echo "== 19/21 profile_bench CLUSTER (fused serving cluster, ISSUE 18)"
 # exp_CLUSTER = bench.py --mode cluster: striped connswarm fleet over
 # real sockets against H reactor-fronted hosts, registry-sharded lanes
 # folding cross-host per commit barrier; the chaos-everything arm
@@ -191,7 +191,7 @@ echo "== 19/20 profile_bench CLUSTER (fused serving cluster, ISSUE 18)"
 timeout 1800 python tools/profile_bench.py CLUSTER 2>&1 \
     | tee "$OUT/cluster.log"
 
-echo "== 20/20 profile_bench sparse exchange (top-k codecs, ISSUE 19)"
+echo "== 20/21 profile_bench sparse exchange (top-k codecs, ISSUE 19)"
 # the ISSUE-19 sparse arms on both wires, chip-attached: exp_POD with
 # FEDML_POD_ARMS=sparse prices the topk/topk_ef carry codecs on real
 # DCN frames (>= 6x wire reduction at k=P/16 rides bench_diff v17,
@@ -204,5 +204,16 @@ FEDML_POD_ARMS=sparse timeout 1800 python tools/profile_bench.py POD \
 FEDML_CLUSTER_ARMS=clean,sparse timeout 1800 \
     python tools/profile_bench.py CLUSTER 2>&1 \
     | tee "$OUT/cluster_sparse.log"
+
+echo "== 21/21 profile_bench SECAGG (pairwise-mask secure agg, ISSUE 20)"
+# exp_SECAGG = bench.py --mode secure: the privacy-tax table on the
+# live async FSM with the chip-attached runtime driving the u32 field
+# fold — plain vs masked committed-updates/sec (>= 0.5x floor rides
+# bench_diff v18), plain/secure/dp accuracy (the end-to-end private
+# mode in the +-0.04 band), masks_cancel_bitwise_ok (exact-integer
+# pin), zero below-threshold commits on the clean arms, and the
+# masked-byzantine pair (blinded screen vs quantizer range refusal)
+timeout 1800 python tools/profile_bench.py SECAGG 2>&1 \
+    | tee "$OUT/secagg.log"
 
 echo "== queue complete; artifacts in $OUT + benchmarks/"

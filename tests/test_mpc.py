@@ -1,0 +1,129 @@
+"""MPC primitive pins (ISSUE 20 satellite): the field-arithmetic layer
+under fedml_tpu/secure/secagg.py.  Everything here is numpy-only — no
+jax import, no engines — so a primitive regression is attributed to
+mpc.py itself, never to the data plane riding it.
+
+The quantize overflow bound is the load-bearing pin: with p = 2^31-1
+and scale 2^16 the usable float range is ±16383.999, and a value past
+it must raise the NAMED ValueError, not alias across the sign boundary
+(a silent wrap reads a large positive back as negative and poisons
+every downstream aggregate while masks still cancel perfectly)."""
+import numpy as np
+import pytest
+
+from fedml_tpu.core import mpc
+
+P = mpc.DEFAULT_PRIME
+
+
+# -- quantize / dequantize ---------------------------------------------------
+
+def test_quantize_roundtrip_including_negatives():
+    x = np.array([0.0, 1.5, -1.5, 1234.5678, -9999.25, 1e-4, -1e-4])
+    q = mpc.quantize(x)
+    assert q.dtype == np.int64
+    assert (q >= 0).all() and (q < P).all()
+    back = mpc.dequantize(q)
+    np.testing.assert_allclose(back, x, atol=1.0 / 2 ** 16)
+
+
+def test_quantize_negative_maps_to_upper_half():
+    q = mpc.quantize(np.array([-1.0]))
+    assert q[0] > P // 2, "negatives must wrap to the upper half-range"
+    assert mpc.dequantize(q)[0] == -1.0
+
+
+def test_quantize_overflow_raises_named_both_signs():
+    # the bound is |round(x*scale)| <= (p-1)//2: 16383.999... fits,
+    # 16384.0 is the first magnitude past it — pin BOTH signs, the
+    # bug class is an asymmetric wrap
+    bound = (P - 1) // 2                           # 1073741823
+    ok = bound / 2.0 ** 16                          # largest exact fit
+    assert mpc.quantize(np.array([ok]))[0] == bound
+    assert mpc.quantize(np.array([-ok]))[0] == P - bound
+    for sign in (+1.0, -1.0):
+        with pytest.raises(ValueError, match="fixed-point field overflow"):
+            mpc.quantize(np.array([sign * (ok + 1.0 / 2 ** 16)]))
+
+
+def test_quantize_sum_bound_documented_for_aggregates():
+    # K summands share one bound: K * max|x| * scale <= (p-1)//2.  Two
+    # half-bound values sum INSIDE the field; the same two past half
+    # would alias — the docstring's contract, pinned numerically.
+    half = ((P - 1) // 2) // 2
+    x = half / 2.0 ** 16
+    q = mpc.quantize(np.array([x, x]))
+    total = int(q.sum() % P)
+    assert mpc.dequantize(np.array([total]))[0] == pytest.approx(
+        2 * x, abs=1.0 / 2 ** 16)
+
+
+# -- BGW (Shamir) sharing ----------------------------------------------------
+
+def test_bgw_roundtrip_at_threshold():
+    secret = mpc.quantize(np.array([3.25, -7.5, 0.0, 16000.0]))
+    N, T = 7, 3                       # any T+1 = 4 shares reconstruct
+    shares = mpc.BGW_encoding(secret, N, T, seed=11)
+    assert shares.shape == (N,) + secret.shape
+    idx = np.array([0, 2, 4, 6])      # exactly T+1 of them
+    out = mpc.BGW_decoding(shares[idx], idx)
+    np.testing.assert_array_equal(out, secret)
+    # a different qualifying subset agrees — reconstruction is a
+    # property of the polynomial, not of which workers survived
+    idx2 = np.array([1, 3, 5, 6])
+    np.testing.assert_array_equal(mpc.BGW_decoding(shares[idx2], idx2),
+                                  secret)
+
+
+def test_bgw_below_threshold_fails_by_value():
+    """T shares (one short of T+1) must NOT reconstruct: Shamir privacy
+    means any T-subset is consistent with EVERY candidate secret, so
+    interpolating it yields garbage, not the secret.  The failure mode
+    is wrong-value (information-theoretic), not an exception — pin
+    that the decode disagrees."""
+    secret = mpc.quantize(np.array([42.0, -42.0]))
+    N, T = 7, 3
+    shares = mpc.BGW_encoding(secret, N, T, seed=13)
+    idx = np.array([0, 2, 4])         # T shares: one short
+    out = mpc.BGW_decoding(shares[idx], idx)
+    assert not np.array_equal(out, secret), (
+        "T shares reconstructed the secret — threshold privacy broken")
+
+
+# -- LCC ---------------------------------------------------------------------
+
+def test_lcc_roundtrip():
+    rs = np.random.RandomState(7)
+    K, N, T = 3, 8, 1
+    X = rs.randint(0, P, (K, 5)).astype(np.int64)
+    coded = mpc.LCC_encoding(X, N, K, T=T, seed=5)
+    assert coded.shape == (N, 5)
+    idx = np.array([1, 3, 5, 7])      # any K+T = 4 coded blocks
+    out = mpc.LCC_decoding(coded[idx], idx, N, K, T=T)
+    np.testing.assert_array_equal(out, X)
+
+
+# -- additive shares ---------------------------------------------------------
+
+def test_additive_shares_sum_to_secret():
+    rs = np.random.RandomState(3)
+    X = rs.randint(0, P, (6,)).astype(np.int64)
+    shares = mpc.additive_shares(X, N=5, seed=17)
+    assert shares.shape == (5, 6)
+    total = np.mod(shares.astype(object).sum(axis=0), P).astype(np.int64)
+    np.testing.assert_array_equal(total, X)
+    # no single share equals the secret (vanishing probability; seeded)
+    for s in shares:
+        assert not np.array_equal(s, X)
+
+
+# -- DH key agreement --------------------------------------------------------
+
+def test_dh_shared_key_symmetry():
+    sk_a, sk_b, sk_c = 123457, 987653, 55555
+    pk_a, pk_b = mpc.pk_gen(sk_a), mpc.pk_gen(sk_b)
+    k_ab = mpc.shared_key(pk_b, sk_a)
+    k_ba = mpc.shared_key(pk_a, sk_b)
+    assert k_ab == k_ba, "DH agreement must be symmetric"
+    # a third party derives a DIFFERENT pair key
+    assert mpc.shared_key(pk_a, sk_c) != k_ab

@@ -1,0 +1,363 @@
+"""Secure-aggregation data plane (ISSUE 20, fedml_tpu/secure/secagg.py).
+
+The anchor is EXACT integer arithmetic: pairwise masks cancel BITWISE
+in the fixed-point field or not at all, so every protocol pin here is
+np.array_equal on field words / tobytes on committed accumulators —
+never allclose.  Layers covered: the mask/fold/unmask protocol with
+elastic dropout recovery (seeded death at each phase must be
+byte-identical to a clean survivor-only round), the named
+below-threshold refusal, the secagg wire transport (opaque by design:
+decode_into must refuse masked frames BY NAME, decode_secagg must
+refuse plain frames so callers fall back), the plain<->secure config
+skew quarantine, and the live FSMs end to end (async INPROC + sync
+FedAvg, where secure-vs-plain agreement is bounded by quantization,
+the one place a float tolerance is correct)."""
+import logging
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import mpc
+from fedml_tpu.secure import (SecAggBelowThreshold, SecAggConfig,
+                              SecureAggregator, pairwise_mask)
+
+P = mpc.DEFAULT_PRIME
+
+
+def _plain_field_sum(cfg, dim, contribs):
+    """The unmasked truth: sum of [quantize(w*x), quantize(w)] rows
+    mod p over `contribs` ({cid: (flat, weight)})."""
+    expected = np.zeros(dim + 1, np.int64)
+    for flat, w in contribs.values():
+        q = np.empty(dim + 1, np.int64)
+        q[:dim] = mpc.quantize(np.asarray(flat, np.float64) * w,
+                               cfg.scale, cfg.prime)
+        q[dim] = mpc.quantize(np.array([float(w)]), cfg.scale,
+                              cfg.prime)[0]
+        expected = (expected + q) % cfg.prime
+    return expected
+
+
+def _mk(n=5, dim=32, seed=9, **cfg_kw):
+    cfg = SecAggConfig(seed=seed, **cfg_kw)
+    ids = list(range(1, n + 1))
+    agg = SecureAggregator(cfg, ids, dim)
+    rs = np.random.RandomState(21)
+    contribs = {c: (rs.randn(dim) * 0.05, float(rs.randint(1, 40)))
+                for c in ids}
+    return cfg, agg, contribs
+
+
+def _upload(agg, contribs, cids, round_idx=0):
+    for c in cids:
+        agg.escrow(c)
+        flat, w = contribs[c]
+        agg.fold(c, agg.client_row(c, round_idx, flat, w))
+
+
+# -- the protocol ------------------------------------------------------------
+
+def test_masks_cancel_bitwise_full_cohort():
+    cfg, agg, contribs = _mk()
+    _upload(agg, contribs, contribs)
+    words, included = agg.field_sum(0, agg.arrived)
+    assert included == sorted(contribs)
+    np.testing.assert_array_equal(
+        np.asarray(words) % P, _plain_field_sum(cfg, agg.dim, contribs))
+
+
+def test_single_masked_row_is_not_the_plain_row():
+    """Privacy premise: one client's uplink must NOT equal its plain
+    fixed-point row (the masks only vanish in the cohort sum)."""
+    cfg, agg, contribs = _mk()
+    c = 1
+    flat, w = contribs[c]
+    masked = agg.client_row(c, 0, flat, w)
+    plain = _plain_field_sum(cfg, agg.dim, {c: contribs[c]})
+    assert not np.array_equal(masked.astype(np.int64), plain)
+
+
+def test_pairwise_mask_is_round_keyed():
+    m0 = pairwise_mask(123456789, 0, 16, P)
+    assert np.array_equal(m0, pairwise_mask(123456789, 0, 16, P)), (
+        "same (key, round) must regenerate the same mask — both ends "
+        "of a pair derive it independently")
+    assert not np.array_equal(m0, pairwise_mask(123456789, 1, 16, P)), (
+        "round-keyed: a stale mask must not cancel in a later round")
+    assert not np.array_equal(m0, pairwise_mask(987654321, 0, 16, P))
+
+
+@pytest.mark.parametrize("phase", ["pre_upload", "post_upload"])
+def test_dropout_recovery_byte_identical_to_clean_survivor_round(phase):
+    """Satellite (c): seeded death at each phase.  A client dying
+    before upload leaves its pair masks uncancelled in every survivor
+    row (reconstruct + back out); dying AFTER upload additionally
+    leaves its whole retained row to subtract.  Either way the
+    recovered aggregate must be byte-identical to a clean round where
+    only the survivors ever existed."""
+    dead = 3
+    cfg, agg, contribs = _mk()
+    survivors = [c for c in contribs if c != dead]
+    uploaders = survivors if phase == "pre_upload" else list(contribs)
+    _upload(agg, contribs, uploaders)
+    agg.escrow(dead)          # escrow happens at DISPATCH, before death
+    words, included = agg.field_sum(0, survivors)
+    assert included == survivors
+    surv_contribs = {c: contribs[c] for c in survivors}
+    np.testing.assert_array_equal(
+        np.asarray(words) % P,
+        _plain_field_sum(cfg, agg.dim, surv_contribs))
+
+    # and the committed float accumulator is byte-identical to a
+    # cohort that never contained the dead client at all
+    acc, wsum, _ = agg.commit(0, survivors, reset=False)
+    clean_cfg = SecAggConfig(seed=cfg.seed)
+    clean = SecureAggregator(clean_cfg, survivors, agg.dim)
+    _upload(clean, contribs, survivors)
+    acc2, wsum2, _ = clean.commit(0, survivors, reset=False)
+    assert acc.tobytes() == acc2.tobytes()
+    assert wsum == wsum2
+
+
+def test_below_threshold_refuses_by_name_then_recovers():
+    cfg, agg, contribs = _mk(n=5, threshold=4)
+    _upload(agg, contribs, [1, 2, 3])
+    for c in (4, 5):
+        agg.escrow(c)
+    with pytest.raises(SecAggBelowThreshold, match="below|survivors"):
+        agg.commit(0, [1, 2, 3])
+    # state survived the refusal: one more arrival crosses the
+    # threshold and the round commits with recovery for client 5
+    assert agg.arrived == [1, 2, 3]
+    flat, w = contribs[4]
+    agg.fold(4, agg.client_row(4, 0, flat, w))
+    words, included = agg.field_sum(0, [1, 2, 3, 4])
+    assert included == [1, 2, 3, 4]
+    np.testing.assert_array_equal(
+        np.asarray(words) % P,
+        _plain_field_sum(cfg, agg.dim,
+                         {c: contribs[c] for c in (1, 2, 3, 4)}))
+
+
+def test_reupload_backs_out_previous_row():
+    """A redispatched client re-uploads at the same round: the fold
+    must replace its previous row, not double-count it."""
+    cfg, agg, contribs = _mk()
+    _upload(agg, contribs, contribs)
+    flat, _w = contribs[2]
+    new_w = 7.0
+    agg.fold(2, agg.client_row(2, 0, flat, new_w))
+    contribs2 = dict(contribs)
+    contribs2[2] = (flat, new_w)
+    words, _ = agg.field_sum(0, agg.arrived)
+    np.testing.assert_array_equal(
+        np.asarray(words) % P, _plain_field_sum(cfg, agg.dim, contribs2))
+
+
+def test_commit_dequantizes_to_weighted_mean():
+    cfg, agg, contribs = _mk()
+    _upload(agg, contribs, contribs)
+    acc, wsum, included = agg.commit(0, agg.arrived)
+    assert included == sorted(contribs)
+    expect = sum(np.asarray(f, np.float64) * w
+                 for f, w in contribs.values())
+    total_w = sum(w for _f, w in contribs.values())
+    assert wsum == pytest.approx(total_w, abs=1e-3)
+    # quantization bound: cohort_size rounding errors of 1/scale each
+    np.testing.assert_allclose(acc, expect,
+                               atol=len(contribs) / cfg.scale)
+
+
+def test_dp_private_mode_composes_before_masking():
+    """End-to-end private mode: clip+noise happen CLIENT-side before
+    quantization, so the masked round still commits and the seeded
+    noise is deterministic (two aggregators, same seed, same call
+    order -> byte-identical commits)."""
+    _cfg, a1, contribs = _mk(dp_clip=2.0, dp_noise=1e-3)
+    _cfg2, a2, _ = _mk(dp_clip=2.0, dp_noise=1e-3)
+    _upload(a1, contribs, contribs)
+    _upload(a2, contribs, contribs)
+    acc1, w1, _ = a1.commit(0, a1.arrived)
+    acc2, w2, _ = a2.commit(0, a2.arrived)
+    assert np.isfinite(acc1).all()
+    assert acc1.tobytes() == acc2.tobytes() and w1 == w2
+    with pytest.raises(ValueError, match="dp_noise"):
+        SecAggConfig(dp_noise=1e-3)      # noise without a clip bound
+
+
+def test_threshold_validation_named():
+    with pytest.raises(ValueError, match="threshold"):
+        _mk(n=3, threshold=7)
+
+
+def test_quantizer_refusal_is_the_surviving_norm_bound():
+    """The one enforcement masking cannot blind: a row past the
+    fixed-point range is refused at the CLIENT with the named
+    overflow error (the server never sees it)."""
+    _cfg, agg, _contribs = _mk()
+    huge = np.full(agg.dim, 1e9)
+    with pytest.raises(ValueError, match="fixed-point field overflow"):
+        agg.client_row(1, 0, huge, 1.0)
+
+
+# -- the wire ----------------------------------------------------------------
+
+def _masked_frame(words, scale=2 ** 16, extra=None):
+    from fedml_tpu.comm.message import Message, MessageCodec
+    msg = Message(3, 1, 0)
+    msg.add_params("model_params", words)
+    msg.add_params("num_samples", 1.0)
+    if extra:
+        for k, v in extra.items():
+            msg.add_params(k, v)
+    msg.set_wire_transport("model_params", "secagg", scale=scale, p=P)
+    return MessageCodec.encode(msg)
+
+
+def test_wire_secagg_roundtrip_preserves_words():
+    from fedml_tpu.comm.message import MessageCodec
+    rs = np.random.RandomState(0)
+    words = rs.randint(0, P, 33).astype(np.uint32)
+    payload = _masked_frame(words, extra={"secagg": {"round": 4}})
+    msg, got, enc = MessageCodec.decode_secagg(payload, "model_params",
+                                               33)
+    np.testing.assert_array_equal(got, words)
+    assert got.flags.writeable, "fold donates the row — needs a copy"
+    assert enc["kind"] == "secagg" and enc["p"] == P
+    assert enc["scale"] == 2 ** 16
+    assert msg.get("model_params") is None
+    assert msg.get("num_samples") == 1.0
+    assert msg.get("secagg") == {"round": 4}
+
+
+def test_wire_plain_decode_passes_field_words_through():
+    """The generic decode must NOT try to dequantize masked words —
+    they are meaningless per-array; it hands back the u32 row."""
+    from fedml_tpu.comm.message import MessageCodec
+    words = np.arange(17, dtype=np.uint32)
+    got = MessageCodec.decode(_masked_frame(words)).get("model_params")
+    assert got.dtype == np.uint32
+    np.testing.assert_array_equal(got, words)
+
+
+def test_decode_secagg_refuses_plain_frames_so_callers_fall_back():
+    from fedml_tpu.comm.message import Message, MessageCodec
+    msg = Message(3, 1, 0)
+    msg.add_params("model_params", np.ones(8, np.float32))
+    payload = MessageCodec.encode(msg)
+    with pytest.raises(ValueError, match="not a secagg frame"):
+        MessageCodec.decode_secagg(payload, "model_params", 8)
+
+
+def test_decode_secagg_word_count_mismatch_named():
+    with pytest.raises(ValueError, match="template mismatch"):
+        from fedml_tpu.comm.message import MessageCodec
+        MessageCodec.decode_secagg(
+            _masked_frame(np.zeros(9, np.uint32)), "model_params", 33)
+
+
+def test_set_wire_transport_secagg_requires_meta():
+    from fedml_tpu.comm.message import Message
+    msg = Message(3, 1, 0)
+    with pytest.raises(ValueError, match="scale"):
+        msg.set_wire_transport("model_params", "secagg")
+
+
+def test_decode_into_rejects_masked_frame_by_name():
+    """A --secure_agg client against a plain streaming server: the
+    decode-into fast path must refuse the masked frame with an error
+    NAMING the config skew, not scribble field words into the f32
+    row."""
+    from fedml_tpu.async_.staleness import RowLayout, flat_dim
+    from fedml_tpu.comm.message import MessageCodec
+    template = {"w": np.zeros((4, 2), np.float32),
+                "b": np.zeros((2,), np.float32)}
+    layout = RowLayout(template, "model_params")
+    out = np.zeros(flat_dim(template), np.float32)
+    payload = _masked_frame(np.zeros(out.size + 1, np.uint32))
+    with pytest.raises(ValueError, match="decode_secagg"):
+        MessageCodec.decode_into(payload, out, layout)
+
+
+# -- config-skew quarantine (sync FSM guard, both directions) ----------------
+
+def _skew_call(secure_server, marker, caplog):
+    from fedml_tpu.comm.fedavg_messaging import (FedAvgServerManager,
+                                                 MyMessage)
+    from fedml_tpu.comm.message import Message
+    msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                   np.zeros(4, np.float32))
+    msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 5.0)
+    if marker:
+        msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG, {"round": 0})
+    folded = []
+    fake = types.SimpleNamespace(
+        aggregator=types.SimpleNamespace(
+            secure=object() if secure_server else None,
+            add_local_trained_result=lambda *a: folded.append(a)),
+        round_idx=0, straggler_timeout=None, _watchdog=None,
+        _round_lock=__import__("threading").Lock())
+    with caplog.at_level(logging.WARNING,
+                         logger="fedml_tpu.comm.fedavg_messaging"):
+        FedAvgServerManager._handle_model_from_client(fake, msg)
+    return folded, caplog.text
+
+
+def test_plain_uplink_to_secure_server_quarantined_by_name(caplog):
+    folded, text = _skew_call(secure_server=True, marker=False,
+                              caplog=caplog)
+    assert folded == [], "a plaintext row must never reach the fold"
+    assert "config skew" in text and "PLAIN" in text
+
+
+def test_masked_uplink_to_plain_server_quarantined_by_name(caplog):
+    folded, text = _skew_call(secure_server=False, marker=True,
+                              caplog=caplog)
+    assert folded == [], "masked field words must never be averaged"
+    assert "config skew" in text and "MASKED" in text
+
+
+# -- the live FSMs -----------------------------------------------------------
+
+def _small_cfg(rounds=2, n=4):
+    from parallel_case import _mnist_like_cfg
+    return _mnist_like_cfg(client_num_in_total=n,
+                           client_num_per_round=n, comm_round=rounds)
+
+
+def test_async_inproc_secure_rounds_commit():
+    from parallel_case import _setup
+    from fedml_tpu.async_ import run_async_messaging
+    cfg = _small_cfg(rounds=2)
+    trainer, data = _setup(cfg)
+    variables, server = run_async_messaging(
+        trainer, data, cfg, buffer_k=4, worker_num=4, total_commits=2,
+        secure=SecAggConfig(seed=3), timeout_s=120.0)
+    assert server.version == 2
+    assert server.updates_committed == 8
+    assert server.secure_below_threshold == 0
+    assert server._secure.report()["below_threshold_rounds"] == 0
+    leaves = __import__("jax").tree.leaves(variables)
+    assert all(np.isfinite(np.asarray(v)).all() for v in leaves)
+
+
+def test_sync_fsm_secure_matches_plain_within_quantization():
+    """The sync FedAvg FSM end to end, secure vs plain on the same
+    seed: the ONLY divergence allowed is fixed-point rounding (~2^-16
+    per round per parameter) — orders of magnitude below training
+    noise, and the reason a tighter-than-allclose-default bound
+    holds."""
+    import jax
+    from parallel_case import _setup
+    from fedml_tpu.comm.fedavg_messaging import run_messaging_fedavg
+    cfg = _small_cfg(rounds=2)
+    trainer, data = _setup(cfg)
+    plain = run_messaging_fedavg(trainer, data, cfg, worker_num=4)
+    trainer2, data2 = _setup(cfg)
+    sec = run_messaging_fedavg(trainer2, data2, cfg, worker_num=4,
+                               secure=SecAggConfig(seed=5))
+    for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(sec)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4)

@@ -440,8 +440,8 @@ def test_chip_queue_carries_conn_step():
     assert "profile_bench.py CONN" in src, (
         "run_chip_queue.sh lost the CONN live-connection reactor step "
         "(ISSUE 11 queues it for the next chip window)")
-    assert "13/20" in src, (
-        "run_chip_queue.sh lost the CONN step numbering (13/20 since "
+    assert "13/21" in src, (
+        "run_chip_queue.sh lost the CONN step numbering (13/21 since "
         "ISSUEs 12-17 appended bench_diff, exp_POD, exp_ELASTIC, the "
         "compressed-carry arm and the straggler observatory arm)")
     assert "exp_CONN" in open(os.path.join(
@@ -584,7 +584,7 @@ def test_bench_json_schema_v13_carries_elastic_chaos_arm():
     # chip queue: the ELASTIC step + its experiment
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert "profile_bench.py ELASTIC" in queue and "17/20" in queue, (
+    assert "profile_bench.py ELASTIC" in queue and "17/21" in queue, (
         "run_chip_queue.sh lost the ELASTIC chaos step (ISSUE 14 "
         "queues it for the next chip window; ISSUE 16 renumbered it "
         "17 when the compressed-carry arm landed as 16, ISSUE 17 "
@@ -598,7 +598,7 @@ def test_bench_json_schema_v13_carries_elastic_chaos_arm():
 def test_chip_queue_carries_pod_step():
     """ISSUE 13: the next chip window must price the multi-host
     weak-scaling sweep on a real pod slice —
-    scripts/run_chip_queue.sh carries the POD step (15/20 since
+    scripts/run_chip_queue.sh carries the POD step (15/21 since
     ISSUEs 14-17 appended the ELASTIC arm, the compressed-carry arm
     and the straggler observatory arm) and profile_bench.py defines
     the exp_POD experiment it runs."""
@@ -608,8 +608,8 @@ def test_chip_queue_carries_pod_step():
     assert "profile_bench.py POD" in src, (
         "run_chip_queue.sh lost the POD multi-host weak-scaling sweep "
         "(ISSUE 13 queues it for the next chip window)")
-    assert "15/20" in src, (
-        "run_chip_queue.sh lost the 15/20 step numbering (exp_POD is "
+    assert "15/21" in src, (
+        "run_chip_queue.sh lost the 15/21 step numbering (exp_POD is "
         "queue step 15; ISSUE 16's compressed arm is 16, ISSUE 14's "
         "exp_ELASTIC is 17, ISSUE 17's straggler arm is 18)")
     assert "exp_POD" in open(os.path.join(
@@ -679,11 +679,11 @@ def test_bench_json_schema_v14_carries_compressed_carry_arm():
         "fedml_tpu/cli.py lost the ISSUE-16 wire-tier flags")
     assert re.search(r'default="f32"', cli), (
         "--carry_codec must default to f32 (the bitwise escape hatch)")
-    # chip queue: the compressed arm rides exp_POD, renumbered 16/20
+    # chip queue: the compressed arm rides exp_POD, renumbered 16/21
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert "FEDML_POD_ARMS=compress" in queue and "16/20" in queue, (
-        "run_chip_queue.sh lost the 16/20 compressed-carry step "
+    assert "FEDML_POD_ARMS=compress" in queue and "16/21" in queue, (
+        "run_chip_queue.sh lost the 16/21 compressed-carry step "
         "(ISSUE 16 prices the bytes column on real DCN frames)")
     assert "FEDML_POD_ARMS" in open(os.path.join(
         base, "tools", "profile_bench.py")).read(), (
@@ -748,11 +748,11 @@ def test_bench_json_schema_v15_carries_straggler_observatory():
         assert field in bd, (
             f"tools/bench_diff.py lost the straggler rule field "
             f"{field} (the v15 acceptance gate)")
-    # chip queue: the straggler observatory arm rides as 18/20
+    # chip queue: the straggler observatory arm rides as 18/21
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert "18/20" in queue and "trace_timeline.py" in queue, (
-        "run_chip_queue.sh lost the 18/20 straggler observatory step "
+    assert "18/21" in queue and "trace_timeline.py" in queue, (
+        "run_chip_queue.sh lost the 18/21 straggler observatory step "
         "(ISSUE 17 banks per-rank obs dirs + the merged timeline)")
     import subprocess
     r = subprocess.run(["bash", "-n", os.path.join(
@@ -811,11 +811,11 @@ def test_bench_json_schema_v16_carries_cluster_block():
         assert ('"cluster"' in bd) and field in bd, (
             f"tools/bench_diff.py lost the cluster rule field "
             f"{field} (the v16 acceptance gate)")
-    # chip queue: the fused-cluster arm appended as 19/20
+    # chip queue: the fused-cluster arm appended as 19/21
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert "19/20" in queue and "profile_bench.py CLUSTER" in queue, (
-        "run_chip_queue.sh lost the 19/20 fused-cluster step "
+    assert "19/21" in queue and "profile_bench.py CLUSTER" in queue, (
+        "run_chip_queue.sh lost the 19/21 fused-cluster step "
         "(ISSUE 18 appends it as the queue's final arm)")
     assert "exp_CLUSTER" in open(os.path.join(
         base, "tools", "profile_bench.py")).read(), (
@@ -896,17 +896,94 @@ def test_bench_json_schema_v17_carries_sparse_exchange():
         assert field in bd, (
             f"tools/bench_diff.py lost the sparse rule field "
             f"{field} (the v17 acceptance gate)")
-    # chip queue: the sparse arms appended as 20/20 on both wires
+    # chip queue: the sparse arms appended as 20/21 on both wires
     queue = open(os.path.join(base, "scripts",
                               "run_chip_queue.sh")).read()
-    assert ("20/20" in queue and "FEDML_POD_ARMS=sparse" in queue
+    assert ("20/21" in queue and "FEDML_POD_ARMS=sparse" in queue
             and "FEDML_CLUSTER_ARMS=clean,sparse" in queue), (
-        "run_chip_queue.sh lost the 20/20 sparse-exchange step "
+        "run_chip_queue.sh lost the 20/21 sparse-exchange step "
         "(ISSUE 19 prices both wires on real DCN frames + sockets)")
     assert "FEDML_CLUSTER_ARMS" in open(os.path.join(
         base, "tools", "profile_bench.py")).read(), (
         "profile_bench.py exp_CLUSTER lost the FEDML_CLUSTER_ARMS "
         "override the queue's sparse step uses")
+    import subprocess
+    r = subprocess.run(["bash", "-n", os.path.join(
+        base, "scripts", "run_chip_queue.sh")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_bench_json_schema_v18_carries_secure_aggregation():
+    """ISSUE 20: schema v18 adds the secure block — the pairwise-mask
+    data plane (fedml_tpu/secure/secagg.py) priced on the live async
+    FSM: privacy-tax ratio with the >= 0.5 floor, the masks-cancel
+    bitwise pin, zero below-threshold commits on clean arms, and the
+    masked-byzantine pair.  Static source check like the v3-v17
+    guards: bench fields, the secure runtime, the wire transport,
+    bench_diff v18 rules, the appended chip-queue step."""
+    src = open(BENCH).read()
+    m = re.search(r"^SCHEMA_VERSION\s*=\s*(\d+)", src, re.M)
+    assert int(m.group(1)) >= 18, (
+        "bench schema must stay >= v18 (secure aggregation block)")
+    for field in ('"secure"', "privacy_tax_ratio",
+                  "masks_cancel_bitwise_ok",
+                  "below_threshold_commits_clean", "rejected_uplinks",
+                  "recovered_rounds"):
+        assert field in src, (
+            f"bench.py lost the v18 secure-aggregation field {field} "
+            "(see fedml_tpu/secure/secagg.py ISSUE 20)")
+    base = os.path.join(os.path.dirname(__file__), "..")
+    # the data plane: masks, escrowed shares, the named
+    # below-threshold refusal, the DP stage
+    sa = open(os.path.join(base, "fedml_tpu", "secure",
+                           "secagg.py")).read()
+    for sym in ("class SecureAggregator", "class SecAggKeyring",
+                "class SecAggBelowThreshold", "def pairwise_mask",
+                "def client_row", "def reconstruct_sk", "dp_clip"):
+        assert sym in sa, (
+            f"fedml_tpu/secure/secagg.py lost {sym!r} — the ISSUE-20 "
+            "pairwise-mask data plane the v18 arm drives")
+    # the wire: the secagg transport is opaque-by-design (masked field
+    # words), decode_into must refuse it BY NAME, the codec must have
+    # the dedicated masked-frame decode
+    msg = open(os.path.join(base, "fedml_tpu", "comm",
+                            "message.py")).read()
+    for sym in ('"secagg"', "def decode_secagg"):
+        assert sym in msg, (
+            f"fedml_tpu/comm/message.py lost {sym!r} — the ISSUE-20 "
+            "masked uplink wire (secagg frames route through "
+            "decode_secagg; decode_into refuses them by name)")
+    # the engines: both FSMs carry the secure seam + the marker-skew
+    # quarantine; the jitted u32 field fold twin lives in staleness
+    assert "MSG_ARG_KEY_SECAGG" in open(os.path.join(
+        base, "fedml_tpu", "async_", "lifecycle.py")).read(), (
+        "fedml_tpu/async_/lifecycle.py lost the secagg marker — "
+        "plain<->secure config skew must quarantine by name")
+    assert "MSG_ARG_KEY_SECAGG" in open(os.path.join(
+        base, "fedml_tpu", "comm", "fedavg_messaging.py")).read(), (
+        "fedml_tpu/comm/fedavg_messaging.py lost the secagg marker")
+    assert "def make_field_fold_fn" in open(os.path.join(
+        base, "fedml_tpu", "async_", "staleness.py")).read(), (
+        "fedml_tpu/async_/staleness.py lost make_field_fold_fn — the "
+        "jitted (acc + row) mod p fold the masked ingest rides")
+    # bench_diff must judge the new fields
+    bd = open(os.path.join(base, "tools", "bench_diff.py")).read()
+    for field in ("privacy_tax_ratio", "masks_cancel_bitwise_ok",
+                  "below_threshold_commits_clean"):
+        assert field in bd, (
+            f"tools/bench_diff.py lost the secure rule field "
+            f"{field} (the v18 acceptance gate)")
+    # chip queue: the secure arm appended as 21/21
+    queue = open(os.path.join(base, "scripts",
+                              "run_chip_queue.sh")).read()
+    assert "21/21" in queue and "profile_bench.py SECAGG" in queue, (
+        "run_chip_queue.sh lost the 21/21 secure-aggregation step "
+        "(ISSUE 20 prices the privacy tax on the chip-attached fold)")
+    assert "def exp_SECAGG" in open(os.path.join(
+        base, "tools", "profile_bench.py")).read(), (
+        "profile_bench.py lost exp_SECAGG — the queue's 21/21 step "
+        "calls it")
     import subprocess
     r = subprocess.run(["bash", "-n", os.path.join(
         base, "scripts", "run_chip_queue.sh")],
@@ -952,7 +1029,7 @@ def test_bench_diff_exists_and_flags_synthetic_regression(tmp_path):
 
 def test_chip_queue_carries_bench_diff_step():
     """ISSUE 12: the chip queue's judgment pass diffs the fresh bench
-    record against the committed trajectory (step 14/20 since ISSUEs
+    record against the committed trajectory (step 14/21 since ISSUEs
     13-18 appended exp_POD, exp_ELASTIC, the compressed-carry arm, the
     straggler observatory arm and the fused-cluster arm), and the
     script stays shell-valid."""
@@ -963,8 +1040,8 @@ def test_chip_queue_carries_bench_diff_step():
     assert "bench_diff.py" in src, (
         "run_chip_queue.sh lost the bench_diff regression step "
         "(ISSUE 12 appends it as the queue's judgment pass)")
-    assert "14/20" in src, (
-        "run_chip_queue.sh lost the 14/20 bench_diff step numbering "
+    assert "14/21" in src, (
+        "run_chip_queue.sh lost the 14/21 bench_diff step numbering "
         "(the judgment pass rides right after the bench artifacts; "
         "exp_POD is 15, the compressed arm 16, exp_ELASTIC 17, the "
         "straggler observatory arm 18, the fused-cluster arm 19)")

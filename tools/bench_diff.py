@@ -66,7 +66,18 @@ Noise-band sources (don't tighten without re-measuring):
     deterministic per row_dim; throughput_ratio_vs_dense carries the
     ISSUE-19 >= 0.9x gate (the scatter-fold ingest path must not tax
     committed throughput); digests_equal is a boolean pin (a
-    <=k-sparse row replays bitwise through the sparse codec).
+    <=k-sparse row replays bitwise through the sparse codec);
+  * secure aggregation (v18, ISSUE 20): privacy_tax_ratio (masked vs
+    plain committed-updates/sec on the same workload) carries a
+    >= 0.5 floor — the pairwise-mask data plane must not halve the
+    live FSM's throughput; masks_cancel_bitwise_ok is a boolean pin
+    (the full-cohort masked field sum equals the plain fixed-point
+    sum EXACTLY or the protocol is broken);
+    below_threshold_commits_clean carries a zero gate (clean arms
+    have no dropouts, so a below-threshold refusal there is a
+    protocol bug, not a policy outcome); secure/dp accuracy rides
+    the +-0.04 quality band; the byzantine rows are informational
+    (the blinded-screen demonstration is the POINT, not a regression).
 """
 from __future__ import annotations
 
@@ -78,7 +89,7 @@ import os
 import sys
 from typing import Optional
 
-SCHEMA_MIN, SCHEMA_MAX = 2, 17
+SCHEMA_MIN, SCHEMA_MAX = 2, 18
 
 
 # ---------------------------------------------------------------------------
@@ -121,7 +132,7 @@ def _slo_breaches(block) -> Optional[float]:
         if not isinstance(arm, dict):
             continue
         if any(tag in name for tag in ("chaos", "storm", "mixed",
-                                       "curve")):
+                                       "curve", "byz")):
             continue
         seen = True
         total += float(arm.get("breaches", 0))
@@ -297,6 +308,26 @@ def prune(doc: dict) -> dict:
             agree = agree and bool(sp.get("ranks_agree", True))
         f["recv_thread_deaths"] = deaths
         f["ranks_agree"] = agree
+    elif mode == "secure":
+        # v18 pairwise-mask secure aggregation (ISSUE 20)
+        s = doc.get("secure") or {}
+        f["privacy_tax_ratio"] = s.get("privacy_tax_ratio",
+                                       doc.get("value"))
+        f["plain_updates_per_sec"] = s.get("plain_updates_per_sec")
+        f["secure_updates_per_sec"] = s.get("secure_updates_per_sec")
+        f["secure_acc"] = s.get("secure_acc")
+        f["dp_acc"] = s.get("dp_acc")
+        f["uplink_bytes_ratio"] = s.get("uplink_bytes_ratio")
+        f["masks_cancel_bitwise_ok"] = s.get("masks_cancel_bitwise_ok")
+        f["below_threshold_commits_clean"] = s.get(
+            "below_threshold_commits_clean")
+        byz = s.get("byzantine") or {}
+        f["byz_overflow_rejected_uplinks"] = (
+            byz.get("overflow") or {}).get("rejected_uplinks")
+        f["byz_overflow_recovered_rounds"] = (
+            byz.get("overflow") or {}).get("recovered_rounds")
+        f["byz_infield_rejected_uplinks"] = (
+            byz.get("infield") or {}).get("rejected_uplinks")
     # v11: clean-arm SLO breaches ride every mode
     b = _slo_breaches(doc.get("slo"))
     if b is not None:
@@ -482,6 +513,53 @@ RULES: dict[tuple, Rule] = {
         -1, 0.01,
         note="len(frame) of the sparse uplink; deterministic per "
              "row_dim"),
+    # -- secure aggregation (ISSUE 20, v18): the tax ratio carries the
+    # floor; masks_cancel_bitwise_ok rides the boolean gate path (the
+    # masked field sum equals the plain fixed-point sum EXACTLY or the
+    # protocol is broken); below_threshold_commits_clean carries the
+    # zero gate (no dropouts on the clean arms, so any refusal there
+    # is a bug); accuracy rides the +-0.04 quality band; the byzantine
+    # rows are informational — the blinded screen and the quantizer
+    # refusals are documented BEHAVIOR, not trend metrics.
+    ("secure", "privacy_tax_ratio"): Rule(
+        +1, 0.35, gate_min=0.5,
+        note="ISSUE-20 >=0.5x floor — masking must not halve the live "
+             "FSM's committed rate (measured 1.2x on 2-core: the u32 "
+             "field fold is cheaper than the plain f32 admission "
+             "pipeline; the tax lives in client-side mask generation "
+             "and 4 B/word uplinks)"),
+    ("secure", "plain_updates_per_sec"): Rule(
+        +1, 0.65, note="GIL-noise band, INPROC thread workload"),
+    ("secure", "secure_updates_per_sec"): Rule(
+        +1, 0.65, note="GIL-noise band, INPROC thread workload"),
+    ("secure", "secure_acc"): Rule(
+        +1, 0.0, abs_band=0.04, note="quality-band +-0.04"),
+    ("secure", "dp_acc"): Rule(
+        +1, 0.0, abs_band=0.04,
+        note="end-to-end private mode (clip 3.0, noise 1e-3): the DP "
+             "cost must stay inside the quality band at these "
+             "hyperparameters"),
+    ("secure", "uplink_bytes_ratio"): Rule(
+        -1, 0.10,
+        note="masked/plain encoded-frame bytes at the bench model dim "
+             "— a deterministic function of the frame layout (u32 "
+             "field words are incompressible by design), so movement "
+             "means the wire format changed"),
+    ("secure", "below_threshold_commits_clean"): Rule(
+        -1, 0.0, gate_max=0.0,
+        note="zero gate: clean arms have no dropouts — a "
+             "below-threshold refusal there is a protocol bug"),
+    ("secure", "byz_overflow_rejected_uplinks"): Rule(
+        0, note="quantizer range refusals under the overflow boost — "
+                "the one enforcement masking cannot blind; "
+                "informational (frac x commits by construction)"),
+    ("secure", "byz_overflow_recovered_rounds"): Rule(
+        0, note="dropout recovery exercised by the refused uplinks; "
+                "informational"),
+    ("secure", "byz_infield_rejected_uplinks"): Rule(
+        0, note="in-field boost fits the quantizer range and commits "
+                "unimpeded — the blinded-screen demonstration; 0 by "
+                "construction"),
 }
 # pattern rules for the per-count connection fields
 PATTERN_RULES: list[tuple] = [

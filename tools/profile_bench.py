@@ -1279,6 +1279,47 @@ def exp_CLUSTER():
                          f"failed (rc={r.returncode})")
 
 
+def exp_SECAGG():
+    """Pairwise-mask secure aggregation chip-attached (ISSUE 20):
+    `bench.py --mode secure` — the privacy-tax table on the live async
+    messaging FSM with the chip-attached runtime driving the jitted
+    u32 field fold (plain vs masked committed-updates/sec), the
+    plain/secure/dp accuracy triple (end-to-end private mode), the
+    masks-cancel bitwise pin, and the masked-byzantine pair (the
+    in-field boost that sails past the blinded screen vs the overflow
+    boost the client-side quantizer range refusal drops).  Gates ride
+    bench_diff v18: privacy_tax_ratio >= 0.5, zero below-threshold
+    commits on the clean arms, masks_cancel_bitwise_ok.
+    FEDML_SECURE_COHORT / FEDML_SECURE_COMMITS override the workload
+    shape."""
+    import json as _json
+    import subprocess
+    bench = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "bench.py")
+    cmd = [sys.executable, bench, "--mode", "secure"]
+    cohort = os.environ.get("FEDML_SECURE_COHORT")
+    if cohort:
+        cmd += ["--secure_cohort", cohort]
+    commits = os.environ.get("FEDML_SECURE_COMMITS")
+    if commits:
+        cmd += ["--secure_commits", commits]
+    r = subprocess.run(cmd, text=True, capture_output=True,
+                       timeout=3600)
+    sys.stderr.write(r.stderr)
+    print(r.stdout, flush=True)
+    if r.returncode != 0:
+        raise SystemExit(f"exp_SECAGG: bench.py --mode secure "
+                         f"failed (rc={r.returncode})")
+    line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+    sec = (_json.loads(line).get("secure") or {})
+    print(f"SECAGG tax {sec.get('privacy_tax_ratio')}  "
+          f"masks_cancel {sec.get('masks_cancel_bitwise_ok')}  "
+          f"below_threshold_clean "
+          f"{sec.get('below_threshold_commits_clean')}  "
+          f"secure_acc {sec.get('secure_acc')}  "
+          f"dp_acc {sec.get('dp_acc')}", flush=True)
+
+
 def exp_U8():
     print(f"U8 chunked(8,unroll=2): "
           f"{_chunked_round(8, unroll=2):.3f}s/round", flush=True)
