@@ -1319,10 +1319,17 @@ def _bench_attack(args) -> None:
 # so the plain/secure pair isolates the DATA PLANE — quantize + mask +
 # field fold + unmask vs flatten + f32 fold.  Byzantine arms run the
 # same workload with a boost adversary at two magnitudes: one inside
-# the quantizer range (blinded-screen demonstration) and one past it
-# (the range refusal that survives masking).
+# the ENFORCED quantizer bound — since the REVIEW fix that is the
+# per-client cohort-headroom slice (p−1)//(2K·scale), |w·x| < 2048 at
+# cohort 8 / scale 2^16, NOT the field half-range — and one past it
+# (the range refusal that survives masking).  The in-field boost must
+# clear that slice with margin or the arm's attackers are refused at
+# quantize, never upload, and the no-deadline barrier stalls: boost 8
+# keeps this workload's rows at ~55% of the bound (boost 50 is now
+# correctly refused — the headroom guard catching sum-aliasing rows
+# the old per-word bound let through).
 SECURE_BYZ_FRAC = 0.25
-SECURE_BYZ_BOOST_INFIELD = 50.0
+SECURE_BYZ_BOOST_INFIELD = 8.0
 SECURE_BYZ_BOOST_OVERFLOW = 1e9
 SECURE_OVERFLOW_DEADLINE_S = 0.5
 

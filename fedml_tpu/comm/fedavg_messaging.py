@@ -174,6 +174,11 @@ class FedAvgServerManager(ServerManager):
         self._round_lock = threading.Lock()
         self._watchdog: Optional[threading.Timer] = None
         self.partial_rounds = 0           # observability: timed-out rounds
+        # ranks whose uplinks are config-skew quarantined (ISSUE 20):
+        # skew is a config property, not a transient, so a quarantined
+        # rank is treated as dead for the all-received barrier — without
+        # this, one misconfigured client deadlocks the federation
+        self._quarantined: set[int] = set()
         self.done = threading.Event()
 
     def send_init_msg(self) -> None:
@@ -206,12 +211,23 @@ class FedAvgServerManager(ServerManager):
         secure = self.aggregator.secure is not None
         if secure != (marker is not None):
             # ISSUE 20: plain uplink to a secure server (or masked
-            # words to a plain one) — quarantine BY NAME, never fold
+            # words to a plain one) — quarantine BY NAME, never fold.
+            # The sender's slot can never fill (skew is config, not
+            # luck), so mark it dead for the barrier and close the
+            # round if everyone else already uploaded — otherwise the
+            # all-received barrier waits on this rank forever.
             log.warning(
                 "%s server: %s uplink from rank %d quarantined "
                 "(--secure_agg config skew between server and client)",
                 "secure" if secure else "plain",
                 "PLAIN" if secure else "MASKED", sender)
+            with self._round_lock:
+                self._quarantined.add(sender)
+                if not self._quorum_met():
+                    return
+                last = self._finish_round()
+            if last:
+                self.finish()
             return
         with self._round_lock:
             if (upload_round is not None
@@ -220,14 +236,25 @@ class FedAvgServerManager(ServerManager):
             all_received = self.aggregator.add_local_trained_result(
                 sender - 1, msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS),
                 msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+            done = all_received or self._quorum_met()
             if self.straggler_timeout is not None and self._watchdog is None \
-                    and not all_received:
+                    and not done:
                 self._arm_watchdog(self.round_idx)
-            if not all_received:
+            if not done:
                 return
             last = self._finish_round()
         if last:       # finish() outside _round_lock: it joins the receive
             self.finish()   # thread, which may be waiting on that lock
+
+    def _quorum_met(self) -> bool:
+        """All non-quarantined slots received (caller holds _round_lock).
+        A config-skew-quarantined rank never fills its slot, so the
+        all-received barrier discounts it; at least one genuine upload
+        is still required — an all-skew cohort has nothing to commit
+        (the launcher's overall timeout reports that by name)."""
+        got = self.aggregator.received_count()
+        return (got > 0
+                and got + len(self._quarantined) >= self.aggregator.worker_num)
 
     def _arm_watchdog(self, armed_round: int) -> None:
         self._watchdog = threading.Timer(

@@ -37,8 +37,15 @@ def modmat(A: np.ndarray, B: np.ndarray, p: int) -> np.ndarray:
 # -- fixed-point bridge ------------------------------------------------------
 
 def quantize(x: np.ndarray, scale: int = 2 ** 16,
-             p: int = DEFAULT_PRIME) -> np.ndarray:
+             p: int = DEFAULT_PRIME,
+             max_abs: int | None = None) -> np.ndarray:
     """float → field: round(x·scale) mod p, negatives wrap to [p/2, p).
+
+    Non-finite inputs are rejected FIRST: inf/NaN cast to INT64_MIN
+    under .astype(np.int64) (and np.abs(INT64_MIN) stays negative), so
+    they would slide past the magnitude check below and encode as
+    garbage — the named refusal here is the enforcement a byzantine or
+    diverged client cannot blind through masking.
 
     Field-overflow bound: the signed fixed-point magnitude |round(x·scale)|
     must stay ≤ (p−1)//2 — the field's signed half-range — or the value
@@ -48,16 +55,30 @@ def quantize(x: np.ndarray, scale: int = 2 ** 16,
     ValueError instead of wrapping; both signs are pinned at the boundary
     in tests/test_mpc.py.  With the default scale 2^16 and p = 2^31−1 the
     usable float range is ±16383.999; aggregate sums share the same bound,
-    so K summands must jointly satisfy K·max|x|·scale ≤ (p−1)//2."""
-    q = np.round(np.asarray(x, np.float64) * scale).astype(np.int64)
+    so K summands must jointly satisfy K·max|x|·scale ≤ (p−1)//2 —
+    callers that fold K rows pass ``max_abs=(p−1)//(2K)`` to enforce
+    their per-summand slice of that budget (secagg client_row does),
+    because a sum that wraps is undetectable after the fact."""
+    x = np.asarray(x, np.float64)
+    if x.size and not np.all(np.isfinite(x)):
+        raise ValueError(
+            "fixed-point quantize: non-finite input (inf/NaN) cannot be "
+            "encoded in the field — clip or drop the row upstream")
+    q = np.round(x * scale).astype(np.int64)
     bound = (p - 1) // 2
+    if max_abs is not None:
+        bound = min(int(max_abs), bound)
     if q.size and int(np.max(np.abs(q))) > bound:
-        bad = float(np.max(np.abs(np.asarray(x, np.float64))))
+        bad = float(np.max(np.abs(x)))
+        why = ("the value would alias across the sign boundary after "
+               "mod p" if bound == (p - 1) // 2 else
+               "past the caller's per-summand share of the field range, "
+               "the aggregate sum could cross the signed half-range and "
+               "alias at dequantize")
         raise ValueError(
             f"fixed-point field overflow: |x|·scale reaches "
-            f"{int(np.max(np.abs(q)))} > (p-1)//2 = {bound} "
-            f"(max |x| = {bad:g}, scale = {scale}) — the value would "
-            f"alias across the sign boundary after mod p; reduce the "
+            f"{int(np.max(np.abs(q)))} > bound {bound} "
+            f"(max |x| = {bad:g}, scale = {scale}) — {why}; reduce the "
             f"scale or clip the input")
     return _mod(q, p)
 

@@ -6,7 +6,9 @@ TurboAggregate field primitives (core/mpc.py):
 * every ordered client pair (i, j) owns a DH shared secret
   ``shared_key(pk_j, sk_i) == shared_key(pk_i, sk_j)`` which seeds a
   counter-mode PRG stream (numpy Philox: key = the pairwise secret,
-  counter = the round index) of field elements;
+  counter HIGH word = the round index, so per-round streams are 2^192
+  blocks apart and can never overlap for any row length) of field
+  elements;
 * client i uploads ``quantize(weight·update) + Σ_{j>i} m_ij −
   Σ_{j<i} m_ij  (mod p)`` — every pair's mask appears once with each
   sign, so the COHORT SUM cancels every mask exactly in the integer
@@ -43,10 +45,15 @@ the field's signed half-range, so a boosted model-replacement larger
 than ±(p−1)/(2·scale) cannot even be encoded.  ``bench.py --mode
 secure`` measures exactly this (the masked × byzantine arm).
 
-Arithmetic bounds (documented at mpc.quantize): every per-client word
-and the K-client field SUM must stay within ±(p−1)//2, i.e.
-K·max|weight·x|·scale ≤ (p−1)//2 — with the default scale 2^16 and
-p = 2^31−1 that is Σ|weight·x| < 16384 per coordinate.
+Arithmetic bounds (ENFORCED at quantization, see mpc.quantize and
+client_row): every per-client word and the K-client field SUM must
+stay within ±(p−1)//2, i.e. K·max|weight·x|·scale ≤ (p−1)//2.
+client_row passes ``max_abs=(p−1)//(2K)`` so each client's slice of
+that budget is checked a priori — the sum cannot alias, and the check
+cannot be deferred to commit because a wrapped field value is
+indistinguishable from a legitimate one post hoc.  With the default
+scale 2^16, p = 2^31−1 and a 5-client cohort that is
+|weight·x| < 3276.8 per coordinate per client.
 """
 from __future__ import annotations
 
@@ -112,13 +119,19 @@ def pairwise_mask(pair_key: int, round_idx: int, n_words: int,
                   p: int = mpc.DEFAULT_PRIME) -> np.ndarray:
     """Counter-mode PRG stream of `n_words` field elements for one
     ordered pair at one round: Philox keyed by the DH pairwise secret
-    with the round index as the counter block.  Same (key, round) →
-    same stream, which is exactly what dropout recovery replays from a
+    with the round index in the counter's HIGH (most-significant) word.
+    Generating a W-word row advances the 256-bit counter ~W/8 blocks
+    from the LOW word up, so rounds that start 2^192 blocks apart can
+    never overlap for any row length — with the round in the low word,
+    round r+1's stream was round r's shifted by 8 words, and the
+    difference of one client's consecutive masked uplinks leaked
+    plaintext quantized-update deltas.  Same (key, round) → same
+    stream, which is exactly what dropout recovery replays from a
     reconstructed secret key.  Returns int64 residues in [0, p)."""
     key = int(pair_key)
     bg = np.random.Philox(key=np.array([key & 0xFFFFFFFFFFFFFFFF,
                                         0x5EC466], dtype=np.uint64),
-                          counter=np.array([int(round_idx), 0, 0, 0],
+                          counter=np.array([0, 0, 0, int(round_idx)],
                                            dtype=np.uint64))
     return np.random.Generator(bg).integers(0, p, size=n_words,
                                             dtype=np.int64)
@@ -215,8 +228,6 @@ class SecureAggregator:
         self._acc = None                     # device u32 running field sum
         self._rows: dict[int, np.ndarray] = {}   # unmask-window retention
         self._lock = threading.Lock()
-        self._dp_rng = (np.random.default_rng(cfg.seed + 41)
-                        if cfg.dp_noise > 0.0 else None)
         self.below_threshold_rounds = 0
         self.recovered_rounds = 0            # commits that rebuilt masks
 
@@ -227,7 +238,14 @@ class SecureAggregator:
         quantize(weight)] + pairwise masks, as uint32 field words.
         The DP stage (end-to-end private mode) clips and noises the
         weighted update BEFORE quantization, so no un-noised value ever
-        reaches the field encoding."""
+        reaches the field encoding; the noise generator is derived per
+        (seed, client, round), so draws are thread-safe and
+        byte-deterministic no matter how concurrent uploads interleave.
+        Quantization enforces the per-client slice of the aggregate
+        bound, |q| ≤ (p−1)//(2K) for a K-client cohort, so the folded
+        field SUM can never cross the signed half-range and alias at
+        dequantize — aliasing is undetectable post hoc, so the guard
+        must run a priori, here."""
         p = self.cfg.prime
         x = np.asarray(flat, np.float64) * float(weight)
         if x.shape != (self.dim,):
@@ -237,13 +255,16 @@ class SecureAggregator:
             nrm = float(np.linalg.norm(x))
             if nrm > self.cfg.dp_clip:
                 x = x * (self.cfg.dp_clip / nrm)
-            if self._dp_rng is not None:
-                x = x + self._dp_rng.normal(
+            if self.cfg.dp_noise > 0.0:
+                rng = np.random.default_rng(
+                    (self.cfg.seed, 41, int(cid), int(round_idx)))
+                x = x + rng.normal(
                     0.0, self.cfg.dp_noise * self.cfg.dp_clip, x.shape)
+        head = (p - 1) // (2 * len(self.ids))
         q = np.empty((self.words,), np.int64)
-        q[:self.dim] = mpc.quantize(x, self.cfg.scale, p)
+        q[:self.dim] = mpc.quantize(x, self.cfg.scale, p, max_abs=head)
         q[self.dim] = mpc.quantize(np.array([float(weight)]),
-                                   self.cfg.scale, p)[0]
+                                   self.cfg.scale, p, max_abs=head)[0]
         for j in self.ids:
             if j == cid:
                 continue

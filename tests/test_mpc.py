@@ -46,6 +46,31 @@ def test_quantize_overflow_raises_named_both_signs():
             mpc.quantize(np.array([sign * (ok + 1.0 / 2 ** 16)]))
 
 
+def test_quantize_rejects_non_finite_by_name():
+    """REVIEW: inf/NaN cast to INT64_MIN under .astype(np.int64), and
+    np.abs(INT64_MIN) stays negative, so non-finite values slid past
+    the max-abs guard and encoded as garbage.  They must be refused
+    FIRST, by name."""
+    for bad in (np.inf, -np.inf, np.nan):
+        with pytest.raises(ValueError, match="non-finite"):
+            mpc.quantize(np.array([bad, 0.5]))
+
+
+def test_quantize_max_abs_enforces_aggregate_headroom():
+    """max_abs tightens the per-value bound below the field half-range
+    so K-summand callers can pre-buy sum headroom (secagg client_row
+    passes (p−1)//(2K)); the refusal stays the named overflow error."""
+    assert mpc.quantize(np.array([0.5]), max_abs=2 ** 15)[0] == 2 ** 15
+    with pytest.raises(ValueError, match="fixed-point field overflow"):
+        mpc.quantize(np.array([1.0]), max_abs=2 ** 15)
+    with pytest.raises(ValueError, match="aggregate"):
+        mpc.quantize(np.array([-1.0]), max_abs=2 ** 15)
+    # a max_abs at/above the half-range is a no-op, not a loosening
+    bound = (P - 1) // 2
+    assert mpc.quantize(np.array([bound / 2.0 ** 16]),
+                        max_abs=2 * bound)[0] == bound
+
+
 def test_quantize_sum_bound_documented_for_aggregates():
     # K summands share one bound: K * max|x| * scale <= (p-1)//2.  Two
     # half-bound values sum INSIDE the field; the same two past half

@@ -88,6 +88,54 @@ def test_pairwise_mask_is_round_keyed():
     assert not np.array_equal(m0, pairwise_mask(987654321, 0, 16, P))
 
 
+def test_pairwise_mask_streams_never_overlap_across_rounds():
+    """REVIEW (high): with the round index in the PRG counter's LOW
+    word, generating a W-word row advanced the counter ~W/8 blocks and
+    round r+1 replayed round r's keystream shifted by 8 words
+    (mask(k, r+1)[i] == mask(k, r)[i+8]) — the difference of one
+    client's consecutive masked uplinks leaked plaintext
+    quantized-update deltas.  The round now rides the counter's HIGH
+    word: no shifted window of one round's stream may reappear in an
+    adjacent round's."""
+    k = 123456789
+    m0 = pairwise_mask(k, 0, 64, P)
+    m1 = pairwise_mask(k, 1, 64, P)
+    for shift in range(1, 33):
+        assert not np.array_equal(m1[:64 - shift], m0[shift:]), (
+            f"round 1 replays round 0's stream at word shift {shift}")
+        assert not np.array_equal(m0[:64 - shift], m1[shift:]), (
+            f"round 0 replays round 1's stream at word shift {shift}")
+
+
+def test_client_row_refuses_non_finite_rows_by_name():
+    """REVIEW: inf/NaN cast to INT64_MIN under .astype(np.int64) and
+    slid past the magnitude guard — a diverged or byzantine client
+    could poison the whole masked cohort sum unattributably.  The
+    quantizer (the one enforcement masking cannot blind) must refuse
+    non-finite rows by name."""
+    _cfg, agg, _contribs = _mk()
+    row = np.zeros(agg.dim)
+    for bad in (np.inf, -np.inf, np.nan):
+        row[3] = bad
+        with pytest.raises(ValueError, match="non-finite"):
+            agg.client_row(1, 0, row, 1.0)
+
+
+def test_client_row_enforces_cohort_sum_headroom():
+    """REVIEW: the aggregate bound K·max|w·x|·scale ≤ (p−1)//2 was
+    documented but unenforced — K per-client-legal rows could still
+    alias the folded field SUM at dequantize, silently.  client_row now
+    quantizes with max_abs=(p−1)//(2K), so a value that fits the FIELD
+    but not the cohort's sum budget is refused a priori."""
+    _cfg, agg, _contribs = _mk(n=5)
+    row = np.zeros(agg.dim)
+    row[0] = ((P - 1) // 2) / 2.0 ** 16       # legal per-word, 5× aliases
+    with pytest.raises(ValueError, match="aggregate"):
+        agg.client_row(1, 0, row, 1.0)
+    row[0] = ((P - 1) // (2 * 5)) / 2.0 ** 16  # exactly the per-client slice
+    agg.client_row(1, 0, row, 1.0)
+
+
 @pytest.mark.parametrize("phase", ["pre_upload", "post_upload"])
 def test_dropout_recovery_byte_identical_to_clean_survivor_round(phase):
     """Satellite (c): seeded death at each phase.  A client dying
@@ -184,6 +232,22 @@ def test_dp_private_mode_composes_before_masking():
     assert acc1.tobytes() == acc2.tobytes() and w1 == w2
     with pytest.raises(ValueError, match="dp_noise"):
         SecAggConfig(dp_noise=1e-3)      # noise without a clip bound
+
+
+def test_dp_noise_is_per_client_round_keyed_not_call_order_keyed():
+    """REVIEW: one shared numpy Generator served every client thread's
+    DP draw — numpy Generators are not thread-safe, and the draw a
+    client got depended on upload interleaving.  The generator is now
+    derived per (seed, client, round): the same client_row call yields
+    the same bytes no matter which uploads ran before it."""
+    _cfg, a1, contribs = _mk(dp_clip=2.0, dp_noise=1e-3)
+    _cfg2, a2, _ = _mk(dp_clip=2.0, dp_noise=1e-3)
+    ids = sorted(contribs)
+    rows_fwd = {c: a1.client_row(c, 0, *contribs[c]) for c in ids}
+    rows_rev = {c: a2.client_row(c, 0, *contribs[c])
+                for c in reversed(ids)}
+    for c in ids:
+        np.testing.assert_array_equal(rows_fwd[c], rows_rev[c])
 
 
 def test_threshold_validation_named():
@@ -296,9 +360,13 @@ def _skew_call(secure_server, marker, caplog):
     fake = types.SimpleNamespace(
         aggregator=types.SimpleNamespace(
             secure=object() if secure_server else None,
+            worker_num=2, received_count=lambda: 0,
             add_local_trained_result=lambda *a: folded.append(a)),
         round_idx=0, straggler_timeout=None, _watchdog=None,
+        _quarantined=set(),
         _round_lock=__import__("threading").Lock())
+    fake._quorum_met = types.MethodType(
+        FedAvgServerManager._quorum_met, fake)
     with caplog.at_level(logging.WARNING,
                          logger="fedml_tpu.comm.fedavg_messaging"):
         FedAvgServerManager._handle_model_from_client(fake, msg)
@@ -317,6 +385,43 @@ def test_masked_uplink_to_plain_server_quarantined_by_name(caplog):
                               caplog=caplog)
     assert folded == [], "masked field words must never be averaged"
     assert "config skew" in text and "MASKED" in text
+
+
+def test_skewed_client_does_not_deadlock_the_barrier():
+    """REVIEW: a skewed uplink was quarantined BEFORE its slot flag was
+    set, so the default all-received barrier waited on that rank
+    forever.  The quarantined rank is now treated as dead: when every
+    other slot has a genuine upload, the quarantine itself closes the
+    round."""
+    import threading
+    from fedml_tpu.comm.fedavg_messaging import (FedAvgServerManager,
+                                                 MyMessage)
+    from fedml_tpu.comm.message import Message
+    finished = []
+    fake = types.SimpleNamespace(
+        aggregator=types.SimpleNamespace(
+            secure=object(), worker_num=2,
+            received_count=lambda: 1,       # rank 2's fold already landed
+            add_local_trained_result=lambda *a: False),
+        round_idx=0, straggler_timeout=None, _watchdog=None,
+        _quarantined=set(), _round_lock=threading.Lock(),
+        _finish_round=lambda: (finished.append(True), False)[1])
+    fake._quorum_met = types.MethodType(
+        FedAvgServerManager._quorum_met, fake)
+    msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                   np.zeros(4, np.float32))   # PLAIN uplink, secure server
+    msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+    FedAvgServerManager._handle_model_from_client(fake, msg)
+    assert fake._quarantined == {1}
+    assert finished == [True], ("the non-quarantined quorum must close "
+                                "the round instead of hanging")
+    # with NO genuine upload yet, the quorum must NOT fire (nothing to
+    # commit) — the round stays open for the real uploads
+    fake.aggregator.received_count = lambda: 0
+    finished.clear()
+    FedAvgServerManager._handle_model_from_client(fake, msg)
+    assert finished == []
 
 
 # -- the live FSMs -----------------------------------------------------------
